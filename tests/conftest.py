@@ -1,0 +1,28 @@
+"""Test harness config (SURVEY.md §4 rebuild test plan).
+
+Tests run on CPU with 8 fake devices so Pallas kernels exercise
+interpret mode and collective lowering is validated without TPU
+hardware (the driver separately compile-checks the real-TPU and
+multi-chip paths). These env vars must be set before jax is imported
+anywhere in the test process.
+"""
+
+import os
+
+# Explicit assignment, not setdefault: the dev/CI shell may have
+# JAX_PLATFORMS pre-set to a TPU plugin (e.g. axon), and the contract
+# here is that the unit suite runs on CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,21 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukernels.kernels.vector_add import saxpy, saxpy_reference
+
+
+@pytest.mark.parametrize("n", [128, 1024, 2**14, 2**20, 1000, 7])
+def test_saxpy_matches_reference(rng, n):
+    x = jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)
+    y = jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)
+    out = saxpy(2.5, x, y)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(saxpy_reference(2.5, x, y)), rtol=1e-6
+    )
+
+
+def test_saxpy_alpha_zero(rng):
+    x = jnp.asarray(rng.standard_normal(512), dtype=jnp.float32)
+    y = jnp.asarray(rng.standard_normal(512), dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(saxpy(0.0, x, y)), np.asarray(y))

@@ -1,0 +1,24 @@
+"""tpukernels — TPU-native rebuild of the `anonyomous4/parallel-c-programs` suite.
+
+A self-checking parallel-kernel benchmark framework in which every kernel
+(SAXPY vector add, tiled SGEMM, 2D/3D Jacobi stencil, prefix-scan +
+histogram, O(N^2) direct N-body) has a JAX/Pallas TPU implementation,
+reached from a plain-C benchmark driver through a C-ABI shim
+(`c/shim/tpu_shim.c`), and whose multi-node collectives are
+`jax.lax.psum`/`ppermute` over a `jax.sharding.Mesh` (ICI/DCN) instead
+of MPI.
+
+Layer map (see SURVEY.md §1–§2; the reference tree was empty at survey
+time, so component numbers C1–C12 refer to SURVEY.md §2's inventory):
+
+- ``tpukernels.kernels``  — Pallas kernel variants (C4–C8 equivalents)
+- ``tpukernels.parallel`` — mesh / collectives / bus-bw harness (C9)
+- ``tpukernels.registry`` — name -> jitted callable (the TPU column of
+  the C dispatch table, C3)
+- ``tpukernels.capi``     — marshalling layer the C shim (C10) imports
+- ``tpukernels.utils``    — tiling / timing helpers (C12 analog)
+"""
+
+__version__ = "0.1.0"
+
+from tpukernels import registry  # noqa: F401

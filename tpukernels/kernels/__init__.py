@@ -1,0 +1,8 @@
+"""Pallas kernel variants (SURVEY.md C4–C8).
+
+Import kernels via their modules (e.g. ``tpukernels.kernels.sgemm``) or
+look them up by benchmark name through ``tpukernels.registry``. Names
+are NOT re-exported here: several modules export a function with the
+same name as the module, and re-exporting would shadow the submodule
+attribute on this package.
+"""

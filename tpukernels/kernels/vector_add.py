@@ -1,0 +1,71 @@
+"""SAXPY vector add: y_out = alpha * x + y  (SURVEY.md C4).
+
+Reference config: N = 2**20, float32 (BASELINE.json configs[0]; the
+reference tree was empty, so no file:line citation is possible — the
+contract comes from the serial-C oracle the C driver runs).
+
+TPU design: a VPU elementwise kernel. The 1-D problem array is reshaped
+to (rows, 128) to satisfy lane tiling, gridded over row blocks so
+arbitrarily large N streams through VMEM. alpha rides in SMEM as a
+(1, 1) scalar.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpukernels.utils import cdiv, default_interpret
+from tpukernels.utils.shapes import LANES
+
+_BLOCK_ROWS = 512  # (512, 128) f32 block = 256 KiB per operand in VMEM
+
+
+def _saxpy_kernel(alpha_ref, x_ref, y_ref, o_ref):
+    o_ref[:] = alpha_ref[0, 0] * x_ref[:] + y_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _saxpy_2d(alpha, x2, y2, interpret=False):
+    rows = x2.shape[0]
+    grid = (cdiv(rows, _BLOCK_ROWS),)
+    block = (min(_BLOCK_ROWS, rows), LANES)
+    return pl.pallas_call(
+        _saxpy_kernel,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(alpha, x2, y2)
+
+
+def saxpy(alpha, x, y, interpret: bool | None = None):
+    """y_out = alpha*x + y for 1-D float arrays of any length."""
+    if interpret is None:
+        interpret = default_interpret()
+    n = x.size
+    x = x.reshape(-1)
+    y = y.reshape(-1)
+    padded = cdiv(n, LANES) * LANES
+    if padded != n:
+        x = jnp.pad(x, (0, padded - n))
+        y = jnp.pad(y, (0, padded - n))
+    x2 = x.reshape(-1, LANES)
+    y2 = y.reshape(-1, LANES)
+    alpha2 = jnp.asarray(alpha, dtype=x.dtype).reshape(1, 1)
+    out = _saxpy_2d(alpha2, x2, y2, interpret=interpret)
+    return out.reshape(-1)[:n]
+
+
+def saxpy_reference(alpha, x, y):
+    """jnp oracle (mirrors the serial-C golden variant)."""
+    return alpha * x + y

@@ -1,0 +1,68 @@
+"""Kernel registry: the TPU column of the C dispatch table (SURVEY.md C3).
+
+The C driver dispatches `--device=tpu` through the shim (C10) into
+`tpukernels.capi`, which looks kernels up here by the same string key
+the C dispatch table uses. Python-side callers (bench.py, tests) use it
+directly.
+
+Population is lazy: kernel modules (and with them JAX and the TPU
+runtime) are only imported on the first lookup()/names() call, so a C
+host embedding Python pays nothing for `import tpukernels` until it
+actually dispatches a kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+_POPULATED = False
+
+
+def lookup(name: str) -> Callable:
+    _populate()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown kernel {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def names():
+    _populate()
+    return sorted(_REGISTRY)
+
+
+def _populate():
+    global _POPULATED
+    if _POPULATED:
+        return
+    _POPULATED = True
+
+    import tpukernels.kernels.vector_add as _vector_add
+    import tpukernels.kernels.sgemm as _sgemm
+
+    _REGISTRY["vector_add"] = _vector_add.saxpy
+    _REGISTRY["sgemm"] = _sgemm.sgemm
+    try:
+        import tpukernels.kernels.stencil as _stencil
+
+        _REGISTRY["stencil2d"] = _stencil.jacobi2d
+        _REGISTRY["stencil3d"] = _stencil.jacobi3d
+    except ImportError:
+        pass
+    try:
+        import tpukernels.kernels.scan as _scan
+        import tpukernels.kernels.histogram as _histogram
+
+        _REGISTRY["scan"] = _scan.inclusive_scan
+        _REGISTRY["histogram"] = _histogram.histogram
+    except ImportError:
+        pass
+    try:
+        import tpukernels.kernels.nbody as _nbody
+
+        _REGISTRY["nbody"] = _nbody.nbody_step
+    except ImportError:
+        pass

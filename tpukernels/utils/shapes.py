@@ -1,0 +1,53 @@
+"""Shape/tiling helpers shared by all Pallas kernels.
+
+TPU tiling constraints (float32): last dim a multiple of 128 lanes,
+second-to-last a multiple of 8 sublanes. Kernels pad/reshape 1-D
+problem arrays into (rows, 128)-shaped 2-D arrays to satisfy them.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+LANES = 128
+SUBLANES_F32 = 8
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b
+
+
+def pad_to_multiple(x: jax.Array, multiple: int, axis: int = 0, value=0):
+    """Pad `x` along `axis` so its size is a multiple of `multiple`.
+
+    Returns (padded, original_size).
+    """
+    n = x.shape[axis]
+    target = round_up(n, multiple)
+    if target == n:
+        return x, n
+    pad_width = [(0, 0)] * x.ndim
+    pad_width[axis] = (0, target - n)
+    return jnp.pad(x, pad_width, constant_values=value), n
+
+
+@functools.cache
+def default_interpret() -> bool:
+    """Run Pallas kernels in interpreter mode when no TPU is attached.
+
+    Tests run on CPU (with fake devices for collectives); the real
+    compiled path is exercised on the TPU chip. Override with
+    TPU_KERNELS_INTERPRET=0/1.
+    """
+    env = os.environ.get("TPU_KERNELS_INTERPRET")
+    if env is not None:
+        return env not in ("0", "false", "False")
+    return jax.default_backend() != "tpu"

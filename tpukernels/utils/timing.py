@@ -1,0 +1,28 @@
+"""Wall-clock timing of jitted callables, matching the C driver's rules.
+
+The C benchmark driver (SURVEY.md C1/C12) owns the authoritative timing
+loop; this module reproduces its discipline for the pure-Python path
+(bench.py, busbw sweeps): warm up to exclude compile time, then time
+repetitions with a monotonic clock, blocking on device completion inside
+the timed region so GFLOPS are honest.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+def time_jitted(fn, *args, reps: int = 10, warmup: int = 2):
+    """Return (best_seconds_per_call, last_result)."""
+    result = None
+    for _ in range(max(warmup, 1)):
+        result = jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        result = jax.block_until_ready(fn(*args))
+        t1 = time.perf_counter()
+        best = min(best, t1 - t0)
+    return best, result
