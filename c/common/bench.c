@@ -1,0 +1,155 @@
+#define _POSIX_C_SOURCE 199309L
+#include "bench.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+void bench_params_default(bench_params_t *p) {
+    memset(p, 0, sizeof(*p));
+    p->n = 1 << 20;
+    p->m = 0;
+    p->k = 0;
+    p->z = 0;
+    p->iters = 1;
+    p->reps = 5;
+    p->check = 0;
+    p->nbins = 256;
+    p->alpha = 2.5;
+    p->beta = 0.5;
+    p->dt = 1e-3;
+    p->seed = 0x243F6A8885A308D3ull; /* pi digits; fixed so golden is stable */
+    snprintf(p->device, sizeof(p->device), "serial");
+}
+
+static long parse_long(const char *s, const char *flag) {
+    char *end;
+    long v = strtol(s, &end, 10);
+    if (*end != '\0') {
+        fprintf(stderr, "bad value for %s: %s\n", flag, s);
+        exit(2);
+    }
+    return v;
+}
+
+void bench_parse_args(bench_params_t *p, int argc, char **argv,
+                      const char *kernel_name) {
+    for (int i = 1; i < argc; i++) {
+        const char *a = argv[i];
+        if (strncmp(a, "--device=", 9) == 0) {
+            snprintf(p->device, sizeof(p->device), "%s", a + 9);
+        } else if (strncmp(a, "--n=", 4) == 0) {
+            p->n = parse_long(a + 4, "--n");
+        } else if (strncmp(a, "--m=", 4) == 0) {
+            p->m = parse_long(a + 4, "--m");
+        } else if (strncmp(a, "--k=", 4) == 0) {
+            p->k = parse_long(a + 4, "--k");
+        } else if (strncmp(a, "--z=", 4) == 0) {
+            p->z = parse_long(a + 4, "--z");
+        } else if (strncmp(a, "--iters=", 8) == 0) {
+            p->iters = parse_long(a + 8, "--iters");
+        } else if (strncmp(a, "--reps=", 7) == 0) {
+            p->reps = (int)parse_long(a + 7, "--reps");
+        } else if (strncmp(a, "--nbins=", 8) == 0) {
+            p->nbins = (int)parse_long(a + 8, "--nbins");
+        } else if (strncmp(a, "--alpha=", 8) == 0) {
+            p->alpha = atof(a + 8);
+        } else if (strncmp(a, "--beta=", 7) == 0) {
+            p->beta = atof(a + 7);
+        } else if (strncmp(a, "--dt=", 5) == 0) {
+            p->dt = atof(a + 5);
+        } else if (strncmp(a, "--seed=", 7) == 0) {
+            p->seed = strtoull(a + 7, NULL, 10);
+        } else if (strcmp(a, "--check") == 0) {
+            p->check = 1;
+        } else if (strcmp(a, "--verbose") == 0) {
+            p->verbose = 1;
+        } else if (strcmp(a, "--help") == 0) {
+            printf("usage: %s [--device=serial|omp|tpu] [--n=N] [--m=M] "
+                   "[--k=K] [--z=Z] [--iters=I] [--reps=R] [--nbins=B] "
+                   "[--alpha=A] [--beta=B] [--dt=DT] [--seed=S] [--check] "
+                   "[--verbose]\n",
+                   kernel_name);
+            exit(0);
+        } else {
+            fprintf(stderr, "%s: unknown flag %s (try --help)\n", kernel_name,
+                    a);
+            exit(2);
+        }
+    }
+}
+
+double bench_now_sec(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+/* splitmix64: tiny, seedable, identical stream everywhere. */
+static inline uint64_t splitmix64(uint64_t *state) {
+    uint64_t z = (*state += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+}
+
+void bench_fill_f32(float *dst, size_t n, unsigned long long seed) {
+    uint64_t s = seed;
+    for (size_t i = 0; i < n; i++) {
+        /* top 24 bits → [0,1) → [-1,1) */
+        uint64_t r = splitmix64(&s) >> 40;
+        dst[i] = (float)((double)r / (double)(1ull << 24) * 2.0 - 1.0);
+    }
+}
+
+void bench_fill_u32(uint32_t *dst, size_t n, uint32_t bound,
+                    unsigned long long seed) {
+    uint64_t s = seed;
+    for (size_t i = 0; i < n; i++) {
+        dst[i] = (uint32_t)(splitmix64(&s) % bound);
+    }
+}
+
+size_t bench_check_f32(const float *got, const float *want, size_t n,
+                       double rtol, double atol, double *max_err) {
+    size_t bad = 0;
+    double worst = 0.0;
+    for (size_t i = 0; i < n; i++) {
+        double g = got[i], w = want[i];
+        double err = fabs(g - w);
+        if (err > worst) worst = err;
+        if (!(err <= atol + rtol * fabs(w))) bad++;
+    }
+    if (max_err) *max_err = worst;
+    return bad;
+}
+
+size_t bench_check_u64(const uint64_t *got, const uint64_t *want, size_t n) {
+    size_t bad = 0;
+    for (size_t i = 0; i < n; i++)
+        if (got[i] != want[i]) bad++;
+    return bad;
+}
+
+int bench_report_check(const char *kernel, size_t mismatches, size_t n,
+                       double max_err) {
+    if (mismatches == 0) {
+        printf("kernel=%s CHECK PASS (n=%zu max_err=%.3e)\n", kernel, n,
+               max_err);
+        return 0;
+    }
+    printf("kernel=%s CHECK FAIL (%zu/%zu mismatches, max_err=%.3e)\n", kernel,
+           mismatches, n, max_err);
+    return 1;
+}
+
+void bench_report_metric(const char *kernel, const char *device, long n,
+                         double seconds, const char *metric, double value,
+                         const char *unit) {
+    printf("kernel=%s device=%s n=%ld time_ms=%.3f metric=%s value=%.3f "
+           "unit=%s\n",
+           kernel, device, n, seconds * 1e3, metric, value, unit);
+    fflush(stdout);
+}

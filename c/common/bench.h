@@ -1,0 +1,73 @@
+/* Benchmark driver common layer (SURVEY.md C1, C2, C12).
+ *
+ * The reference tree was empty at survey time, so this layer is a
+ * clean-room reconstruction of the canonical shape described in
+ * SURVEY.md §1–§3: per-kernel driver binaries owning flag parsing,
+ * seeded input init, a warm-up + monotonic-clock timing loop, metric
+ * computation, and a golden-output correctness check in which the
+ * serial variant is the oracle.
+ */
+#ifndef TPK_BENCH_H
+#define TPK_BENCH_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- generic per-run parameters, shared by every kernel driver ---- */
+typedef struct {
+    long   n;        /* primary problem size (elements / matrix dim / bodies) */
+    long   m, k;     /* extra dims (sgemm), grid dims (stencil)               */
+    long   z;        /* third stencil dim (3D)                                */
+    long   iters;    /* inner iterations (stencil sweeps, nbody steps)        */
+    int    reps;     /* timed repetitions                                     */
+    int    check;    /* run golden-output check                               */
+    int    verbose;
+    int    nbins;    /* histogram bins                                        */
+    double alpha, beta;
+    double dt;       /* nbody timestep                                        */
+    char   device[32];
+    unsigned long long seed;
+} bench_params_t;
+
+void bench_params_default(bench_params_t *p);
+
+/* Parse the common flags (--device=, --n=, --m=, --k=, --z=, --iters=,
+ * --reps=, --check, --alpha=, --beta=, --nbins=, --dt=, --seed=,
+ * --verbose). Unknown flags abort with usage. */
+void bench_parse_args(bench_params_t *p, int argc, char **argv,
+                      const char *kernel_name);
+
+/* ---- timing (C12): monotonic wall clock ---- */
+double bench_now_sec(void);
+
+/* ---- seeded deterministic init (same stream on every backend) ---- */
+/* splitmix64-based uniform floats in [-1, 1). */
+void bench_fill_f32(float *dst, size_t n, unsigned long long seed);
+void bench_fill_u32(uint32_t *dst, size_t n, uint32_t bound,
+                    unsigned long long seed);
+
+/* ---- golden checker (C2) ---- */
+/* Elementwise |a-b| <= atol + rtol*|b|; returns number of mismatches
+ * and writes the worst absolute error to *max_err if non-NULL. */
+size_t bench_check_f32(const float *got, const float *want, size_t n,
+                       double rtol, double atol, double *max_err);
+size_t bench_check_u64(const uint64_t *got, const uint64_t *want, size_t n);
+
+/* Prints "CHECK PASS"/"CHECK FAIL ..." and returns 0 on pass. */
+int bench_report_check(const char *kernel, size_t mismatches, size_t n,
+                       double max_err);
+
+/* ---- metric reporting (frozen printf format; SURVEY.md §5) ---- */
+/* kernel=<k> device=<d> n=<n> time_ms=<t> metric=<name> value=<v> unit=<u> */
+void bench_report_metric(const char *kernel, const char *device, long n,
+                         double seconds, const char *metric, double value,
+                         const char *unit);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* TPK_BENCH_H */

@@ -1,0 +1,17 @@
+#include "dispatch.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+tpk_kern_fn tpk_dispatch_lookup(const tpk_dispatch_entry *table,
+                                const char *device, const char *kernel) {
+    for (const tpk_dispatch_entry *e = table; e->device; e++) {
+        if (strcmp(e->device, device) == 0) return e->fn;
+    }
+    fprintf(stderr, "%s: unknown device '%s'; known:", kernel, device);
+    for (const tpk_dispatch_entry *e = table; e->device; e++)
+        fprintf(stderr, " %s", e->device);
+    fprintf(stderr, "\n");
+    exit(2);
+}

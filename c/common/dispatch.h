@@ -1,0 +1,34 @@
+/* Per-kernel dispatch table (SURVEY.md C3): the load-bearing seam.
+ *
+ * Each driver binary declares a static table of
+ * {device-name -> kernel function} rows; the TPU backend is just one
+ * more row whose function forwards through the shim (C10). Adding a
+ * backend never touches the driver's timing loop or checker.
+ */
+#ifndef TPK_DISPATCH_H
+#define TPK_DISPATCH_H
+
+#include "bench.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* A kernel variant: operates in place on the driver-owned buffers.
+ * Returns 0 on success. */
+typedef int (*tpk_kern_fn)(const bench_params_t *p, void **bufs);
+
+typedef struct {
+    const char *device;
+    tpk_kern_fn fn;
+} tpk_dispatch_entry;
+
+/* Linear lookup; table is terminated by a {NULL, NULL} row.
+ * Exits with a clear message listing known devices when not found. */
+tpk_kern_fn tpk_dispatch_lookup(const tpk_dispatch_entry *table,
+                                const char *device, const char *kernel);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* TPK_DISPATCH_H */

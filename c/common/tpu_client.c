@@ -1,0 +1,68 @@
+#define _GNU_SOURCE
+#include "tpu_client.h"
+
+#include <dlfcn.h>
+#include <limits.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+typedef int (*tpu_init_fn)(void);
+typedef int (*tpu_run_fn)(const char *, const char *, void **, int);
+
+static tpu_run_fn g_run = NULL;
+
+static void *try_open(const char *path) {
+    void *h = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+    return h;
+}
+
+void tpk_tpu_ensure(void) {
+    if (g_run) return;
+
+    void *h = NULL;
+    const char *override = getenv("TPU_KERNELS_SHIM");
+    if (override && override[0]) h = try_open(override);
+    if (!h) h = try_open("libtpukernels.so");
+    if (!h) {
+        /* next to the binary (c/bin/) */
+        char exe[PATH_MAX];
+        ssize_t len = readlink("/proc/self/exe", exe, sizeof(exe) - 1);
+        if (len > 0) {
+            exe[len] = '\0';
+            char *slash = strrchr(exe, '/');
+            if (slash) {
+                *slash = '\0';
+                char path[PATH_MAX + 32];
+                snprintf(path, sizeof(path), "%s/libtpukernels.so", exe);
+                h = try_open(path);
+            }
+        }
+    }
+    if (!h) {
+        fprintf(stderr,
+                "tpu backend unavailable: cannot load libtpukernels.so (%s)\n"
+                "build it with `make -C c` or point TPU_KERNELS_SHIM at it\n",
+                dlerror());
+        exit(2);
+    }
+
+    tpu_init_fn init = (tpu_init_fn)dlsym(h, "tpu_init");
+    g_run = (tpu_run_fn)dlsym(h, "tpu_run");
+    if (!init || !g_run) {
+        fprintf(stderr, "libtpukernels.so is missing tpu_init/tpu_run: %s\n",
+                dlerror());
+        exit(2);
+    }
+    if (init() != 0) {
+        fprintf(stderr, "tpu_init failed\n");
+        exit(2);
+    }
+}
+
+int tpk_tpu_run(const char *kernel, const char *params_json, void **bufs,
+                int nbufs) {
+    if (!g_run) tpk_tpu_ensure();
+    return g_run(kernel, params_json, bufs, nbufs);
+}

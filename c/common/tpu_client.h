@@ -1,0 +1,26 @@
+/* Lazy dlopen client for libtpukernels.so.
+ *
+ * Driver binaries stay free of libpython: the shim is only loaded when
+ * --device=tpu is actually selected (mirrors how the reference's CUDA
+ * variants isolate the CUDA runtime in .cu objects; SURVEY.md C10).
+ */
+#ifndef TPK_TPU_CLIENT_H
+#define TPK_TPU_CLIENT_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Load the shim and initialize the embedded interpreter. Exits with a
+ * diagnostic on failure (a missing backend is a configuration error,
+ * matching the driver's behavior for unknown --device=). */
+void tpk_tpu_ensure(void);
+
+/* Forward to tpu_run in the shim. tpk_tpu_ensure must have returned. */
+int tpk_tpu_run(const char *kernel, const char *params_json, void **bufs,
+                int nbufs);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* TPK_TPU_CLIENT_H */

@@ -1,0 +1,39 @@
+#!/bin/sh
+# C acceptance gate (SURVEY.md §4): run every built (kernel x device)
+# pair at a small problem size and require CHECK PASS from each.
+# Set TPK_TEST_TPU=1 to include the tpu rows (needs a TPU attached).
+set -e
+cd "$(dirname "$0")"
+
+devices="serial omp"
+if [ "${TPK_TEST_TPU:-0}" = "1" ]; then
+  devices="$devices tpu"
+fi
+
+fail=0
+run() {
+  # $1 binary, rest: args
+  bin="bin/$1"; shift
+  [ -x "$bin" ] || return 0
+  for dev in $devices; do
+    echo "== $bin --device=$dev $*"
+    if ! "$bin" --device="$dev" --check --reps=1 "$@"; then
+      echo "FAILED: $bin --device=$dev"
+      fail=1
+    fi
+  done
+}
+
+run vector_add --n=100000
+run sgemm --n=256
+run stencil --n=256 --iters=10
+run stencil --n=64 --z=64 --iters=5
+run scan_histogram --n=100000
+run nbody --n=1024 --iters=2
+run allreduce_bench --n=1048576
+
+if [ "$fail" = "1" ]; then
+  echo "ACCEPTANCE: FAIL"
+  exit 1
+fi
+echo "ACCEPTANCE: PASS"

@@ -1,0 +1,115 @@
+/* libtpukernels.so — embeds CPython and forwards kernel invocations to
+ * the tpukernels Python package (SURVEY.md C10; north-star: "a thin
+ * ctypes shim" seen from the C side of the ABI).
+ */
+#include "tpu_shim.h"
+
+#include <Python.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#ifndef TPK_DEFAULT_ROOT
+#define TPK_DEFAULT_ROOT "."
+#endif
+#ifndef TPK_SITE_PACKAGES
+#define TPK_SITE_PACKAGES ""
+#endif
+
+static PyObject *g_run_from_c = NULL; /* tpukernels.capi.run_from_c */
+static int g_initialized = 0;
+
+static int verbose(void) {
+    const char *v = getenv("TPU_KERNELS_VERBOSE");
+    return v && v[0] && strcmp(v, "0") != 0;
+}
+
+int tpu_init(void) {
+    if (g_initialized) return 0;
+
+    if (!Py_IsInitialized()) {
+        PyConfig config;
+        PyConfig_InitPythonConfig(&config);
+        /* Leave stdout/stderr and signal handling to the C host. */
+        config.install_signal_handlers = 0;
+        PyStatus status = Py_InitializeFromConfig(&config);
+        PyConfig_Clear(&config);
+        if (PyStatus_Exception(status)) {
+            fprintf(stderr, "tpu_shim: Py_InitializeFromConfig failed\n");
+            return 1;
+        }
+    }
+
+    /* Make the kernel package and the venv's site-packages importable.
+     * Overridable at runtime; defaults baked in by the Makefile. */
+    const char *root = getenv("TPU_KERNELS_ROOT");
+    if (!root || !root[0]) root = TPK_DEFAULT_ROOT;
+    const char *site = getenv("TPU_KERNELS_SITE");
+    if (!site || !site[0]) site = TPK_SITE_PACKAGES;
+
+    char buf[2048];
+    snprintf(buf, sizeof(buf),
+             "import sys\n"
+             "for _p in (r'%s', r'%s'):\n"
+             "    if _p and _p not in sys.path:\n"
+             "        sys.path.insert(0, _p)\n",
+             site, root);
+    if (PyRun_SimpleString(buf) != 0) {
+        fprintf(stderr, "tpu_shim: failed to extend sys.path\n");
+        return 1;
+    }
+
+    PyObject *mod = PyImport_ImportModule("tpukernels.capi");
+    if (!mod) {
+        PyErr_Print();
+        fprintf(stderr, "tpu_shim: cannot import tpukernels.capi "
+                        "(TPU_KERNELS_ROOT=%s)\n",
+                root);
+        return 1;
+    }
+    g_run_from_c = PyObject_GetAttrString(mod, "run_from_c");
+    Py_DECREF(mod);
+    if (!g_run_from_c || !PyCallable_Check(g_run_from_c)) {
+        PyErr_Print();
+        fprintf(stderr, "tpu_shim: tpukernels.capi.run_from_c missing\n");
+        return 1;
+    }
+    g_initialized = 1;
+    if (verbose()) fprintf(stderr, "tpu_shim: initialized (root=%s)\n", root);
+    return 0;
+}
+
+int tpu_run(const char *name, const char *params_json, void **bufs,
+            int nbufs) {
+    if (!g_initialized && tpu_init() != 0) return 1;
+
+    PyObject *addrs = PyList_New(nbufs);
+    if (!addrs) return 1;
+    for (int i = 0; i < nbufs; i++) {
+        PyList_SET_ITEM(addrs, i,
+                        PyLong_FromUnsignedLongLong((unsigned long long)(uintptr_t)bufs[i]));
+    }
+    PyObject *res =
+        PyObject_CallFunction(g_run_from_c, "ssO", name, params_json, addrs);
+    Py_DECREF(addrs);
+    if (!res) {
+        PyErr_Print();
+        fprintf(stderr, "tpu_shim: kernel '%s' raised\n", name);
+        return 1;
+    }
+    long rc = PyLong_AsLong(res);
+    Py_DECREF(res);
+    if (rc == -1 && PyErr_Occurred()) {
+        PyErr_Print();
+        return 1;
+    }
+    return (int)rc;
+}
+
+void tpu_shutdown(void) {
+    /* Intentionally do NOT Py_FinalizeEx: PJRT/runtime threads may
+     * still be alive and finalization ordering with the TPU plugin is
+     * undefined (SURVEY.md §7 "hard parts"). The OS reclaims
+     * everything at exit. */
+    if (verbose()) fprintf(stderr, "tpu_shim: shutdown (noop)\n");
+}

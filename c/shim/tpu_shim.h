@@ -1,0 +1,35 @@
+/* C ABI of libtpukernels.so — the C→TPU bridge (SURVEY.md C10).
+ *
+ * The benchmark driver hands raw host buffers across this boundary;
+ * the shim (which embeds CPython 3.12) wraps them as numpy arrays,
+ * dispatches the named kernel through tpukernels.capi → registry →
+ * JAX/Pallas → PJRT → TPU, blocks until device completion, and copies
+ * results back into the driver's buffers before returning — so the
+ * driver's wall-clock timing of tpu_run() is honest (includes H2D/D2H,
+ * excludes nothing), symmetric with a CUDA variant timing
+ * memcpy+kernel+sync.
+ */
+#ifndef TPK_TPU_SHIM_H
+#define TPK_TPU_SHIM_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Initialize the embedded interpreter and import tpukernels.
+ * Idempotent. Returns 0 on success. */
+int tpu_init(void);
+
+/* Run kernel `name`. `params_json` describes buffer shapes/dtypes/roles
+ * and scalar parameters; `bufs` are the raw host pointers in the same
+ * order as the JSON "buffers" list. Returns 0 on success. */
+int tpu_run(const char *name, const char *params_json, void **bufs,
+            int nbufs);
+
+/* Finalize the interpreter (optional; safe to skip at exit). */
+void tpu_shutdown(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* TPK_TPU_SHIM_H */

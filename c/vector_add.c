@@ -1,0 +1,112 @@
+/* vector_add benchmark driver (SURVEY.md C1+C4): SAXPY y = alpha*x + y.
+ *
+ * Config of record: N = 2^20 float32 (BASELINE.json configs[0]).
+ * Metric: effective bandwidth GB/s = 3*4*N bytes / t (read x, read y,
+ * write y). The serial variant is the golden oracle (C2).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "common/bench.h"
+#include "common/dispatch.h"
+#include "common/tpu_client.h"
+
+/* ---- variants (C4) ---- */
+
+static int saxpy_serial(const bench_params_t *p, void **bufs) {
+    const float *x = (const float *)bufs[0];
+    float *y = (float *)bufs[1];
+    const float a = (float)p->alpha;
+    for (long i = 0; i < p->n; i++) y[i] = a * x[i] + y[i];
+    return 0;
+}
+
+static int saxpy_omp(const bench_params_t *p, void **bufs) {
+    const float *x = (const float *)bufs[0];
+    float *y = (float *)bufs[1];
+    const float a = (float)p->alpha;
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < p->n; i++) y[i] = a * x[i] + y[i];
+    return 0;
+}
+
+static int saxpy_tpu(const bench_params_t *p, void **bufs) {
+    char json[256];
+    snprintf(json, sizeof(json),
+             "{\"alpha\":%.17g,\"buffers\":["
+             "{\"shape\":[%ld],\"dtype\":\"f32\"},"
+             "{\"shape\":[%ld],\"dtype\":\"f32\"}]}",
+             p->alpha, p->n, p->n);
+    return tpk_tpu_run("vector_add", json, bufs, 2);
+}
+
+static const tpk_dispatch_entry TABLE[] = {
+    {"serial", saxpy_serial},
+    {"omp", saxpy_omp},
+    {"tpu", saxpy_tpu},
+    {NULL, NULL},
+};
+
+int main(int argc, char **argv) {
+    bench_params_t p;
+    bench_params_default(&p);
+    bench_parse_args(&p, argc, argv, "vector_add");
+
+    tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "vector_add");
+    if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();
+
+    const size_t n = (size_t)p.n;
+    float *x = malloc(n * sizeof(float));
+    float *y = malloc(n * sizeof(float));
+    float *y_run = malloc(n * sizeof(float));
+    if (!x || !y || !y_run) {
+        fprintf(stderr, "alloc failed\n");
+        return 1;
+    }
+    bench_fill_f32(x, n, p.seed);
+    bench_fill_f32(y, n, p.seed ^ 0x9E3779B97F4A7C15ull);
+
+    int rc = 0;
+    if (p.check) {
+        /* golden: serial run on a fresh copy (C2) */
+        float *y_gold = malloc(n * sizeof(float));
+        memcpy(y_gold, y, n * sizeof(float));
+        void *gold_bufs[2] = {x, y_gold};
+        saxpy_serial(&p, gold_bufs);
+
+        memcpy(y_run, y, n * sizeof(float));
+        void *run_bufs[2] = {x, y_run};
+        if (fn(&p, run_bufs) != 0) {
+            fprintf(stderr, "kernel failed\n");
+            return 1;
+        }
+        double max_err;
+        size_t bad =
+            bench_check_f32(y_run, y_gold, n, 1e-5, 1e-6, &max_err);
+        rc = bench_report_check("vector_add", bad, n, max_err);
+        free(y_gold);
+        if (rc) return rc;
+    }
+
+    /* timing: warm-up excluded (absorbs JIT compile on tpu), reps timed
+     * individually, best-of reported (C1/C12) */
+    memcpy(y_run, y, n * sizeof(float));
+    void *bufs[2] = {x, y_run};
+    fn(&p, bufs); /* warm-up */
+    double best = 1e30;
+    for (int r = 0; r < p.reps; r++) {
+        double t0 = bench_now_sec();
+        fn(&p, bufs);
+        double t1 = bench_now_sec();
+        if (t1 - t0 < best) best = t1 - t0;
+    }
+    double gbps = 3.0 * 4.0 * (double)n / best / 1e9;
+    bench_report_metric("vector_add", p.device, p.n, best, "bandwidth", gbps,
+                        "GB/s");
+
+    free(x);
+    free(y);
+    free(y_run);
+    return rc;
+}

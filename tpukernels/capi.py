@@ -1,0 +1,99 @@
+"""Marshalling layer the C shim imports (SURVEY.md C10, Python side).
+
+The shim passes raw host pointers plus a JSON description of shapes,
+dtypes and scalar parameters. Each adapter wraps the pointers as numpy
+views (zero-copy on the host side), dispatches the jitted kernel,
+blocks until device completion, and copies results back into the
+driver-owned buffers *before returning* — the C timing loop around
+tpu_run() therefore measures H2D + compute + D2H, symmetric with a CUDA
+variant that times memcpy+kernel+sync (SURVEY.md §7 "honest timing").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import math
+
+import numpy as np
+
+_DTYPES = {
+    "f32": np.float32,
+    "f64": np.float64,
+    "i32": np.int32,
+    "u32": np.uint32,
+    "u64": np.uint64,
+}
+
+
+def _wrap(addr: int, spec: dict) -> np.ndarray:
+    dt = np.dtype(_DTYPES[spec["dtype"]])
+    shape = tuple(spec["shape"])
+    nbytes = dt.itemsize * math.prod(shape)
+    raw = (ctypes.c_char * nbytes).from_address(addr)
+    return np.frombuffer(raw, dtype=dt).reshape(shape)
+
+
+def _adapt_vector_add(p, arrs):
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    x, y = arrs
+    out = registry.lookup("vector_add")(
+        p.get("alpha", 1.0), jnp.asarray(x), jnp.asarray(y)
+    )
+    np.copyto(y, np.asarray(out))
+
+
+def _adapt_sgemm(p, arrs):
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    a, b, c = arrs
+    out = registry.lookup("sgemm")(
+        p.get("alpha", 1.0),
+        jnp.asarray(a),
+        jnp.asarray(b),
+        p.get("beta", 0.0),
+        jnp.asarray(c),
+    )
+    np.copyto(c, np.asarray(out))
+
+
+_ADAPTERS = {
+    "vector_add": _adapt_vector_add,
+    "sgemm": _adapt_sgemm,
+}
+
+
+def _register_late_adapters():
+    """Adapters for kernels added in later build steps; tolerate their
+    absence so the walking skeleton works before they exist."""
+    if "stencil2d" not in _ADAPTERS:
+        try:
+            from tpukernels.capi_ext import EXTRA_ADAPTERS
+
+            _ADAPTERS.update(EXTRA_ADAPTERS)
+        except ImportError:
+            pass
+
+
+def run_from_c(kernel: str, params_json: str, addrs) -> int:
+    _register_late_adapters()
+    p = json.loads(params_json)
+    specs = p.get("buffers", [])
+    if len(specs) != len(addrs):
+        raise ValueError(
+            f"{kernel}: {len(addrs)} pointers but {len(specs)} buffer specs"
+        )
+    arrs = [_wrap(int(a), s) for a, s in zip(addrs, specs)]
+    try:
+        fn = _ADAPTERS[kernel]
+    except KeyError:
+        raise KeyError(
+            f"no C adapter for kernel {kernel!r}; known: {sorted(_ADAPTERS)}"
+        ) from None
+    fn(p, arrs)
+    return 0
