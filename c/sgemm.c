@@ -1,0 +1,158 @@
+/* sgemm benchmark driver (SURVEY.md C1+C5): C = alpha*A@B + beta*C.
+ *
+ * Config of record: 1024x1024x1024 float32 (BASELINE.json configs[1]).
+ * Metric of record: GFLOPS = 2*M*N*K / t (BASELINE.md). The serial ijk
+ * variant is the golden oracle; the omp variant is cache-tiled.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "common/bench.h"
+#include "common/dispatch.h"
+#include "common/tpu_client.h"
+
+/* bufs = {A (MxK, in), B (KxN, in), C (MxN, inout)} */
+
+static void dims(const bench_params_t *p, long *M, long *N, long *K) {
+    *M = p->m > 0 ? p->m : p->n;
+    *N = p->n;
+    *K = p->k > 0 ? p->k : p->n;
+}
+
+static int sgemm_serial(const bench_params_t *p, void **bufs) {
+    long M, N, K;
+    dims(p, &M, &N, &K);
+    const float *A = bufs[0], *B = bufs[1];
+    float *C = bufs[2];
+    const float alpha = (float)p->alpha, beta = (float)p->beta;
+    for (long i = 0; i < M; i++) {
+        for (long j = 0; j < N; j++) {
+            /* double accumulator: the golden should be the most
+             * accurate variant, not just the slowest */
+            double acc = 0.0;
+            for (long k = 0; k < K; k++)
+                acc += (double)A[i * K + k] * (double)B[k * N + j];
+            C[i * N + j] = alpha * (float)acc + beta * C[i * N + j];
+        }
+    }
+    return 0;
+}
+
+#define TILE 64
+static int sgemm_omp(const bench_params_t *p, void **bufs) {
+    long M, N, K;
+    dims(p, &M, &N, &K);
+    const float *A = bufs[0], *B = bufs[1];
+    float *C = bufs[2];
+    const float alpha = (float)p->alpha, beta = (float)p->beta;
+#pragma omp parallel for collapse(2) schedule(static)
+    for (long ii = 0; ii < M; ii += TILE) {
+        for (long jj = 0; jj < N; jj += TILE) {
+            long iend = ii + TILE < M ? ii + TILE : M;
+            long jend = jj + TILE < N ? jj + TILE : N;
+            for (long i = ii; i < iend; i++)
+                for (long j = jj; j < jend; j++)
+                    C[i * N + j] *= beta;
+            for (long kk = 0; kk < K; kk += TILE) {
+                long kend = kk + TILE < K ? kk + TILE : K;
+                for (long i = ii; i < iend; i++) {
+                    for (long k = kk; k < kend; k++) {
+                        float a = alpha * A[i * K + k];
+                        for (long j = jj; j < jend; j++)
+                            C[i * N + j] += a * B[k * N + j];
+                    }
+                }
+            }
+        }
+    }
+    return 0;
+}
+
+static int sgemm_tpu(const bench_params_t *p, void **bufs) {
+    long M, N, K;
+    dims(p, &M, &N, &K);
+    char json[512];
+    snprintf(json, sizeof(json),
+             "{\"alpha\":%.17g,\"beta\":%.17g,\"buffers\":["
+             "{\"shape\":[%ld,%ld],\"dtype\":\"f32\"},"
+             "{\"shape\":[%ld,%ld],\"dtype\":\"f32\"},"
+             "{\"shape\":[%ld,%ld],\"dtype\":\"f32\"}]}",
+             p->alpha, p->beta, M, K, K, N, M, N);
+    return tpk_tpu_run("sgemm", json, bufs, 3);
+}
+
+static const tpk_dispatch_entry TABLE[] = {
+    {"serial", sgemm_serial},
+    {"omp", sgemm_omp},
+    {"tpu", sgemm_tpu},
+    {NULL, NULL},
+};
+
+int main(int argc, char **argv) {
+    bench_params_t p;
+    bench_params_default(&p);
+    p.n = 1024;
+    bench_parse_args(&p, argc, argv, "sgemm");
+
+    tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "sgemm");
+    if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();
+
+    long M, N, K;
+    dims(&p, &M, &N, &K);
+    float *A = malloc((size_t)M * K * sizeof(float));
+    float *B = malloc((size_t)K * N * sizeof(float));
+    float *C = malloc((size_t)M * N * sizeof(float));
+    float *C_run = malloc((size_t)M * N * sizeof(float));
+    if (!A || !B || !C || !C_run) {
+        fprintf(stderr, "alloc failed\n");
+        return 1;
+    }
+    bench_fill_f32(A, (size_t)M * K, p.seed);
+    bench_fill_f32(B, (size_t)K * N, p.seed ^ 0xA5A5A5A5ull);
+    bench_fill_f32(C, (size_t)M * N, p.seed ^ 0x5A5A5A5Aull);
+
+    int rc = 0;
+    if (p.check) {
+        float *C_gold = malloc((size_t)M * N * sizeof(float));
+        memcpy(C_gold, C, (size_t)M * N * sizeof(float));
+        void *gold_bufs[3] = {A, B, C_gold};
+        sgemm_serial(&p, gold_bufs);
+
+        memcpy(C_run, C, (size_t)M * N * sizeof(float));
+        void *run_bufs[3] = {A, B, C_run};
+        if (fn(&p, run_bufs) != 0) {
+            fprintf(stderr, "kernel failed\n");
+            return 1;
+        }
+        /* fp32 K-length accumulation differs per backend: rel tol
+         * scales with sqrt(K)*eps (SURVEY.md §4) */
+        double rtol = 1e-4, atol = 1e-3;
+        double max_err;
+        size_t bad = bench_check_f32(C_run, C_gold, (size_t)M * N, rtol,
+                                     atol, &max_err);
+        rc = bench_report_check("sgemm", bad, (size_t)M * N, max_err);
+        free(C_gold);
+        if (rc) return rc;
+    }
+
+    memcpy(C_run, C, (size_t)M * N * sizeof(float));
+    void *bufs[3] = {A, B, C_run};
+    fn(&p, bufs); /* warm-up */
+    double best = 1e30;
+    for (int r = 0; r < p.reps; r++) {
+        double t0 = bench_now_sec();
+        fn(&p, bufs);
+        double t1 = bench_now_sec();
+        if (t1 - t0 < best) best = t1 - t0;
+    }
+    double gflops = 2.0 * (double)M * N * K / best / 1e9;
+    bench_report_metric("sgemm", p.device, p.n, best, "gflops", gflops,
+                        "GFLOPS");
+
+    free(A);
+    free(B);
+    free(C);
+    free(C_run);
+    return rc;
+}

@@ -1,0 +1,202 @@
+/* stencil benchmark driver (SURVEY.md C1+C6): Jacobi relaxation.
+ *
+ * 2D 5-point (default) or 3D 7-point (--z=D). Config of record:
+ * 4096^2, 1000 iters (BASELINE.json configs[2]). Metric of record:
+ * Mcells/sec = X*Y(*Z)*iters / t. Interior cells become the mean of
+ * their face neighbors; boundary cells are held fixed (Dirichlet).
+ * Double-buffered sweeps; the serial variant is the golden oracle.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "common/bench.h"
+#include "common/dispatch.h"
+#include "common/tpu_client.h"
+
+/* bufs = {x (inout)}; 2D: (n rows x m cols); 3D: (z, n, m) */
+
+static void dims(const bench_params_t *p, long *H, long *W, long *D) {
+    *H = p->n;
+    *W = p->m > 0 ? p->m : p->n;
+    *D = p->z; /* 0 → 2D */
+}
+
+/* one 2D sweep src→dst */
+static void sweep2d(const float *src, float *dst, long H, long W) {
+    for (long i = 0; i < H; i++) {
+        for (long j = 0; j < W; j++) {
+            if (i == 0 || i == H - 1 || j == 0 || j == W - 1) {
+                dst[i * W + j] = src[i * W + j];
+            } else {
+                dst[i * W + j] = 0.25f * (src[(i - 1) * W + j] +
+                                          src[(i + 1) * W + j] +
+                                          src[i * W + j - 1] +
+                                          src[i * W + j + 1]);
+            }
+        }
+    }
+}
+
+static void sweep2d_omp(const float *src, float *dst, long H, long W) {
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < H; i++) {
+        for (long j = 0; j < W; j++) {
+            if (i == 0 || i == H - 1 || j == 0 || j == W - 1) {
+                dst[i * W + j] = src[i * W + j];
+            } else {
+                dst[i * W + j] = 0.25f * (src[(i - 1) * W + j] +
+                                          src[(i + 1) * W + j] +
+                                          src[i * W + j - 1] +
+                                          src[i * W + j + 1]);
+            }
+        }
+    }
+}
+
+static void sweep3d(const float *src, float *dst, long D, long H, long W,
+                    int omp) {
+    const float c = 1.0f / 6.0f;
+#pragma omp parallel for collapse(2) schedule(static) if (omp)
+    for (long z = 0; z < D; z++) {
+        for (long i = 0; i < H; i++) {
+            for (long j = 0; j < W; j++) {
+                size_t idx = ((size_t)z * H + i) * W + j;
+                if (z == 0 || z == D - 1 || i == 0 || i == H - 1 ||
+                    j == 0 || j == W - 1) {
+                    dst[idx] = src[idx];
+                } else {
+                    dst[idx] = c * (src[idx - (size_t)H * W] +
+                                    src[idx + (size_t)H * W] +
+                                    src[idx - W] + src[idx + W] +
+                                    src[idx - 1] + src[idx + 1]);
+                }
+            }
+        }
+    }
+}
+
+static size_t total_cells(const bench_params_t *p) {
+    long H, W, D;
+    dims(p, &H, &W, &D);
+    return (size_t)(D > 0 ? D : 1) * H * W;
+}
+
+static int jacobi_host(const bench_params_t *p, void **bufs, int omp) {
+    long H, W, D;
+    dims(p, &H, &W, &D);
+    size_t cells = total_cells(p);
+    float *x = bufs[0];
+    float *tmp = malloc(cells * sizeof(float));
+    if (!tmp) return 1;
+    float *src = x, *dst = tmp;
+    for (long t = 0; t < p->iters; t++) {
+        if (D > 0)
+            sweep3d(src, dst, D, H, W, omp);
+        else if (omp)
+            sweep2d_omp(src, dst, H, W);
+        else
+            sweep2d(src, dst, H, W);
+        float *s = src;
+        src = dst;
+        dst = s;
+    }
+    if (src != x) memcpy(x, src, cells * sizeof(float));
+    free(tmp);
+    return 0;
+}
+
+static int jacobi_serial(const bench_params_t *p, void **bufs) {
+    return jacobi_host(p, bufs, 0);
+}
+static int jacobi_omp(const bench_params_t *p, void **bufs) {
+    return jacobi_host(p, bufs, 1);
+}
+
+static int jacobi_tpu(const bench_params_t *p, void **bufs) {
+    long H, W, D;
+    dims(p, &H, &W, &D);
+    char json[512];
+    if (D > 0) {
+        snprintf(json, sizeof(json),
+                 "{\"iters\":%ld,\"buffers\":["
+                 "{\"shape\":[%ld,%ld,%ld],\"dtype\":\"f32\"}]}",
+                 p->iters, D, H, W);
+        return tpk_tpu_run("stencil3d", json, bufs, 1);
+    }
+    snprintf(json, sizeof(json),
+             "{\"iters\":%ld,\"buffers\":["
+             "{\"shape\":[%ld,%ld],\"dtype\":\"f32\"}]}",
+             p->iters, H, W);
+    return tpk_tpu_run("stencil2d", json, bufs, 1);
+}
+
+static const tpk_dispatch_entry TABLE[] = {
+    {"serial", jacobi_serial},
+    {"omp", jacobi_omp},
+    {"tpu", jacobi_tpu},
+    {NULL, NULL},
+};
+
+int main(int argc, char **argv) {
+    bench_params_t p;
+    bench_params_default(&p);
+    p.n = 4096;
+    p.iters = 1000;
+    bench_parse_args(&p, argc, argv, "stencil");
+
+    tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "stencil");
+    if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();
+
+    size_t cells = total_cells(&p);
+    float *x0 = malloc(cells * sizeof(float));
+    float *x_run = malloc(cells * sizeof(float));
+    if (!x0 || !x_run) {
+        fprintf(stderr, "alloc failed\n");
+        return 1;
+    }
+    bench_fill_f32(x0, cells, p.seed);
+
+    int rc = 0;
+    if (p.check) {
+        float *x_gold = malloc(cells * sizeof(float));
+        memcpy(x_gold, x0, cells * sizeof(float));
+        void *gold_bufs[1] = {x_gold};
+        jacobi_serial(&p, gold_bufs);
+
+        memcpy(x_run, x0, cells * sizeof(float));
+        void *run_bufs[1] = {x_run};
+        if (fn(&p, run_bufs) != 0) {
+            fprintf(stderr, "kernel failed\n");
+            return 1;
+        }
+        /* Jacobi is a contraction: absolute error shrinks per sweep,
+         * so a tight tolerance holds even for 1000 iters */
+        double max_err;
+        size_t bad = bench_check_f32(x_run, x_gold, cells, 1e-4, 1e-5,
+                                     &max_err);
+        rc = bench_report_check("stencil", bad, cells, max_err);
+        free(x_gold);
+        if (rc) return rc;
+    }
+
+    memcpy(x_run, x0, cells * sizeof(float));
+    void *bufs[1] = {x_run};
+    fn(&p, bufs); /* warm-up (absorbs JIT compile on tpu) */
+    double best = 1e30;
+    for (int r = 0; r < p.reps; r++) {
+        memcpy(x_run, x0, cells * sizeof(float));
+        double t0 = bench_now_sec();
+        fn(&p, bufs);
+        double t1 = bench_now_sec();
+        if (t1 - t0 < best) best = t1 - t0;
+    }
+    double mcells =
+        (double)cells * (double)p.iters / best / 1e6;
+    bench_report_metric("stencil", p.device, p.n, best, "throughput",
+                        mcells, "Mcells/s");
+
+    free(x0);
+    free(x_run);
+    return rc;
+}

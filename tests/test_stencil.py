@@ -1,0 +1,88 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukernels.kernels.stencil import (
+    jacobi2d,
+    jacobi2d_reference,
+    jacobi3d,
+    jacobi3d_reference,
+)
+
+
+def _numpy_jacobi2d(x, iters):
+    x = np.array(x, dtype=np.float64)
+    for _ in range(iters):
+        out = x.copy()
+        out[1:-1, 1:-1] = 0.25 * (
+            x[:-2, 1:-1] + x[2:, 1:-1] + x[1:-1, :-2] + x[1:-1, 2:]
+        )
+        x = out
+    return x
+
+
+@pytest.mark.parametrize("shape,iters", [((64, 128), 3), ((33, 100), 5), ((16, 16), 10)])
+def test_jacobi2d_small(rng, shape, iters):
+    x = jnp.asarray(rng.standard_normal(shape), dtype=jnp.float32)
+    out = jacobi2d(x, iters)
+    ref = _numpy_jacobi2d(np.asarray(x), iters)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_jacobi2d_matches_jnp_reference(rng):
+    x = jnp.asarray(rng.standard_normal((128, 256)), dtype=jnp.float32)
+    out = jacobi2d(x, 4)
+    ref = jacobi2d_reference(x, 4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_jacobi2d_blocked_path(rng):
+    # tall enough to hit the blocked (DMA-slab) kernel: > _BM+2 rows
+    # and > 4 MiB
+    x = jnp.asarray(rng.standard_normal((1024, 1536)), dtype=jnp.float32)
+    out = jacobi2d(x, 2)
+    ref = jacobi2d_reference(x, 2)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def _numpy_jacobi3d(x, iters):
+    x = np.array(x, dtype=np.float64)
+    for _ in range(iters):
+        out = x.copy()
+        out[1:-1, 1:-1, 1:-1] = (
+            x[:-2, 1:-1, 1:-1] + x[2:, 1:-1, 1:-1]
+            + x[1:-1, :-2, 1:-1] + x[1:-1, 2:, 1:-1]
+            + x[1:-1, 1:-1, :-2] + x[1:-1, 1:-1, 2:]
+        ) / 6.0
+        x = out
+    return x
+
+
+@pytest.mark.parametrize("shape,iters", [((8, 16, 128), 3), ((12, 10, 50), 4)])
+def test_jacobi3d_small(rng, shape, iters):
+    x = jnp.asarray(rng.standard_normal(shape), dtype=jnp.float32)
+    out = jacobi3d(x, iters)
+    ref = _numpy_jacobi3d(np.asarray(x), iters)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_jacobi3d_blocked_path(rng):
+    x = jnp.asarray(rng.standard_normal((64, 64, 256)), dtype=jnp.float32)
+    out = jacobi3d(x, 2)
+    ref = jacobi3d_reference(x, 2)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_boundary_held_fixed(rng):
+    x = jnp.asarray(rng.standard_normal((32, 128)), dtype=jnp.float32)
+    out = np.asarray(jacobi2d(x, 7))
+    xn = np.asarray(x)
+    np.testing.assert_array_equal(out[0], xn[0])
+    np.testing.assert_array_equal(out[-1], xn[-1])
+    np.testing.assert_array_equal(out[:, 0], xn[:, 0])
+    np.testing.assert_array_equal(out[:, -1], xn[:, -1])

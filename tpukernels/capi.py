@@ -62,26 +62,35 @@ def _adapt_sgemm(p, arrs):
     np.copyto(c, np.asarray(out))
 
 
+def _adapt_stencil2d(p, arrs):
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    (x,) = arrs
+    out = registry.lookup("stencil2d")(jnp.asarray(x), int(p["iters"]))
+    np.copyto(x, np.asarray(out))
+
+
+def _adapt_stencil3d(p, arrs):
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    (x,) = arrs
+    out = registry.lookup("stencil3d")(jnp.asarray(x), int(p["iters"]))
+    np.copyto(x, np.asarray(out))
+
+
 _ADAPTERS = {
     "vector_add": _adapt_vector_add,
     "sgemm": _adapt_sgemm,
+    "stencil2d": _adapt_stencil2d,
+    "stencil3d": _adapt_stencil3d,
 }
 
 
-def _register_late_adapters():
-    """Adapters for kernels added in later build steps; tolerate their
-    absence so the walking skeleton works before they exist."""
-    if "stencil2d" not in _ADAPTERS:
-        try:
-            from tpukernels.capi_ext import EXTRA_ADAPTERS
-
-            _ADAPTERS.update(EXTRA_ADAPTERS)
-        except ImportError:
-            pass
-
-
 def run_from_c(kernel: str, params_json: str, addrs) -> int:
-    _register_late_adapters()
     p = json.loads(params_json)
     specs = p.get("buffers", [])
     if len(specs) != len(addrs):

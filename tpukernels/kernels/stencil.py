@@ -1,0 +1,339 @@
+"""Jacobi stencils: 2D 5-point and 3D 7-point (SURVEY.md C6).
+
+Reference config: 2D 4096^2, 1000 iters (BASELINE.json configs[2]);
+metric Mcells/sec = X*Y(*Z)*iters / t. Update rule (fixed by the
+serial-C oracle in c/stencil.c): interior cells become the mean of
+their face neighbors (0.25 in 2D, 1/6 in 3D); boundary cells are held
+fixed (Dirichlet).
+
+TPU design — two Pallas paths chosen by problem size:
+
+* small: whole grid fits in VMEM; neighbor shifts are concatenations
+  (VPU) and one pallas_call performs one sweep.
+* blocked: the grid lives in HBM (`pl.ANY`). The wrapper pads the
+  blocked dimension by one ghost row/plane on each side, so every
+  kernel instance DMAs a (bm+2)-row slab starting at the aligned
+  offset i*bm into VMEM scratch, and all in-kernel slices are static
+  (Mosaic requires sublane offsets provably 8-aligned; dynamic
+  clamped offsets are not). One HBM read per cell per sweep — the
+  bandwidth-optimal pattern (vs. 3x for a three-shifted-inputs
+  formulation).
+
+Ghost cells replicate the boundary cell and the boundary is Dirichlet
+(held fixed), so ghosts stay consistent across iterations by
+construction. The interior mask is always computed against the TRUE
+dims, so padding (ghost rows, lane-alignment columns) never leaks into
+the interior.
+
+Iteration runs under `jax.lax.fori_loop` inside one jit, so XLA
+double-buffers the ping-pong arrays and no host round-trips happen
+between sweeps. Multi-chip variant (row-sharded, ppermute halos) lives
+in tpukernels/parallel/collectives.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpukernels.utils import cdiv, default_interpret
+from tpukernels.utils.shapes import LANES
+
+_SMALL_BYTES = 4 * 1024 * 1024  # whole-grid-in-VMEM threshold
+_VMEM_BUDGET = 10 * 1024 * 1024  # slab + (pipelined) out blocks must fit
+
+
+def _pick_bm(wp: int) -> int:
+    """Rows per 2D block: slab (bm+16, wp) + up to two out blocks
+    (bm, wp) must fit the VMEM budget; multiple of 8."""
+    total_rows = _VMEM_BUDGET // (4 * wp)
+    bm = (total_rows - 2 * _GHOST2D) // 3
+    return max(8, min(512, bm // 8 * 8))
+
+
+def _pick_bz(hp: int, wp: int) -> int:
+    """z-planes per 3D block: slab (bz+2) + two out blocks of bz planes."""
+    total_planes = _VMEM_BUDGET // (4 * hp * wp)
+    bz = (total_planes - 2) // 3
+    return max(1, min(32, bz))
+
+
+def _shift_cols(x, left: bool):
+    """Neighbor values along the lane dim: col j gets col j-1
+    (left=True) or j+1. Edge cols replicate; they are boundary cells
+    and get masked anyway."""
+    if left:
+        return jnp.concatenate([x[:, :1], x[:, :-1]], axis=1)
+    return jnp.concatenate([x[:, 1:], x[:, -1:]], axis=1)
+
+
+# ---------------------------------------------------------------- 2D
+
+def _mask2d(row0, bm, w_blk, h, w, row_offset):
+    """Interior mask for a (bm, w_blk) block whose first row is global
+    padded row `row0`; real row = padded row - row_offset."""
+    gr = row0 - row_offset + jax.lax.broadcasted_iota(
+        jnp.int32, (bm, w_blk), 0
+    )
+    gc = jax.lax.broadcasted_iota(jnp.int32, (bm, w_blk), 1)
+    return (gr > 0) & (gr < h - 1) & (gc > 0) & (gc < w - 1)
+
+
+def _jacobi2d_small_kernel(h, w, x_ref, o_ref):
+    x = x_ref[:]
+    hp, wp = x.shape
+    north = jnp.concatenate([x[:1], x[:-1]], axis=0)
+    south = jnp.concatenate([x[1:], x[-1:]], axis=0)
+    out = 0.25 * (north + south + _shift_cols(x, True) + _shift_cols(x, False))
+    o_ref[:] = jnp.where(_mask2d(0, hp, wp, h, w, 0), out, x)
+
+
+_GHOST2D = 8  # ghost rows each side; 8 so DMA row-counts stay 8-aligned
+
+
+def _jacobi2d_blocked_kernel(h, w, bm, x_hbm, o_ref, slab, sem):
+    # x_hbm has 8 ghost rows above and below (padded height =
+    # Hp + 16). Block i owns padded rows [8 + i*bm, 8 + (i+1)*bm) and
+    # DMAs the slab [i*bm, i*bm + bm + 16): the start offset is
+    # bm-aligned and the row count (bm+16) is a sublane multiple —
+    # both Mosaic requirements. In-VMEM neighbor slices are static.
+    i = pl.program_id(0)
+    g = _GHOST2D
+    wp = slab.shape[1]
+    copy = pltpu.make_async_copy(
+        x_hbm.at[pl.ds(i * bm, bm + 2 * g), :], slab, sem
+    )
+    copy.start()
+    copy.wait()
+    north = slab[g - 1 : g - 1 + bm, :]
+    center = slab[g : g + bm, :]
+    south = slab[g + 1 : g + 1 + bm, :]
+    out = 0.25 * (
+        north + south + _shift_cols(center, True) + _shift_cols(center, False)
+    )
+    o_ref[:] = jnp.where(_mask2d(i * bm + g, bm, wp, h, w, g), out, center)
+
+
+def _sweep2d_small(x, h, w, interpret):
+    hp, wp = x.shape
+    return pl.pallas_call(
+        functools.partial(_jacobi2d_small_kernel, h, w),
+        out_shape=jax.ShapeDtypeStruct((hp, wp), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x)
+
+
+def _sweep2d_blocked(x, h, w, bm, interpret):
+    # x: (Hp + 16, wp) with 8 ghost rows at each end; Hp % bm == 0
+    hp2, wp = x.shape
+    g = _GHOST2D
+    nblk = (hp2 - 2 * g) // bm
+    out = pl.pallas_call(
+        functools.partial(_jacobi2d_blocked_kernel, h, w, bm),
+        out_shape=jax.ShapeDtypeStruct((hp2 - 2 * g, wp), x.dtype),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(
+            (bm, wp), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bm + 2 * g, wp), x.dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(x)
+    # re-attach ghost rows (held fixed) for the next sweep
+    return jnp.concatenate([x[:g], out, x[-g:]], axis=0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("h", "w", "iters", "bm", "interpret")
+)
+def _jacobi2d_jit(x, h, w, iters, bm, interpret):
+    if bm:
+        sweep = lambda v: _sweep2d_blocked(v, h, w, bm, interpret)  # noqa: E731
+    else:
+        sweep = lambda v: _sweep2d_small(v, h, w, interpret)  # noqa: E731
+    return jax.lax.fori_loop(0, iters, lambda _, v: sweep(v), x)
+
+
+def jacobi2d(x, iters: int, interpret: bool | None = None):
+    """Run `iters` Jacobi 5-point sweeps on (H, W) float32."""
+    if interpret is None:
+        interpret = default_interpret()
+    h, w = x.shape
+    wp = max(cdiv(w, LANES) * LANES, LANES)
+    bm = _pick_bm(wp)
+    blocked = h >= bm + 2 and h * wp * 4 > _SMALL_BYTES
+    pads = [(0, 0), (0, wp - w)]
+    if blocked:
+        # 8 ghost rows each side + round rows up to a block multiple
+        g = _GHOST2D
+        pads[0] = (g, g + cdiv(h, bm) * bm - h)
+    x = jnp.pad(x, pads, mode="edge") if pads != [(0, 0), (0, 0)] else x
+    out = _jacobi2d_jit(
+        x, h, w, int(iters), bm if blocked else 0, interpret
+    )
+    if blocked:
+        out = out[_GHOST2D : _GHOST2D + h]
+    return out[:, :w]
+
+
+def jacobi2d_reference(x, iters: int):
+    """jnp oracle mirroring the serial-C golden variant."""
+
+    def sweep(_, v):
+        out = 0.25 * (
+            jnp.roll(v, 1, 0) + jnp.roll(v, -1, 0)
+            + jnp.roll(v, 1, 1) + jnp.roll(v, -1, 1)
+        )
+        h, w = v.shape
+        gr = jax.lax.broadcasted_iota(jnp.int32, (h, w), 0)
+        gc = jax.lax.broadcasted_iota(jnp.int32, (h, w), 1)
+        interior = (gr > 0) & (gr < h - 1) & (gc > 0) & (gc < w - 1)
+        return jnp.where(interior, out, v)
+
+    return jax.lax.fori_loop(0, iters, sweep, x)
+
+
+# ---------------------------------------------------------------- 3D
+
+def _mask3d(z0, bz, h_blk, w_blk, d, h, w, z_offset):
+    gz = z0 - z_offset + jax.lax.broadcasted_iota(
+        jnp.int32, (bz, h_blk, w_blk), 0
+    )
+    gy = jax.lax.broadcasted_iota(jnp.int32, (bz, h_blk, w_blk), 1)
+    gx = jax.lax.broadcasted_iota(jnp.int32, (bz, h_blk, w_blk), 2)
+    return (
+        (gz > 0) & (gz < d - 1)
+        & (gy > 0) & (gy < h - 1)
+        & (gx > 0) & (gx < w - 1)
+    )
+
+
+def _stencil3d_sum(center, zm, zp):
+    ym = jnp.concatenate([center[:, :1], center[:, :-1]], axis=1)
+    yp = jnp.concatenate([center[:, 1:], center[:, -1:]], axis=1)
+    xm = jnp.concatenate([center[:, :, :1], center[:, :, :-1]], axis=2)
+    xp = jnp.concatenate([center[:, :, 1:], center[:, :, -1:]], axis=2)
+    return (zm + zp + ym + yp + xm + xp) * (1.0 / 6.0)
+
+
+def _jacobi3d_small_kernel(d, h, w, x_ref, o_ref):
+    x = x_ref[:]
+    dp, hp, wp = x.shape
+    zm = jnp.concatenate([x[:1], x[:-1]], axis=0)
+    zp = jnp.concatenate([x[1:], x[-1:]], axis=0)
+    out = _stencil3d_sum(x, zm, zp)
+    o_ref[:] = jnp.where(_mask3d(0, dp, hp, wp, d, h, w, 0), out, x)
+
+
+def _jacobi3d_blocked_kernel(d, h, w, bz, x_hbm, o_ref, slab, sem):
+    zi = pl.program_id(0)
+    hp, wp = slab.shape[1], slab.shape[2]
+    copy = pltpu.make_async_copy(x_hbm.at[pl.ds(zi * bz, bz + 2)], slab, sem)
+    copy.start()
+    copy.wait()
+    zm = slab[0:bz]
+    center = slab[1 : bz + 1]
+    zp = slab[2 : bz + 2]
+    out = _stencil3d_sum(center, zm, zp)
+    o_ref[:] = jnp.where(
+        _mask3d(zi * bz + 1, bz, hp, wp, d, h, w, 1), out, center
+    )
+
+
+def _sweep3d_small(x, d, h, w, interpret):
+    dp, hp, wp = x.shape
+    return pl.pallas_call(
+        functools.partial(_jacobi3d_small_kernel, d, h, w),
+        out_shape=jax.ShapeDtypeStruct((dp, hp, wp), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x)
+
+
+def _sweep3d_blocked(x, d, h, w, bz, interpret):
+    dp2, hp, wp = x.shape
+    nblk = (dp2 - 2) // bz
+    out = pl.pallas_call(
+        functools.partial(_jacobi3d_blocked_kernel, d, h, w, bz),
+        out_shape=jax.ShapeDtypeStruct((dp2 - 2, hp, wp), x.dtype),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(
+            (bz, hp, wp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bz + 2, hp, wp), x.dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(x)
+    return jnp.concatenate([x[:1], out, x[-1:]], axis=0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("d", "h", "w", "iters", "bz", "interpret")
+)
+def _jacobi3d_jit(x, d, h, w, iters, bz, interpret):
+    if bz:
+        sweep = lambda v: _sweep3d_blocked(v, d, h, w, bz, interpret)  # noqa: E731
+    else:
+        sweep = lambda v: _sweep3d_small(v, d, h, w, interpret)  # noqa: E731
+    return jax.lax.fori_loop(0, iters, lambda _, v: sweep(v), x)
+
+
+def jacobi3d(x, iters: int, interpret: bool | None = None):
+    """Run `iters` Jacobi 7-point sweeps on (D, H, W) float32."""
+    if interpret is None:
+        interpret = default_interpret()
+    d, h, w = x.shape
+    wp = max(cdiv(w, LANES) * LANES, LANES)
+    hp8 = cdiv(h, 8) * 8
+    bz = _pick_bz(hp8, wp)
+    blocked = d >= bz + 2 and d * h * wp * 4 > _SMALL_BYTES
+    pads = [(0, 0), (0, 0), (0, wp - w)]
+    if blocked:
+        pads[0] = (1, 1 + cdiv(d, bz) * bz - d)
+        # sublane dim (h) must be an 8-multiple for the slab DMA
+        pads[1] = (0, hp8 - h)
+    x = (
+        jnp.pad(x, pads, mode="edge")
+        if pads != [(0, 0), (0, 0), (0, 0)]
+        else x
+    )
+    out = _jacobi3d_jit(
+        x, d, h, w, int(iters), bz if blocked else 0, interpret
+    )
+    if blocked:
+        out = out[1 : 1 + d]
+    return out[:, :h, :w]
+
+
+def jacobi3d_reference(x, iters: int):
+    def sweep(_, v):
+        out = (
+            jnp.roll(v, 1, 0) + jnp.roll(v, -1, 0)
+            + jnp.roll(v, 1, 1) + jnp.roll(v, -1, 1)
+            + jnp.roll(v, 1, 2) + jnp.roll(v, -1, 2)
+        ) * (1.0 / 6.0)
+        d, h, w = v.shape
+        gz = jax.lax.broadcasted_iota(jnp.int32, (d, h, w), 0)
+        gy = jax.lax.broadcasted_iota(jnp.int32, (d, h, w), 1)
+        gx = jax.lax.broadcasted_iota(jnp.int32, (d, h, w), 2)
+        interior = (
+            (gz > 0) & (gz < d - 1)
+            & (gy > 0) & (gy < h - 1)
+            & (gx > 0) & (gx < w - 1)
+        )
+        return jnp.where(interior, out, v)
+
+    return jax.lax.fori_loop(0, iters, sweep, x)
