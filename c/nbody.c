@@ -1,0 +1,156 @@
+/* nbody benchmark driver (SURVEY.md C1+C8): O(N^2) direct all-pairs
+ * gravity with Plummer softening, leapfrog-style integration.
+ *
+ * Config of record: 65 536 bodies (BASELINE.json configs[4]; the
+ * multi-device allreduce variant lives behind the same kernel name in
+ * the Python package). Metric: Ginter/s = N^2 * steps / t.
+ * eps = 1e-2 softening, fixed across all variants.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "common/bench.h"
+#include "common/dispatch.h"
+#include "common/tpu_client.h"
+
+#define EPS2 (1e-2 * 1e-2)
+
+/* bufs = {px,py,pz,vx,vy,vz (inout), m (in)} */
+
+static void step_host(long n, long steps, float dt, float **b, int omp) {
+    float *px = b[0], *py = b[1], *pz = b[2];
+    float *vx = b[3], *vy = b[4], *vz = b[5];
+    const float *m = b[6];
+    for (long t = 0; t < steps; t++) {
+#pragma omp parallel for schedule(static) if (omp)
+        for (long i = 0; i < n; i++) {
+            /* double accumulators: the serial run doubles as the
+             * golden oracle (SURVEY.md C2) */
+            double ax = 0.0, ay = 0.0, az = 0.0;
+            for (long j = 0; j < n; j++) {
+                double dx = (double)px[j] - px[i];
+                double dy = (double)py[j] - py[i];
+                double dz = (double)pz[j] - pz[i];
+                double r2 = dx * dx + dy * dy + dz * dz + EPS2;
+                double inv_r = 1.0 / sqrt(r2);
+                double w = m[j] * inv_r * inv_r * inv_r;
+                ax += w * dx;
+                ay += w * dy;
+                az += w * dz;
+            }
+            vx[i] += (float)(ax * dt);
+            vy[i] += (float)(ay * dt);
+            vz[i] += (float)(az * dt);
+        }
+        for (long i = 0; i < n; i++) {
+            px[i] += vx[i] * dt;
+            py[i] += vy[i] * dt;
+            pz[i] += vz[i] * dt;
+        }
+    }
+}
+
+static int nbody_serial(const bench_params_t *p, void **bufs) {
+    step_host(p->n, p->iters, (float)p->dt, (float **)bufs, 0);
+    return 0;
+}
+
+static int nbody_omp(const bench_params_t *p, void **bufs) {
+    step_host(p->n, p->iters, (float)p->dt, (float **)bufs, 1);
+    return 0;
+}
+
+static int nbody_tpu(const bench_params_t *p, void **bufs) {
+    char json[1024];
+    int off = snprintf(json, sizeof(json),
+                       "{\"dt\":%.17g,\"eps\":1e-2,\"steps\":%ld,"
+                       "\"buffers\":[",
+                       p->dt, p->iters);
+    for (int i = 0; i < 7; i++) {
+        off += snprintf(json + off, sizeof(json) - off,
+                        "%s{\"shape\":[%ld],\"dtype\":\"f32\"}",
+                        i ? "," : "", p->n);
+    }
+    snprintf(json + off, sizeof(json) - off, "]}");
+    return tpk_tpu_run("nbody", json, bufs, 7);
+}
+
+static const tpk_dispatch_entry TABLE[] = {
+    {"serial", nbody_serial},
+    {"omp", nbody_omp},
+    {"tpu", nbody_tpu},
+    {NULL, NULL},
+};
+
+int main(int argc, char **argv) {
+    bench_params_t p;
+    bench_params_default(&p);
+    p.n = 65536;
+    p.iters = 10;
+    bench_parse_args(&p, argc, argv, "nbody");
+
+    tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "nbody");
+    if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();
+
+    const size_t n = (size_t)p.n;
+    float *state[7];
+    for (int i = 0; i < 7; i++) state[i] = malloc(n * sizeof(float));
+    /* positions ~U(-1,1); small velocities; masses in (0.5, 1.5) */
+    for (int i = 0; i < 3; i++)
+        bench_fill_f32(state[i], n, p.seed + i);
+    for (int i = 3; i < 6; i++) {
+        bench_fill_f32(state[i], n, p.seed + i);
+        for (size_t k = 0; k < n; k++) state[i][k] *= 0.1f;
+    }
+    bench_fill_f32(state[6], n, p.seed + 6);
+    for (size_t k = 0; k < n; k++)
+        state[6][k] = 1.0f + 0.5f * state[6][k];
+
+    int rc = 0;
+    if (p.check) {
+        float *gold[7], *run[7];
+        for (int i = 0; i < 7; i++) {
+            gold[i] = malloc(n * sizeof(float));
+            run[i] = malloc(n * sizeof(float));
+            memcpy(gold[i], state[i], n * sizeof(float));
+            memcpy(run[i], state[i], n * sizeof(float));
+        }
+        nbody_serial(&p, (void **)gold);
+        if (fn(&p, (void **)run) != 0) {
+            fprintf(stderr, "kernel failed\n");
+            return 1;
+        }
+        size_t bad = 0;
+        double max_err = 0.0, e;
+        for (int i = 0; i < 6; i++) {
+            bad += bench_check_f32(run[i], gold[i], n, 2e-3, 2e-4, &e);
+            if (e > max_err) max_err = e;
+        }
+        rc = bench_report_check("nbody", bad, 6 * n, max_err);
+        for (int i = 0; i < 7; i++) {
+            free(gold[i]);
+            free(run[i]);
+        }
+        if (rc) return rc;
+    }
+
+    void *bufs[7];
+    for (int i = 0; i < 7; i++) bufs[i] = state[i];
+    fn(&p, bufs); /* warm-up */
+    double best = 1e30;
+    for (int r = 0; r < p.reps; r++) {
+        double t0 = bench_now_sec();
+        fn(&p, bufs);
+        double t1 = bench_now_sec();
+        if (t1 - t0 < best) best = t1 - t0;
+    }
+    double ginter =
+        (double)n * (double)n * (double)p.iters / best / 1e9;
+    bench_report_metric("nbody", p.device, p.n, best, "interactions", ginter,
+                        "Ginter/s");
+
+    for (int i = 0; i < 7; i++) free(state[i]);
+    return rc;
+}

@@ -1,0 +1,162 @@
+/* scan_histogram benchmark driver (SURVEY.md C1+C7): CUB-style
+ * inclusive prefix scan + histogram over the same int32 input stream.
+ *
+ * Config of record: BASELINE.json configs[3]. Metric: Melem/s = N / t
+ * for the combined scan+histogram pass. Integer kernels check exactly
+ * (SURVEY.md §4). Values are drawn in [0, nbins).
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "common/bench.h"
+#include "common/dispatch.h"
+#include "common/tpu_client.h"
+
+/* bufs = {x (n, i32, in), scan_out (n, i32, out), hist (nbins, i32, out)} */
+
+static int sh_serial(const bench_params_t *p, void **bufs) {
+    const int32_t *x = bufs[0];
+    int32_t *scan_out = bufs[1];
+    int32_t *hist = bufs[2];
+    memset(hist, 0, (size_t)p->nbins * sizeof(int32_t));
+    int32_t run = 0;
+    for (long i = 0; i < p->n; i++) {
+        run += x[i];
+        scan_out[i] = run;
+        hist[x[i]]++;
+    }
+    return 0;
+}
+
+static int sh_omp(const bench_params_t *p, void **bufs) {
+    const int32_t *x = bufs[0];
+    int32_t *scan_out = bufs[1];
+    int32_t *hist = bufs[2];
+    long n = p->n;
+    int nbins = p->nbins;
+    memset(hist, 0, (size_t)nbins * sizeof(int32_t));
+
+#pragma omp parallel
+    {
+        /* histogram: privatized bins + critical merge */
+        int32_t *priv = calloc((size_t)nbins, sizeof(int32_t));
+#pragma omp for schedule(static) nowait
+        for (long i = 0; i < n; i++) priv[x[i]]++;
+#pragma omp critical
+        for (int b = 0; b < nbins; b++) hist[b] += priv[b];
+        free(priv);
+    }
+
+    /* scan: two-pass block scan (chunk sums, exclusive chunk prefix,
+     * then local rescan) — the classic OpenMP decomposition */
+    enum { CHUNKS = 64 };
+    int32_t chunk_sum[CHUNKS + 1] = {0};
+    long chunk = (n + CHUNKS - 1) / CHUNKS;
+#pragma omp parallel for schedule(static)
+    for (int c = 0; c < CHUNKS; c++) {
+        long lo = c * chunk, hi = lo + chunk < n ? lo + chunk : n;
+        int32_t s = 0;
+        for (long i = lo; i < hi; i++) s += x[i];
+        chunk_sum[c + 1] = s;
+    }
+    for (int c = 1; c <= CHUNKS; c++) chunk_sum[c] += chunk_sum[c - 1];
+#pragma omp parallel for schedule(static)
+    for (int c = 0; c < CHUNKS; c++) {
+        long lo = c * chunk, hi = lo + chunk < n ? lo + chunk : n;
+        int32_t run = chunk_sum[c];
+        for (long i = lo; i < hi; i++) {
+            run += x[i];
+            scan_out[i] = run;
+        }
+    }
+    return 0;
+}
+
+static int sh_tpu(const bench_params_t *p, void **bufs) {
+    char json[512];
+    snprintf(json, sizeof(json),
+             "{\"buffers\":[{\"shape\":[%ld],\"dtype\":\"i32\"},"
+             "{\"shape\":[%ld],\"dtype\":\"i32\"}]}",
+             p->n, p->n);
+    void *scan_bufs[2] = {bufs[0], bufs[1]};
+    if (tpk_tpu_run("scan", json, scan_bufs, 2) != 0) return 1;
+
+    snprintf(json, sizeof(json),
+             "{\"nbins\":%d,\"buffers\":[{\"shape\":[%ld],\"dtype\":\"i32\"},"
+             "{\"shape\":[%d],\"dtype\":\"i32\"}]}",
+             p->nbins, p->n, p->nbins);
+    void *hist_bufs[2] = {bufs[0], bufs[2]};
+    return tpk_tpu_run("histogram", json, hist_bufs, 2);
+}
+
+static const tpk_dispatch_entry TABLE[] = {
+    {"serial", sh_serial},
+    {"omp", sh_omp},
+    {"tpu", sh_tpu},
+    {NULL, NULL},
+};
+
+int main(int argc, char **argv) {
+    bench_params_t p;
+    bench_params_default(&p);
+    bench_parse_args(&p, argc, argv, "scan_histogram");
+
+    tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "scan_histogram");
+    if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();
+
+    const size_t n = (size_t)p.n;
+    uint32_t *raw = malloc(n * sizeof(uint32_t));
+    int32_t *x = malloc(n * sizeof(int32_t));
+    int32_t *scan_out = malloc(n * sizeof(int32_t));
+    int32_t *hist = malloc((size_t)p.nbins * sizeof(int32_t));
+    if (!raw || !x || !scan_out || !hist) {
+        fprintf(stderr, "alloc failed\n");
+        return 1;
+    }
+    bench_fill_u32(raw, n, (uint32_t)p.nbins, p.seed);
+    for (size_t i = 0; i < n; i++) x[i] = (int32_t)raw[i];
+    free(raw);
+
+    int rc = 0;
+    if (p.check) {
+        int32_t *scan_gold = malloc(n * sizeof(int32_t));
+        int32_t *hist_gold = malloc((size_t)p.nbins * sizeof(int32_t));
+        void *gold_bufs[3] = {x, scan_gold, hist_gold};
+        sh_serial(&p, gold_bufs);
+
+        void *run_bufs[3] = {x, scan_out, hist};
+        if (fn(&p, run_bufs) != 0) {
+            fprintf(stderr, "kernel failed\n");
+            return 1;
+        }
+        size_t bad = 0;
+        for (size_t i = 0; i < n; i++)
+            if (scan_out[i] != scan_gold[i]) bad++;
+        for (int b = 0; b < p.nbins; b++)
+            if (hist[b] != hist_gold[b]) bad++;
+        rc = bench_report_check("scan_histogram", bad, n + p.nbins, 0.0);
+        free(scan_gold);
+        free(hist_gold);
+        if (rc) return rc;
+    }
+
+    void *bufs[3] = {x, scan_out, hist};
+    fn(&p, bufs); /* warm-up */
+    double best = 1e30;
+    for (int r = 0; r < p.reps; r++) {
+        double t0 = bench_now_sec();
+        fn(&p, bufs);
+        double t1 = bench_now_sec();
+        if (t1 - t0 < best) best = t1 - t0;
+    }
+    double melems = (double)n / best / 1e6;
+    bench_report_metric("scan_histogram", p.device, p.n, best, "throughput",
+                        melems, "Melem/s");
+
+    free(x);
+    free(scan_out);
+    free(hist);
+    return rc;
+}

@@ -1,0 +1,53 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukernels.kernels.nbody import nbody_step, nbody_reference
+
+
+def _rand_system(rng, n):
+    px, py, pz = (jnp.asarray(rng.standard_normal(n), jnp.float32) for _ in range(3))
+    vx, vy, vz = (
+        jnp.asarray(0.1 * rng.standard_normal(n), jnp.float32) for _ in range(3)
+    )
+    m = jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32)
+    return px, py, pz, vx, vy, vz, m
+
+
+@pytest.mark.parametrize("n,steps", [(256, 1), (1024, 2), (1000, 3)])
+def test_nbody_matches_reference(rng, n, steps):
+    sys_ = _rand_system(rng, n)
+    out = nbody_step(*sys_, dt=1e-3, eps=1e-2, steps=steps)
+    ref = nbody_reference(*sys_, dt=1e-3, eps=1e-2, steps=steps)
+    for got, want, name in zip(out, ref, ["px", "py", "pz", "vx", "vy", "vz"]):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5,
+            err_msg=name,
+        )
+
+
+def test_nbody_momentum_conserved(rng):
+    # equal masses, pairwise antisymmetric forces -> total momentum
+    # constant (up to float error)
+    n = 512
+    px, py, pz, vx, vy, vz, _ = _rand_system(rng, n)
+    m = jnp.ones(n, jnp.float32)
+    out = nbody_step(px, py, pz, vx, vy, vz, m, dt=1e-3, steps=5)
+    p0 = np.asarray(vx).sum()
+    p1 = np.asarray(out[3]).sum()
+    assert abs(p1 - p0) < 1e-2
+
+
+def test_nbody_zero_mass_inert(rng):
+    # a zero-mass far-away body must not disturb the others
+    n = 128
+    sys_ = [np.asarray(a) for a in _rand_system(rng, n)]
+    sys2 = [np.append(a, 100.0).astype(np.float32) for a in sys_[:3]] + [
+        np.append(a, 0.0).astype(np.float32) for a in sys_[3:]
+    ]
+    out_base = nbody_step(*[jnp.asarray(a) for a in sys_], steps=2)
+    out_ext = nbody_step(*[jnp.asarray(a) for a in sys2], steps=2)
+    np.testing.assert_allclose(
+        np.asarray(out_ext[0])[:n], np.asarray(out_base[0]), rtol=1e-5
+    )

@@ -1,0 +1,46 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukernels.kernels.histogram import histogram, histogram_reference
+from tpukernels.kernels.scan import inclusive_scan, inclusive_scan_reference
+
+
+@pytest.mark.parametrize("n", [128, 1000, 2**17, 7])
+def test_scan_f32(rng, n):
+    x = jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)
+    out = np.asarray(inclusive_scan(x))
+    ref = np.cumsum(np.asarray(x, dtype=np.float64))
+    # float prefix sums accumulate error ~ sqrt(n) * eps * scale
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-2)
+
+
+@pytest.mark.parametrize("n", [128, 4096, 2**17, 333])
+def test_scan_i32_exact(rng, n):
+    x = jnp.asarray(rng.integers(-100, 100, n), dtype=jnp.int32)
+    out = np.asarray(inclusive_scan(x))
+    ref = np.cumsum(np.asarray(x))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_scan_matches_jnp_reference(rng):
+    x = jnp.asarray(rng.integers(0, 10, 50000), dtype=jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(inclusive_scan(x)), np.asarray(inclusive_scan_reference(x))
+    )
+
+
+@pytest.mark.parametrize("n,nbins", [(100000, 256), (2**17, 64), (999, 16), (4096, 1024)])
+def test_histogram_exact(rng, n, nbins):
+    x = jnp.asarray(rng.integers(0, nbins, n), dtype=jnp.int32)
+    out = np.asarray(histogram(x, nbins))
+    ref = np.bincount(np.asarray(x), minlength=nbins)
+    np.testing.assert_array_equal(out, ref)
+    assert out.sum() == n
+
+
+def test_histogram_matches_jnp_reference(rng):
+    x = jnp.asarray(rng.integers(0, 32, 10000), dtype=jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(histogram(x, 32)), np.asarray(histogram_reference(x, 32))
+    )

@@ -82,11 +82,51 @@ def _adapt_stencil3d(p, arrs):
     np.copyto(x, np.asarray(out))
 
 
+def _adapt_scan(p, arrs):
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    x, out = arrs
+    res = registry.lookup("scan")(jnp.asarray(x))
+    np.copyto(out, np.asarray(res))
+
+
+def _adapt_histogram(p, arrs):
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    x, counts = arrs
+    res = registry.lookup("histogram")(jnp.asarray(x), int(p["nbins"]))
+    np.copyto(counts, np.asarray(res))
+
+
+def _adapt_nbody(p, arrs):
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    px, py, pz, vx, vy, vz, m = arrs
+    out = registry.lookup("nbody")(
+        *(jnp.asarray(a) for a in (px, py, pz, vx, vy, vz)),
+        jnp.asarray(m),
+        dt=p.get("dt", 1e-3),
+        eps=p.get("eps", 1e-2),
+        steps=int(p.get("steps", 1)),
+    )
+    for host, dev in zip((px, py, pz, vx, vy, vz), out):
+        np.copyto(host, np.asarray(dev))
+
+
 _ADAPTERS = {
     "vector_add": _adapt_vector_add,
     "sgemm": _adapt_sgemm,
     "stencil2d": _adapt_stencil2d,
     "stencil3d": _adapt_stencil3d,
+    "scan": _adapt_scan,
+    "histogram": _adapt_histogram,
+    "nbody": _adapt_nbody,
 }
 
 

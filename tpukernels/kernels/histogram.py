@@ -1,0 +1,92 @@
+"""Histogram (SURVEY.md C7, histogram half).
+
+Reference behavior: count occurrences of integer values in
+[0, nbins) (BASELINE.json configs[3], "CUB-style"). The OpenMP/CUDA
+formulations privatize per-thread/per-block bins and merge; on TPU
+there are no scatter atomics worth using — instead each grid step
+compares its (bm, 128) value block against the bin-index row vector
+(a broadcasted VPU compare) and reduces matches per bin, accumulating
+into the output block, which Pallas keeps resident in VMEM across the
+sequential grid (the TPU-native analog of bin privatization + merge).
+
+Out-of-range values (and the padding the wrapper adds) count nothing.
+Counts are exact: int32 adds on the VPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpukernels.utils import cdiv, default_interpret
+from tpukernels.utils.shapes import LANES
+
+_BLOCK_ROWS = 256
+
+
+def _hist_kernel(nbins, x_ref, o_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    block = x_ref[:]  # (bm, 128) int32 values
+    bm = block.shape[0]
+    # 3D broadcast compare: (bm, 128, 1) == (1, 1, nbins) keeps bins on
+    # the lane dim and needs no layout-hostile reshape. The (bm, 128,
+    # nbins) one-hot is the VMEM governor; _pick_bm sizes bm to fit.
+    bins = jax.lax.broadcasted_iota(jnp.int32, (1, 1, nbins), 2)
+    onehot = (block[:, :, None] == bins).astype(jnp.int32)
+    o_ref[:] += jnp.sum(onehot, axis=(0, 1), keepdims=False)[None, :]
+
+
+def _pick_bm(rows: int, nbins: int) -> int:
+    """Largest block rows whose one-hot fits ~2 MiB of VMEM."""
+    limit = 2 * 1024 * 1024 // (LANES * nbins * 4)
+    return max(8, min(_BLOCK_ROWS, limit // 8 * 8, rows))
+
+
+@functools.partial(jax.jit, static_argnames=("nbins", "interpret"))
+def _hist_2d(x2, nbins, interpret=False):
+    rows = x2.shape[0]
+    bm = _pick_bm(rows, nbins)
+    grid = (cdiv(rows, bm),)
+    return pl.pallas_call(
+        functools.partial(_hist_kernel, nbins),
+        out_shape=jax.ShapeDtypeStruct((1, nbins), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nbins), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(x2)
+
+
+def histogram(x, nbins: int, interpret: bool | None = None):
+    """Count int32 values in [0, nbins); returns (nbins,) int32."""
+    if interpret is None:
+        interpret = default_interpret()
+    x = x.reshape(-1).astype(jnp.int32)
+    n = x.size
+    padded = cdiv(n, LANES) * LANES
+    if padded != n:
+        # pad with an out-of-range value so padding counts nothing
+        x = jnp.pad(x, (0, padded - n), constant_values=nbins)
+    out = _hist_2d(x.reshape(-1, LANES), int(nbins), interpret=interpret)
+    return out.reshape(-1)
+
+
+def histogram_reference(x, nbins: int):
+    """jnp oracle (mirrors the serial-C counting loop)."""
+    x = x.reshape(-1).astype(jnp.int32)
+    return jnp.bincount(
+        jnp.clip(x, 0, nbins), length=nbins + 1
+    )[:nbins].astype(jnp.int32)

@@ -1,0 +1,157 @@
+"""O(N^2) direct N-body (SURVEY.md C8).
+
+Reference config: 65 536 bodies, direct all-pairs gravity with
+Plummer softening, leapfrog-style integration (BASELINE.json
+configs[4]). Metric: interactions/sec = N^2 * steps / t.
+
+TPU design: SoA float32 arrays shaped (1, N) so bodies live on the
+lane dimension. The Pallas force kernel grids over i-blocks; each
+grid step holds its (bi,) i-bodies as a column tile and sweeps all
+j-bodies in (1, bj) lane chunks held in VMEM (the whole 65 536-body
+j-set is only 1 MiB), accumulating (bi, bj) pairwise partial
+accelerations on the VPU — the GPU-Gems shared-memory j-tiling
+pattern, restated for VMEM (SURVEY.md C8). Self-interaction
+contributes zero automatically (dr = 0), and padded bodies carry
+mass 0 so they contribute nothing.
+
+Integration (v += a dt; p += v dt) is plain fused VPU work; `steps`
+sweeps run under one jit via fori_loop. The multi-chip variant
+(i-shard + psum, or j-ring via ppermute) lives in
+tpukernels/parallel/collectives.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpukernels.utils import cdiv, default_interpret
+from tpukernels.utils.shapes import LANES
+
+_BI = 256  # i-bodies per grid step
+_BJ = 2048  # j-bodies per inner chunk
+
+
+def _forces_kernel(n_pad, bi, bj, eps2_ref, xi_ref, yi_ref, zi_ref,
+                   xj_ref, yj_ref, zj_ref, mj_ref,
+                   ax_ref, ay_ref, az_ref):
+    eps2 = eps2_ref[0, 0]
+    # i-bodies as columns: (1, bi) -> (bi, 1)
+    xi = xi_ref[:].reshape(bi, 1)
+    yi = yi_ref[:].reshape(bi, 1)
+    zi = zi_ref[:].reshape(bi, 1)
+
+    nchunks = n_pad // bj
+
+    def chunk(c, acc):
+        ax, ay, az = acc
+        j0 = c * bj
+        xj = xj_ref[:, pl.ds(j0, bj)]
+        yj = yj_ref[:, pl.ds(j0, bj)]
+        zj = zj_ref[:, pl.ds(j0, bj)]
+        mj = mj_ref[:, pl.ds(j0, bj)]
+        dx = xj - xi  # (bi, bj)
+        dy = yj - yi
+        dz = zj - zi
+        r2 = dx * dx + dy * dy + dz * dz + eps2
+        inv_r = jax.lax.rsqrt(r2)
+        w = mj * inv_r * inv_r * inv_r  # m_j / r^3
+        ax = ax + jnp.sum(w * dx, axis=1, keepdims=True)
+        ay = ay + jnp.sum(w * dy, axis=1, keepdims=True)
+        az = az + jnp.sum(w * dz, axis=1, keepdims=True)
+        return ax, ay, az
+
+    zero = jnp.zeros((bi, 1), jnp.float32)
+    ax, ay, az = jax.lax.fori_loop(0, nchunks, chunk, (zero, zero, zero))
+    ax_ref[:] = ax.reshape(1, bi)
+    ay_ref[:] = ay.reshape(1, bi)
+    az_ref[:] = az.reshape(1, bi)
+
+
+def _forces(px, py, pz, m, eps2, interpret):
+    n_pad = px.shape[1]
+    bi = min(_BI, n_pad)
+    bj = min(_BJ, n_pad)
+    grid = (cdiv(n_pad, bi),)
+    ispec = pl.BlockSpec((1, bi), lambda i: (0, i), memory_space=pltpu.VMEM)
+    jspec = pl.BlockSpec(memory_space=pltpu.VMEM)  # whole array resident
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    out_shape = jax.ShapeDtypeStruct((1, n_pad), jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_forces_kernel, n_pad, bi, bj),
+        out_shape=(out_shape, out_shape, out_shape),
+        grid=grid,
+        in_specs=[sspec, ispec, ispec, ispec, jspec, jspec, jspec, jspec],
+        out_specs=(ispec, ispec, ispec),
+        cost_estimate=pl.CostEstimate(
+            flops=20 * n_pad * bi,  # per grid step pairwise work
+            bytes_accessed=4 * (7 * n_pad),
+            transcendentals=n_pad * bi,
+        ),
+        interpret=interpret,
+    )(eps2.reshape(1, 1), px, py, pz, px, py, pz, m)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("steps", "interpret")
+)
+def _nbody_jit(px, py, pz, vx, vy, vz, m, dt, eps2, steps, interpret):
+    def step(_, s):
+        px, py, pz, vx, vy, vz = s
+        ax, ay, az = _forces(px, py, pz, m, eps2, interpret)
+        vx = vx + ax * dt
+        vy = vy + ay * dt
+        vz = vz + az * dt
+        px = px + vx * dt
+        py = py + vy * dt
+        pz = pz + vz * dt
+        return px, py, pz, vx, vy, vz
+
+    return jax.lax.fori_loop(0, steps, step, (px, py, pz, vx, vy, vz))
+
+
+def nbody_step(px, py, pz, vx, vy, vz, m, dt=1e-3, eps=1e-2, steps=1,
+               interpret: bool | None = None):
+    """Advance N bodies `steps` leapfrog steps. 1-D float32 SoA inputs;
+    returns updated (px, py, pz, vx, vy, vz)."""
+    if interpret is None:
+        interpret = default_interpret()
+    n = px.size
+    pad = cdiv(n, LANES) * LANES - n
+    arrs = [a.reshape(1, -1) for a in (px, py, pz, vx, vy, vz, m)]
+    if pad:
+        # padded bodies: mass 0 at the origin -> zero contribution
+        arrs = [jnp.pad(a, ((0, 0), (0, pad))) for a in arrs]
+    px2, py2, pz2, vx2, vy2, vz2, m2 = arrs
+    out = _nbody_jit(
+        px2, py2, pz2, vx2, vy2, vz2, m2,
+        jnp.float32(dt), jnp.float32(eps * eps), int(steps), interpret
+    )
+    return tuple(a.reshape(-1)[:n] for a in out)
+
+
+def nbody_reference(px, py, pz, vx, vy, vz, m, dt=1e-3, eps=1e-2, steps=1):
+    """jnp oracle (mirrors the serial-C double loop)."""
+    eps2 = jnp.float32(eps * eps)
+    dt = jnp.float32(dt)
+
+    def step(_, s):
+        px, py, pz, vx, vy, vz = s
+        dx = px[None, :] - px[:, None]
+        dy = py[None, :] - py[:, None]
+        dz = pz[None, :] - pz[:, None]
+        r2 = dx * dx + dy * dy + dz * dz + eps2
+        w = m[None, :] * jax.lax.rsqrt(r2) ** 3
+        ax = jnp.sum(w * dx, axis=1)
+        ay = jnp.sum(w * dy, axis=1)
+        az = jnp.sum(w * dz, axis=1)
+        vx = vx + ax * dt
+        vy = vy + ay * dt
+        vz = vz + az * dt
+        return px + vx * dt, py + vy * dt, pz + vz * dt, vx, vy, vz
+
+    return jax.lax.fori_loop(0, steps, step, (px, py, pz, vx, vy, vz))

@@ -1,0 +1,108 @@
+"""Inclusive prefix scan (SURVEY.md C7, scan half).
+
+Reference behavior: CUB-style parallel prefix sum over N elements
+(BASELINE.json configs[3]). CUB's GPU formulation (block scan +
+decoupled lookback) exists because CUDA thread blocks run concurrently;
+the TPU grid is *sequential* per core, so the carry is simply a running
+total in scratch that persists across grid steps — same contract,
+simpler algorithm (SURVEY.md §7 "scan carry on TPU").
+
+Layout: the 1-D input is reshaped to (rows, 128) lanes. Each grid step
+scans one (bm, 128) block in row-major element order:
+
+    within-row inclusive scan  (cumsum along lanes)
+  + exclusive prefix of row totals  (cumsum along sublanes)
+  + carry from all previous blocks  (SMEM scratch)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpukernels.utils import cdiv, default_interpret
+from tpukernels.utils.shapes import LANES
+
+_BLOCK_ROWS = 256
+
+
+def _cumsum_log(x, axis: int):
+    """Inclusive prefix sum via Hillis-Steele log-step shifted adds
+    (jnp.cumsum has no Pallas TPU lowering). Static unrolled loop:
+    log2(size) VPU adds."""
+    size = x.shape[axis]
+    k = 1
+    while k < size:
+        zeros_shape = list(x.shape)
+        zeros_shape[axis] = k
+        zeros = jnp.zeros(zeros_shape, x.dtype)
+        if axis == 1:
+            shifted = jnp.concatenate([zeros, x[:, :-k]], axis=1)
+        else:
+            shifted = jnp.concatenate([zeros, x[:-k]], axis=0)
+        x = x + shifted
+        k *= 2
+    return x
+
+
+def _scan_kernel(x_ref, o_ref, carry_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        carry_ref[0] = jnp.zeros((), x_ref.dtype)
+
+    block = x_ref[:]
+    within = _cumsum_log(block, axis=1)
+    row_tot = within[:, -1:]
+    # Mosaic can't concat (k, 1)-shaped single-lane arrays ("offset
+    # mismatch on non-concat dimension"), so run the sublane scan on a
+    # full-lane broadcast and take one column.
+    row_tot_b = jnp.broadcast_to(row_tot, block.shape)
+    row_prefix_incl = _cumsum_log(row_tot_b, axis=0)[:, :1]
+    o_ref[:] = within + (row_prefix_incl - row_tot) + carry_ref[0]
+    # negative int indexing lowers to dynamic_slice (no TPU lowering);
+    # a full reduction is supported and equivalent
+    carry_ref[0] = carry_ref[0] + jnp.sum(row_tot)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _scan_2d(x2, interpret=False):
+    rows = x2.shape[0]
+    bm = min(_BLOCK_ROWS, rows)
+    grid = (cdiv(rows, bm),)
+    return pl.pallas_call(
+        _scan_kernel,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec(
+            (bm, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.SMEM((1,), x2.dtype)],
+        interpret=interpret,
+    )(x2)
+
+
+def inclusive_scan(x, interpret: bool | None = None):
+    """Inclusive prefix sum of a 1-D array (float32 or int32)."""
+    if interpret is None:
+        interpret = default_interpret()
+    n = x.size
+    x = x.reshape(-1)
+    padded = cdiv(n, LANES) * LANES
+    if padded != n:
+        x = jnp.pad(x, (0, padded - n))  # zeros don't disturb the scan
+    out = _scan_2d(x.reshape(-1, LANES), interpret=interpret)
+    return out.reshape(-1)[:n]
+
+
+def inclusive_scan_reference(x):
+    """jnp oracle (mirrors the serial-C running-sum golden)."""
+    return jnp.cumsum(x)
