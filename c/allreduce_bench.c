@@ -1,0 +1,119 @@
+/* allreduce_bench driver (SURVEY.md C9, §3(d)): the measured
+ * collective microbenchmark. Every rank contributes the same S-element
+ * float32 vector (the standard MPI-benchmark setup); allreduce(SUM)
+ * must return nranks * x on every rank.
+ *
+ * Metric of record: bus bandwidth = 2*(n-1)/n * bytes / t (ring
+ * allreduce accounting), swept 8→64 chips on a pod
+ * (BASELINE.json metric). On the TPU path nranks = however many chips
+ * the mesh has (1 on the dev box — a degenerate but honest check);
+ * serial/omp model the single-rank case. The full sweep lives in
+ * `python -m tpukernels.parallel.busbw`.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "common/bench.h"
+#include "common/dispatch.h"
+#include "common/tpu_client.h"
+
+/* bufs = {x (n, f32, in), out (n, f32, out)} */
+
+static int ar_serial(const bench_params_t *p, void **bufs) {
+    memcpy(bufs[1], bufs[0], (size_t)p->n * sizeof(float));
+    return 0;
+}
+
+static int ar_omp(const bench_params_t *p, void **bufs) {
+    const float *x = bufs[0];
+    float *out = bufs[1];
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < p->n; i++) out[i] = x[i];
+    return 0;
+}
+
+static int ar_tpu(const bench_params_t *p, void **bufs) {
+    char json[256];
+    snprintf(json, sizeof(json),
+             "{\"buffers\":[{\"shape\":[%ld],\"dtype\":\"f32\"},"
+             "{\"shape\":[%ld],\"dtype\":\"f32\"}]}",
+             p->n, p->n);
+    return tpk_tpu_run("allreduce", json, bufs, 2);
+}
+
+static const tpk_dispatch_entry TABLE[] = {
+    {"serial", ar_serial},
+    {"omp", ar_omp},
+    {"tpu", ar_tpu},
+    {NULL, NULL},
+};
+
+int main(int argc, char **argv) {
+    bench_params_t p;
+    bench_params_default(&p);
+    p.n = 1 << 22; /* 16 MiB message */
+    bench_parse_args(&p, argc, argv, "allreduce_bench");
+
+    tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "allreduce_bench");
+    if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();
+
+    const size_t n = (size_t)p.n;
+    float *x = malloc(n * sizeof(float));
+    float *out = malloc(n * sizeof(float));
+    if (!x || !out) {
+        fprintf(stderr, "alloc failed\n");
+        return 1;
+    }
+    bench_fill_f32(x, n, p.seed);
+    /* keep values away from 0 so out/x recovers the rank count */
+    for (size_t i = 0; i < n; i++) x[i] = 1.0f + 0.5f * x[i];
+
+    void *bufs[2] = {x, out};
+    if (fn(&p, bufs) != 0) {
+        fprintf(stderr, "kernel failed\n");
+        return 1;
+    }
+
+    /* infer nranks: allreduce of identical contributions = nranks * x */
+    double k = (double)out[0] / (double)x[0];
+    long nranks = (long)(k + 0.5);
+    int rc = 0;
+    if (p.check) {
+        size_t bad = 0;
+        double max_err = 0.0;
+        if (nranks < 1 || fabs(k - (double)nranks) > 1e-3) {
+            bad = n;
+        } else {
+            for (size_t i = 0; i < n; i++) {
+                double want = (double)nranks * x[i];
+                double err = fabs(out[i] - want);
+                if (err > max_err) max_err = err;
+                if (err > 1e-5 + 1e-5 * fabs(want)) bad++;
+            }
+        }
+        rc = bench_report_check("allreduce", bad, n, max_err);
+        if (rc) return rc;
+    }
+
+    fn(&p, bufs); /* warm-up */
+    double best = 1e30;
+    for (int r = 0; r < p.reps; r++) {
+        double t0 = bench_now_sec();
+        fn(&p, bufs);
+        double t1 = bench_now_sec();
+        if (t1 - t0 < best) best = t1 - t0;
+    }
+    double bytes = (double)n * sizeof(float);
+    double busbw =
+        (nranks > 1 ? 2.0 * (nranks - 1) / nranks * bytes : bytes) / best /
+        1e9;
+    printf("kernel=allreduce device=%s n=%ld nranks=%ld time_ms=%.3f "
+           "metric=busbw value=%.3f unit=GB/s\n",
+           p.device, p.n, nranks, best * 1e3, busbw);
+
+    free(x);
+    free(out);
+    return rc;
+}

@@ -1,0 +1,77 @@
+/* ABI round-trip unit test (SURVEY.md §4 item 4): buffers must cross
+ * the C -> libtpukernels.so -> embedded CPython -> JAX -> back
+ * boundary intact, errors must come back as nonzero return codes (not
+ * crashes), and repeated calls must reuse the interpreter.
+ *
+ * Exercises the shim directly, without a benchmark driver on top.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "common/tpu_client.h"
+
+static int failures = 0;
+
+#define CHECK(cond, msg)                                            \
+    do {                                                            \
+        if (!(cond)) {                                              \
+            fprintf(stderr, "FAIL: %s (%s:%d)\n", msg, __FILE__,    \
+                    __LINE__);                                      \
+            failures++;                                             \
+        } else {                                                    \
+            printf("ok: %s\n", msg);                                \
+        }                                                           \
+    } while (0)
+
+int main(void) {
+    tpk_tpu_ensure();
+
+    /* 1. round-trip correctness: saxpy through the full stack */
+    enum { N = 1000 };
+    float x[N], y[N], y0[N];
+    for (int i = 0; i < N; i++) {
+        x[i] = (float)i * 0.25f;
+        y[i] = y0[i] = 1.0f - (float)i * 0.125f;
+    }
+    void *bufs[2] = {x, y};
+    char json[256];
+    snprintf(json, sizeof(json),
+             "{\"alpha\":2.0,\"buffers\":[{\"shape\":[%d],\"dtype\":\"f32\"},"
+             "{\"shape\":[%d],\"dtype\":\"f32\"}]}",
+             N, N);
+    int rc = tpk_tpu_run("vector_add", json, bufs, 2);
+    CHECK(rc == 0, "vector_add returns 0");
+    int bad = 0;
+    for (int i = 0; i < N; i++)
+        if (fabsf(y[i] - (2.0f * x[i] + y0[i])) > 1e-5f) bad++;
+    CHECK(bad == 0, "buffer round-trip values exact");
+    bad = 0;
+    for (int i = 0; i < N; i++)
+        if (x[i] != (float)i * 0.25f) bad++;
+    CHECK(bad == 0, "input buffer unmodified");
+
+    /* 2. unknown kernel -> error return, not a crash */
+    rc = tpk_tpu_run("no_such_kernel", json, bufs, 2);
+    CHECK(rc != 0, "unknown kernel returns nonzero");
+
+    /* 3. buffer-count mismatch -> error return */
+    rc = tpk_tpu_run("vector_add", json, bufs, 1);
+    CHECK(rc != 0, "buffer count mismatch returns nonzero");
+
+    /* 4. malformed JSON -> error return */
+    rc = tpk_tpu_run("vector_add", "{not json", bufs, 2);
+    CHECK(rc != 0, "malformed JSON returns nonzero");
+
+    /* 5. interpreter reuse: second good call still works */
+    rc = tpk_tpu_run("vector_add", json, bufs, 2);
+    CHECK(rc == 0, "shim survives errors and keeps working");
+
+    if (failures) {
+        printf("test_shim_abi: %d FAILURES\n", failures);
+        return 1;
+    }
+    printf("test_shim_abi: ALL PASS\n");
+    return 0;
+}

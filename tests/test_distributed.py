@@ -1,0 +1,108 @@
+"""Distributed-layer logic tests (SURVEY.md §4 item 3).
+
+Collective lowering is validated on 8 fake CPU host-platform devices.
+This box's sitecustomize force-registers the single-chip axon TPU
+backend at interpreter start (overriding JAX_PLATFORMS), so each test
+runs in a subprocess with a scrubbed env: PALLAS_AXON_POOL_IPS unset,
+JAX_PLATFORMS=cpu, xla_force_host_platform_device_count=8.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cpu8(body: str) -> str:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(body)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"STDOUT:\n{proc.stdout}\nSTDERR:\n{proc.stderr}"
+    return proc.stdout
+
+
+def test_allreduce_sum_matches_mpi_semantics():
+    out = run_cpu8("""
+        import jax, numpy as np, jax.numpy as jnp
+        assert jax.default_backend() == 'cpu' and len(jax.devices()) == 8
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import allreduce_sum
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((8, 1024)), jnp.float32)
+        out = np.asarray(allreduce_sum(x, mesh))
+        want = np.asarray(x).sum(axis=0)
+        for r in range(8):
+            np.testing.assert_allclose(out[r], want, rtol=1e-5)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
+def test_jacobi2d_dist_matches_single_device():
+    out = run_cpu8("""
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import jacobi2d_dist
+        from tpukernels.kernels.stencil import jacobi2d_reference
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((256, 128)), jnp.float32)
+        out = np.asarray(jacobi2d_dist(x, 7, mesh))
+        ref = np.asarray(jacobi2d_reference(x, 7))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
+@pytest.mark.parametrize("variant", ["psum", "ring"])
+def test_nbody_dist_matches_single_device(variant):
+    out = run_cpu8(f"""
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import nbody_dist_psum, nbody_dist_ring
+        from tpukernels.kernels.nbody import nbody_reference
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(2)
+        n = 512
+        state = tuple(jnp.asarray(rng.standard_normal(n), jnp.float32) for _ in range(6)) + (
+            jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32),)
+        fn = nbody_dist_{variant}
+        out = fn(state, 3, mesh)
+        ref = nbody_reference(*state, steps=3)
+        for got, want in zip(out, ref):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=5e-4, atol=5e-5)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
+def test_busbw_sweep_runs():
+    out = run_cpu8("""
+        from tpukernels.parallel.busbw import sweep, bus_bandwidth
+        res = sweep(min_bytes=1024, max_bytes=16384, reps=2, verbose=True)
+        assert len(res) == 3
+        assert all(bw > 0 for _, _, bw in res)
+        # accounting formula spot-checks
+        assert abs(bus_bandwidth(1.0, 1e9, 8) - 2*7/8) < 1e-9
+        assert abs(bus_bandwidth(1.0, 1e9, 1) - 1.0) < 1e-9
+        print('OK')
+    """)
+    assert "OK" in out

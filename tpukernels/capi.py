@@ -119,6 +119,20 @@ def _adapt_nbody(p, arrs):
         np.copyto(host, np.asarray(dev))
 
 
+def _adapt_allreduce(p, arrs):
+    import jax
+    import jax.numpy as jnp
+
+    from tpukernels.parallel import make_mesh
+    from tpukernels.parallel.collectives import allreduce_sum
+
+    x, out = arrs
+    ndev = jax.device_count()
+    contrib = jnp.tile(jnp.asarray(x)[None, :], (ndev, 1))
+    res = allreduce_sum(contrib, make_mesh(ndev))
+    np.copyto(out, np.asarray(res[0]))
+
+
 _ADAPTERS = {
     "vector_add": _adapt_vector_add,
     "sgemm": _adapt_sgemm,
@@ -127,6 +141,7 @@ _ADAPTERS = {
     "scan": _adapt_scan,
     "histogram": _adapt_histogram,
     "nbody": _adapt_nbody,
+    "allreduce": _adapt_allreduce,
 }
 
 

@@ -1,0 +1,18 @@
+"""Distributed layer (SURVEY.md C9): the MPI harness, rebuilt TPU-native.
+
+The reference's multi-node story is MPI: rank topology, domain
+decomposition, `MPI_Sendrecv` halo exchange, `MPI_Allreduce` (measured
+as a bus-bandwidth microbenchmark 8→64 chips). Here the wire is owned
+by the XLA runtime instead: `jax.distributed.initialize()` +
+`jax.sharding.Mesh` over ICI/DCN, with collectives expressed as
+`jax.lax.psum` / `ppermute` / `all_gather` inside `shard_map`. No
+NCCL/Gloo/UCX anywhere.
+
+- ``mesh``        — device mesh construction (single- and multi-host)
+- ``collectives`` — distributed kernel variants: row-sharded stencil
+                    with ppermute halos, i-sharded N-body with a
+                    j-block ring, plain allreduce
+- ``busbw``       — the allreduce bus-bandwidth microbenchmark
+"""
+
+from tpukernels.parallel.mesh import make_mesh, maybe_distributed_init  # noqa: F401

@@ -1,0 +1,93 @@
+"""Allreduce bus-bandwidth microbenchmark (SURVEY.md C9, §3(d)).
+
+The reference's measured metric: MPI_Allreduce bus bandwidth swept
+over message sizes at 8→64 ranks. Bus bandwidth uses the standard
+ring-allreduce accounting:
+
+    bus_bw = 2 * (n-1)/n * bytes / t
+
+Here the allreduce is `jax.lax.psum` under `shard_map` over the ICI
+ring; run on a v5e pod slice this measures achieved ICI bandwidth.
+On fewer chips it still runs (n=1 is a degenerate no-comm copy) so
+the C driver's acceptance check works anywhere.
+
+CLI:  python -m tpukernels.parallel.busbw [--min=1KB] [--max=64MB]
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpukernels.parallel.collectives import allreduce_sum
+from tpukernels.parallel.mesh import make_mesh, maybe_distributed_init
+
+
+def bus_bandwidth(seconds: float, nbytes: int, nranks: int) -> float:
+    """GB/s by ring-allreduce algorithm-bandwidth accounting."""
+    if nranks <= 1:
+        return nbytes / seconds / 1e9
+    return 2.0 * (nranks - 1) / nranks * nbytes / seconds / 1e9
+
+
+def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
+          reps: int = 10, mesh=None, verbose: bool = True):
+    """Time psum-allreduce over message sizes; returns
+    [(bytes, seconds, busbw_GBps)]."""
+    if mesh is None:
+        maybe_distributed_init()
+        mesh = make_mesh()
+    nranks = mesh.shape["x"]
+    results = []
+    size = min_bytes
+    while size <= max_bytes:
+        elems = max(size // 4, 1)
+        x = jnp.ones((nranks, elems), jnp.float32)
+
+        fn = jax.jit(
+            lambda v: allreduce_sum(v, mesh).ravel()[:1]
+        )
+        # warm-up (compile) then per-call timing with a 4-byte
+        # materialization to force real completion (device-side
+        # block_until_ready is unreliable through the axon tunnel)
+        np.asarray(fn(x))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(fn(x))
+            t1 = time.perf_counter()
+            best = min(best, t1 - t0)
+        bw = bus_bandwidth(best, size, nranks)
+        results.append((size, best, bw))
+        if verbose:
+            print(
+                f"allreduce n={nranks} size={size:>10d}B "
+                f"time={best * 1e3:9.3f}ms busbw={bw:8.3f} GB/s"
+            )
+        size *= 4
+    return results
+
+
+def _parse_size(s: str) -> int:
+    s = s.upper().rstrip("B")
+    for suffix, mult in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30)):
+        if s.endswith(suffix):
+            return int(float(s[:-1]) * mult)
+    return int(s)
+
+
+if __name__ == "__main__":
+    import sys
+
+    kw = {}
+    for a in sys.argv[1:]:
+        if a.startswith("--min="):
+            kw["min_bytes"] = _parse_size(a[6:])
+        elif a.startswith("--max="):
+            kw["max_bytes"] = _parse_size(a[6:])
+        elif a.startswith("--reps="):
+            kw["reps"] = int(a[7:])
+    sweep(**kw)

@@ -1,0 +1,222 @@
+"""Distributed kernel variants (SURVEY.md C9, §3(b)-(d)).
+
+Each function is the TPU-native rebuild of one of the reference's MPI
+patterns, as a `shard_map` program over a 1-D ring mesh:
+
+- `allreduce_sum`    — MPI_Allreduce               → jax.lax.psum
+- `jacobi2d_dist`    — halo MPI_Sendrecv + sweep   → ppermute halos,
+                        fused into the per-iteration XLA program
+- `nbody_dist_psum`  — partial forces allreduced   → psum (the
+                        north-star's named formulation)
+- `nbody_dist_ring`  — ring body-block rotation    → ppermute ring
+                        (memory O(N/P) per chip; the ring-attention
+                        structural analog, SURVEY.md §5)
+
+On the dev box these are logic-tested on 8 fake CPU devices
+(tests/test_distributed.py spawns subprocesses with the right env);
+on a real v5e pod the same code rides ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from tpukernels.utils import cdiv
+
+
+def _ring_perm(n: int, shift: int = 1):
+    """(src, dst) pairs rotating data `shift` ranks forward."""
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+# ------------------------------------------------------------ allreduce
+
+def allreduce_sum(x, mesh: Mesh, axis: str = "x"):
+    """MPI_Allreduce(SUM): x is (P, S) with row r = rank r's
+    contribution; every row of the result is the elementwise sum."""
+    f = shard_map(
+        lambda xl: jax.lax.psum(xl, axis),
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(axis, None),
+    )
+    return f(x)
+
+
+# ------------------------------------------------------------- stencil
+
+def jacobi2d_dist(x, iters: int, mesh: Mesh, axis: str = "x"):
+    """Row-sharded Jacobi 5-point: halo exchange via ppermute, sweep
+    locally; comm + compute fuse into one XLA program per iteration
+    (SURVEY.md §3(b)). x: (H, W) float32 with H % P == 0."""
+    nranks = mesh.shape[axis]
+    h, w = x.shape
+    if h % nranks:
+        raise ValueError(f"H={h} must divide across {nranks} ranks")
+    lh = h // nranks
+
+    up_perm = _ring_perm(nranks, 1)  # my last row -> (r+1)'s top halo
+    down_perm = _ring_perm(nranks, -1)  # my first row -> (r-1)'s bottom
+
+    def local_fn(xl):  # (lh, w) local rows
+        rank = jax.lax.axis_index(axis)
+
+        def sweep(_, v):
+            top_halo = jax.lax.ppermute(v[-1:], axis, up_perm)
+            bot_halo = jax.lax.ppermute(v[:1], axis, down_perm)
+            padded = jnp.concatenate([top_halo, v, bot_halo], axis=0)
+            north = padded[:-2]
+            south = padded[2:]
+            west = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+            east = jnp.concatenate([v[:, 1:], v[:, -1:]], axis=1)
+            out = 0.25 * (north + south + west + east)
+            gr = rank * lh + jax.lax.broadcasted_iota(jnp.int32, (lh, w), 0)
+            gc = jax.lax.broadcasted_iota(jnp.int32, (lh, w), 1)
+            interior = (gr > 0) & (gr < h - 1) & (gc > 0) & (gc < w - 1)
+            return jnp.where(interior, out, v)
+
+        return jax.lax.fori_loop(0, iters, sweep, xl)
+
+    f = shard_map(
+        local_fn, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None)
+    )
+    return jax.jit(f)(x)
+
+
+# -------------------------------------------------------------- nbody
+
+def _pairwise_accel(pxi, pyi, pzi, jx, jy, jz, jm, eps2, chunk=2048):
+    """Accelerations on i-bodies from j-bodies, chunked over j."""
+    nj = jx.shape[0]
+    nchunks = cdiv(nj, chunk)
+    if nj % chunk:
+        pad = nchunks * chunk - nj
+        jx = jnp.pad(jx, (0, pad))
+        jy = jnp.pad(jy, (0, pad))
+        jz = jnp.pad(jz, (0, pad))
+        jm = jnp.pad(jm, (0, pad))  # zero mass: no contribution
+
+    def body(c, acc):
+        ax, ay, az = acc
+        sl = jax.lax.dynamic_slice_in_dim
+        cx = sl(jx, c * chunk, chunk)
+        cy = sl(jy, c * chunk, chunk)
+        cz = sl(jz, c * chunk, chunk)
+        cm = sl(jm, c * chunk, chunk)
+        dx = cx[None, :] - pxi[:, None]
+        dy = cy[None, :] - pyi[:, None]
+        dz = cz[None, :] - pzi[:, None]
+        r2 = dx * dx + dy * dy + dz * dz + eps2
+        inv_r = jax.lax.rsqrt(r2)
+        w = cm[None, :] * inv_r * inv_r * inv_r
+        return (
+            ax + jnp.sum(w * dx, axis=1),
+            ay + jnp.sum(w * dy, axis=1),
+            az + jnp.sum(w * dz, axis=1),
+        )
+
+    zero = jnp.zeros_like(pxi)
+    return jax.lax.fori_loop(0, nchunks, body, (zero, zero, zero))
+
+
+def nbody_dist_psum(state, steps: int, mesh: Mesh, axis: str = "x",
+                    dt=1e-3, eps=1e-2):
+    """North-star formulation: bodies partitioned as force *sources*
+    (j sharded), positions replicated; each rank computes partial
+    forces on all bodies from its j-partition, then `psum` combines
+    (SURVEY.md C8/§3(c)). state = (px,py,pz,vx,vy,vz,m), all (N,)."""
+    px, py, pz, vx, vy, vz, m = state
+    dt = jnp.float32(dt)
+    eps2 = jnp.float32(eps * eps)
+
+    def local_fn(px, py, pz, vx, vy, vz, ml):
+        # px..vz replicated (N,); ml local shard (N/P,)
+        nranks = jax.lax.psum(1, axis)
+        n = px.shape[0]
+        lsz = n // nranks
+        rank = jax.lax.axis_index(axis)
+
+        def step(_, s):
+            px, py, pz, vx, vy, vz = s
+            j0 = rank * lsz
+            sl = jax.lax.dynamic_slice_in_dim
+            jx, jy, jz = (sl(a, j0, lsz) for a in (px, py, pz))
+            ax, ay, az = _pairwise_accel(px, py, pz, jx, jy, jz, ml, eps2)
+            ax = jax.lax.psum(ax, axis)
+            ay = jax.lax.psum(ay, axis)
+            az = jax.lax.psum(az, axis)
+            vx = vx + ax * dt
+            vy = vy + ay * dt
+            vz = vz + az * dt
+            return (px + vx * dt, py + vy * dt, pz + vz * dt, vx, vy, vz)
+
+        return jax.lax.fori_loop(0, steps, step, (px, py, pz, vx, vy, vz))
+
+    rep = P()
+    shard = P(axis)
+    f = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(rep, rep, rep, rep, rep, rep, shard),
+        out_specs=(rep, rep, rep, rep, rep, rep),
+        check_rep=False,  # psum of replicated inputs is intentional
+    )
+    return jax.jit(f)(px, py, pz, vx, vy, vz, m)
+
+
+def nbody_dist_ring(state, steps: int, mesh: Mesh, axis: str = "x",
+                    dt=1e-3, eps=1e-2):
+    """Ring formulation: i-bodies sharded, j-blocks rotate around the
+    ring via ppermute (memory O(N/P) per chip) — the reference's
+    Sendrecv body-rotation pipeline (SURVEY.md §2 C8, §5 'ring
+    communication'). state arrays (N,), N % P == 0."""
+    px, py, pz, vx, vy, vz, m = state
+    dt = jnp.float32(dt)
+    eps2 = jnp.float32(eps * eps)
+    nranks = mesh.shape[axis]
+    perm = _ring_perm(nranks, 1)
+
+    def local_fn(pxl, pyl, pzl, vxl, vyl, vzl, ml):
+        def step(_, s):
+            pxl, pyl, pzl, vxl, vyl, vzl = s
+
+            def ring(k, carry):
+                ax, ay, az, jx, jy, jz, jm = carry
+                dax, day, daz = _pairwise_accel(
+                    pxl, pyl, pzl, jx, jy, jz, jm, eps2
+                )
+                jx = jax.lax.ppermute(jx, axis, perm)
+                jy = jax.lax.ppermute(jy, axis, perm)
+                jz = jax.lax.ppermute(jz, axis, perm)
+                jm = jax.lax.ppermute(jm, axis, perm)
+                return (ax + dax, ay + day, az + daz, jx, jy, jz, jm)
+
+            zero = jnp.zeros_like(pxl)
+            ax, ay, az, *_ = jax.lax.fori_loop(
+                0, nranks, ring, (zero, zero, zero, pxl, pyl, pzl, ml)
+            )
+            vxl = vxl + ax * dt
+            vyl = vyl + ay * dt
+            vzl = vzl + az * dt
+            return (
+                pxl + vxl * dt, pyl + vyl * dt, pzl + vzl * dt,
+                vxl, vyl, vzl,
+            )
+
+        return jax.lax.fori_loop(
+            0, steps, step, (pxl, pyl, pzl, vxl, vyl, vzl)
+        )
+
+    shard = P(axis)
+    f = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(shard,) * 7,
+        out_specs=(shard,) * 6,
+    )
+    return jax.jit(f)(px, py, pz, vx, vy, vz, m)

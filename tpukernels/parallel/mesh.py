@@ -1,0 +1,57 @@
+"""Device mesh helpers (SURVEY.md C9; patterns cf. SNIPPETS.md [1]-[3]).
+
+The reference's `MPI_Init` + rank topology becomes: optionally
+`jax.distributed.initialize()` (multi-host), then a named 1-D ring
+mesh over however many chips are visible. The C driver runs once per
+host with identical args — the moral equivalent of `mpirun` — and the
+XLA runtime owns the wire (SURVEY.md §3(d), §5).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def maybe_distributed_init() -> None:
+    """Initialize multi-host JAX when launched under a coordinator.
+
+    Single-process single-host (the common case, and always the case
+    on this 1-chip dev box) needs nothing. Multi-host runs set the
+    standard env vars; mirror mpirun's contract by only initializing
+    when they are present.
+    """
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    ):
+        jax.distributed.initialize()
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "x") -> Mesh:
+    """A 1-D ring mesh over the first `n_devices` devices (default all).
+
+    All the reference's communication patterns (halo sendrecv, ring
+    body rotation, allreduce) are 1-D ring patterns, so a 1-D mesh is
+    the faithful topology; ICI ring ordering is what
+    `jax.lax.ppermute` rides on.
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(
+            f"requested {n_devices} devices, have {len(devs)}"
+        )
+    return Mesh(np.array(devs[:n_devices]), (axis,))
+
+
+def row_sharding(mesh: Mesh, axis: str = "x") -> NamedSharding:
+    """Shard the leading dim across the mesh (domain decomposition)."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
