@@ -1,10 +1,14 @@
 """Test harness config (SURVEY.md §4 rebuild test plan).
 
-Tests run on CPU with 8 fake devices so Pallas kernels exercise
-interpret mode and collective lowering is validated without TPU
-hardware (the driver separately compile-checks the real-TPU and
-multi-chip paths). These env vars must be set before jax is imported
-anywhere in the test process.
+Intent: run on CPU with 8 fake devices so Pallas kernels exercise
+interpret mode without TPU hardware. On a plain machine the env vars
+below accomplish that. On this dev box, sitecustomize force-registers
+the axon TPU backend at interpreter start (overriding JAX_PLATFORMS),
+so the kernel tests actually run COMPILED on the real chip — stricter
+coverage, same assertions. To force the CPU path here, launch as:
+  PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q
+Collective tests always get fake CPU devices: test_distributed.py
+spawns subprocesses with a scrubbed env.
 """
 
 import os

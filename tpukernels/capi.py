@@ -14,8 +14,32 @@ from __future__ import annotations
 import ctypes
 import json
 import math
+import os
 
 import numpy as np
+
+# Compilation-cache (SURVEY.md §5 "checkpoint/resume"): persist
+# compiled executables across C-driver processes so the timing loop
+# never eats a recompile. Must be set before jax initializes a backend.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
+)
+
+_PROFILE_DIR = os.environ.get("TPU_KERNELS_PROFILE")
+_profiling = False
+
+
+def _maybe_start_profiler():
+    """Opt-in tracing (SURVEY.md §5): TPU_KERNELS_PROFILE=<dir> wraps
+    all shim-dispatched kernel work in a jax.profiler trace
+    (Perfetto/XProf) so MXU utilization and DMA traffic are visible."""
+    global _profiling
+    if _PROFILE_DIR and not _profiling:
+        import jax
+
+        jax.profiler.start_trace(_PROFILE_DIR)
+        _profiling = True
 
 _DTYPES = {
     "f32": np.float32,
@@ -146,6 +170,7 @@ _ADAPTERS = {
 
 
 def run_from_c(kernel: str, params_json: str, addrs) -> int:
+    _maybe_start_profiler()
     p = json.loads(params_json)
     specs = p.get("buffers", [])
     if len(specs) != len(addrs):
