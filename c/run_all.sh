@@ -24,6 +24,11 @@ run() {
   done
 }
 
+if [ "${TPK_TEST_TPU:-0}" = "1" ] && [ -x bin/test_shim_abi ]; then
+  echo "== bin/test_shim_abi"
+  ./bin/test_shim_abi || fail=1
+fi
+
 run vector_add --n=100000
 run sgemm --n=256
 run stencil --n=256 --iters=10
