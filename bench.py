@@ -13,12 +13,35 @@ forces completion by materializing a 4-byte scalar reduction.
 from __future__ import annotations
 
 import json
+import signal
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_BENCH_TIMEOUT_S = 600  # per-benchmark watchdog (tunnel can wedge)
+
+
+class _Timeout(Exception):
+    pass
+
+
+def _with_timeout(fn, seconds=_BENCH_TIMEOUT_S):
+    """Run fn() under SIGALRM so a wedged TPU tunnel skips one metric
+    instead of hanging the whole round."""
+
+    def handler(signum, frame):
+        raise _Timeout(f"exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(seconds)
+    try:
+        return fn()
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 def _timeit(fn, *args, reps=10, warmup=3):
@@ -90,11 +113,13 @@ def main():
         ("scan_hist_melem_s", bench_scan_hist),
     ]:
         try:
-            results[name] = round(fn(), 2)
+            results[name] = round(_with_timeout(fn), 2)
             print(f"# {name}: {results[name]}", file=sys.stderr)
+            sys.stderr.flush()
         except Exception as e:  # keep the headline alive if one fails
             results[name] = None
             print(f"# {name} FAILED: {e}", file=sys.stderr)
+            sys.stderr.flush()
 
     headline = results.get("sgemm_gflops")
     try:
