@@ -2,12 +2,18 @@
 
 Metric of record (BASELINE.md): SGEMM GFLOPS/chip at 1024^3 fp32 on
 the attached TPU. Secondary metrics (stencil Mcells/s, nbody
-Ginter/s, scan/histogram Melem/s) ride along in "details".
+Ginter/s, scan/histogram Melem/s, saxpy GB/s) ride along in "details".
 
-Timing discipline (see .claude/skills/verify/SKILL.md): the axon
-tunnel makes device-side block_until_ready unreliable and early-
-process readings ~100x off, so every measurement warms >= 3 calls and
-forces completion by materializing a 4-byte scalar reduction.
+Timing discipline: the axon PJRT tunnel carries a fixed ~65 ms
+host<->device round-trip per dispatched program, which would swamp any
+sub-ms kernel (a 1024^3 matmul is ~80 us of MXU time). So every metric
+is measured as a *slope*: the kernel's iteration loop runs on-device
+(lax.fori_loop / the kernel's own `iters`/`steps` argument) at two
+repeat counts R_small and R_big, and the per-iteration time is
+(t_big - t_small) / (R_big - R_small). The fixed round-trip and any
+other per-call constant cancels exactly; compile time is excluded by
+warm-up calls as usual. Each loop body carries a data dependence on
+the previous iteration so XLA cannot hoist or batch the work.
 """
 
 from __future__ import annotations
@@ -20,6 +26,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 _BENCH_TIMEOUT_S = 600  # per-benchmark watchdog (tunnel can wedge)
 
@@ -44,8 +51,9 @@ def _with_timeout(fn, seconds=_BENCH_TIMEOUT_S):
         signal.signal(signal.SIGALRM, old)
 
 
-def _timeit(fn, *args, reps=10, warmup=3):
-    """Seconds/call; fn must return something tiny (scalar)."""
+def _timeit(fn, *args, reps=4, warmup=2):
+    """Best-of wall seconds/call; fn must return something tiny so the
+    np.asarray() materialization forces device completion."""
     for _ in range(warmup):
         np.asarray(fn(*args))
     best = float("inf")
@@ -57,6 +65,26 @@ def _timeit(fn, *args, reps=10, warmup=3):
     return best
 
 
+def _slope(make_fn, r_small, r_big):
+    """Marginal seconds per loop iteration.
+
+    make_fn(R) -> (jitted_fn, args) where fn runs R dependent
+    iterations on-device. Timing both R values and dividing the
+    difference cancels the fixed per-dispatch cost (axon tunnel
+    round-trip, host overhead) that a single-call measurement would
+    mis-attribute to the kernel.
+    """
+    f_s, a_s = make_fn(r_small)
+    f_b, a_b = make_fn(r_big)
+    t_s = _timeit(f_s, *a_s)
+    t_b = _timeit(f_b, *a_b)
+    if t_b <= t_s:  # tunnel stall corrupted a reading; don't report garbage
+        raise RuntimeError(
+            f"non-positive slope: t({r_small})={t_s:.4f}s >= t({r_big})={t_b:.4f}s"
+        )
+    return (t_b - t_s) / (r_big - r_small)
+
+
 def bench_sgemm(m=1024):
     from tpukernels.kernels.sgemm import sgemm
 
@@ -64,31 +92,47 @@ def bench_sgemm(m=1024):
     a = jnp.asarray(rng.standard_normal((m, m)), jnp.float32)
     b = jnp.asarray(rng.standard_normal((m, m)), jnp.float32)
     c = jnp.asarray(rng.standard_normal((m, m)), jnp.float32)
-    f = jax.jit(lambda a, b, c: jnp.sum(sgemm(1.5, a, b, 0.5, c)))
-    t = _timeit(f, a, b, c, reps=20)
+
+    def make(R):
+        # beta=0.5 chains each matmul on the previous result (stable:
+        # c_n -> 2*A@B) so the loop cannot be hoisted or parallelized.
+        def f(a, b, c):
+            body = lambda i, cc: sgemm(1.0, a, b, 0.5, cc)
+            return jnp.sum(lax.fori_loop(0, R, body, c))
+
+        return jax.jit(f), (a, b, c)
+
+    t = _slope(make, 50, 750)
     return 2.0 * m**3 / t / 1e9
 
 
-def bench_stencil(n=4096, iters=100):
+def bench_stencil(n=4096):
     from tpukernels.kernels.stencil import jacobi2d
 
     rng = np.random.default_rng(1)
     x = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
-    f = jax.jit(lambda x: jnp.sum(jacobi2d(x, iters)))
-    t = _timeit(f, x, reps=5)
-    return float(n) * n * iters / t / 1e6
+
+    def make(R):
+        return jax.jit(lambda x: jnp.sum(jacobi2d(x, R))), (x,)
+
+    t = _slope(make, 20, 320)
+    return float(n) * n / t / 1e6
 
 
-def bench_nbody(n=65536, steps=2):
+def bench_nbody(n=65536):
     from tpukernels.kernels.nbody import nbody_step
 
     rng = np.random.default_rng(2)
     args = tuple(
         jnp.asarray(rng.standard_normal(n), jnp.float32) for _ in range(6)
     ) + (jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32),)
-    f = jax.jit(lambda *a: jnp.sum(nbody_step(*a, steps=steps)[0]))
-    t = _timeit(f, *args, reps=5)
-    return float(n) * n * steps / t / 1e9
+
+    def make(R):
+        f = jax.jit(lambda *a: jnp.sum(nbody_step(*a, steps=R)[0]))
+        return f, args
+
+    t = _slope(make, 1, 6)
+    return float(n) * n / t / 1e9
 
 
 def bench_scan_hist(n=1 << 22):
@@ -97,11 +141,45 @@ def bench_scan_hist(n=1 << 22):
 
     rng = np.random.default_rng(3)
     x = jnp.asarray(rng.integers(0, 256, n), jnp.int32)
-    f = jax.jit(
-        lambda x: inclusive_scan(x)[:1] + histogram(x, 256)[:1]
-    )
-    t = _timeit(f, x, reps=5)
+
+    def make(R):
+        def f(x):
+            def body(i, carry):
+                xc, acc = carry
+                s = inclusive_scan(xc)
+                h = histogram(xc, 256)
+                # parity of a data-dependent sum; xor keeps values in
+                # [0,256) while chaining each iteration on the last
+                acc = (acc + s[-1] + h[0]) & 1
+                return (xc ^ acc, acc)
+
+            xc, acc = lax.fori_loop(0, R, body, (x, jnp.int32(0)))
+            return jnp.sum(xc[:1]) + acc
+
+        return jax.jit(f), (x,)
+
+    t = _slope(make, 2, 22)
     return float(n) / t / 1e6
+
+
+def bench_saxpy(n=1 << 20):
+    from tpukernels.kernels.vector_add import saxpy
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(n), jnp.float32)
+
+    def make(R):
+        def f(x, y):
+            body = lambda i, yy: saxpy(1e-3, x, yy)
+            return jnp.sum(lax.fori_loop(0, R, body, y)[:1])
+
+        return jax.jit(f), (x, y)
+
+    # ~1.7 us/iter: need a large R delta so the marginal signal (~34 ms)
+    # dominates run-to-run jitter in the ~65 ms fixed dispatch cost.
+    t = _slope(make, 1000, 21000)
+    return 3.0 * 4.0 * n / t / 1e9  # read x, read y, write y
 
 
 def main():
@@ -111,6 +189,7 @@ def main():
         ("stencil2d_mcells_s", bench_stencil),
         ("nbody_ginter_s", bench_nbody),
         ("scan_hist_melem_s", bench_scan_hist),
+        ("saxpy_gb_s", bench_saxpy),
     ]:
         try:
             results[name] = round(_with_timeout(fn), 2)
