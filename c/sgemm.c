@@ -125,9 +125,12 @@ int main(int argc, char **argv) {
             fprintf(stderr, "kernel failed\n");
             return 1;
         }
-        /* fp32 K-length accumulation differs per backend: rel tol
-         * scales with sqrt(K)*eps (SURVEY.md §4) */
-        double rtol = 1e-4, atol = 1e-3;
+        /* fp32 K-length accumulation differs per backend, and
+         * reduced-precision matmul paths (TPU bf16_3x splitting, CUDA
+         * TF32 tensor cores) carry a documented ~3e-4 worst-case rel
+         * error (tpukernels/kernels/sgemm.py) — rtol gives >3x margin
+         * over that at every magnitude (SURVEY.md §4) */
+        double rtol = 1e-3, atol = 1e-3;
         double max_err;
         size_t bad = bench_check_f32(C_run, C_gold, (size_t)M * N, rtol,
                                      atol, &max_err);

@@ -30,7 +30,19 @@ def test_scan_matches_jnp_reference(rng):
     )
 
 
-@pytest.mark.parametrize("n,nbins", [(100000, 256), (2**17, 64), (999, 16), (4096, 1024)])
+@pytest.mark.parametrize(
+    "n,nbins",
+    [
+        (100000, 256),
+        (2**17, 64),
+        (999, 16),
+        (4096, 1024),
+        # nbins that don't divide the block row count: regression for
+        # the in-kernel chunk loop dropping trailing rows
+        (300000, 200),
+        (2**18, 80),
+    ],
+)
 def test_histogram_exact(rng, n, nbins):
     x = jnp.asarray(rng.integers(0, nbins, n), dtype=jnp.int32)
     out = np.asarray(histogram(x, nbins))

@@ -28,36 +28,52 @@ from tpukernels.utils.shapes import LANES
 _BLOCK_ROWS = 256
 
 
-def _hist_kernel(nbins, x_ref, o_ref):
+def _hist_kernel(nbins, chunk, x_ref, o_ref):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
         o_ref[:] = jnp.zeros_like(o_ref)
 
-    block = x_ref[:]  # (bm, 128) int32 values
-    bm = block.shape[0]
-    # 3D broadcast compare: (bm, 128, 1) == (1, 1, nbins) keeps bins on
-    # the lane dim and needs no layout-hostile reshape. The (bm, 128,
-    # nbins) one-hot is the VMEM governor; _pick_bm sizes bm to fit.
+    bm = x_ref.shape[0]
+    # 3D broadcast compare: (chunk, 128, 1) == (1, 1, nbins) keeps bins
+    # on the lane dim and needs no layout-hostile reshape. An int8
+    # one-hot halves the VMEM footprint vs int32 (the compare+add per
+    # (element, bin) is the VPU issue-rate floor either way); the inner
+    # fori_loop keeps only a (chunk, 128, nbins) slab live while the
+    # block is large enough to amortize grid-step overhead.
     bins = jax.lax.broadcasted_iota(jnp.int32, (1, 1, nbins), 2)
-    onehot = (block[:, :, None] == bins).astype(jnp.int32)
-    o_ref[:] += jnp.sum(onehot, axis=(0, 1), keepdims=False)[None, :]
+
+    def body(c, acc):
+        blk = x_ref[pl.ds(c * chunk, chunk), :]
+        onehot = (blk[:, :, None] == bins).astype(jnp.int8)
+        return acc + jnp.sum(onehot, axis=(0, 1), dtype=jnp.int32)[None, :]
+
+    o_ref[:] += jax.lax.fori_loop(
+        0, bm // chunk, body, jnp.zeros((1, nbins), jnp.int32)
+    )
 
 
-def _pick_bm(rows: int, nbins: int) -> int:
-    """Largest block rows whose one-hot fits ~2 MiB of VMEM."""
-    limit = 2 * 1024 * 1024 // (LANES * nbins * 4)
-    return max(8, min(_BLOCK_ROWS, limit // 8 * 8, rows))
+def _pick_chunk(nbins: int) -> int:
+    """Rows per inner one-hot slab: (chunk, 128, nbins) int8 in ~2 MiB."""
+    limit = 2 * 1024 * 1024 // (LANES * nbins)
+    return max(8, min(_BLOCK_ROWS, limit // 8 * 8))
 
 
 @functools.partial(jax.jit, static_argnames=("nbins", "interpret"))
 def _hist_2d(x2, nbins, interpret=False):
+    chunk = _pick_chunk(nbins)
+    # bm must be an exact chunk multiple or the in-kernel loop would
+    # silently skip the trailing bm % chunk rows of every block
+    bm = max(chunk, (2048 // chunk) * chunk)
+    pad_rows = cdiv(x2.shape[0], bm) * bm - x2.shape[0]
+    if pad_rows:
+        # out-of-range pad value: counts nothing
+        x2 = jnp.pad(x2, ((0, pad_rows), (0, 0)), constant_values=nbins)
     rows = x2.shape[0]
-    bm = _pick_bm(rows, nbins)
     grid = (cdiv(rows, bm),)
     return pl.pallas_call(
-        functools.partial(_hist_kernel, nbins),
+        functools.partial(_hist_kernel, nbins, chunk),
         out_shape=jax.ShapeDtypeStruct((1, nbins), jnp.int32),
         grid=grid,
         in_specs=[

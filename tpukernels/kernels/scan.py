@@ -10,9 +10,21 @@ simpler algorithm (SURVEY.md §7 "scan carry on TPU").
 Layout: the 1-D input is reshaped to (rows, 128) lanes. Each grid step
 scans one (bm, 128) block in row-major element order:
 
-    within-row inclusive scan  (cumsum along lanes)
-  + exclusive prefix of row totals  (cumsum along sublanes)
+    within-row inclusive scan  (MXU: block @ upper-triangular ones)
+  + exclusive prefix of row totals  (cumsum along sublanes, VPU)
   + carry from all previous blocks  (SMEM scratch)
+
+The within-row scan runs on the MXU instead of log-step lane shifts:
+lane-axis shifts are cross-lane relayouts (slow on TPU), while a
+(bm, 128) x (128, 128) matmul against an upper-triangular ones matrix
+is one MXU op. For int32 the matmul stays *exact* by splitting each
+value into four 8-bit digits (three masked, top one arithmetic-shifted
+for the sign): each digit is in [-128, 255], exactly representable in
+the MXU's default single-pass bf16 operand path (8 significand bits —
+a 16-bit split would NOT survive bf16), and every within-row digit
+partial sum is < 128*256 = 2^15, inside the fp32 accumulator's exact
+window. The Horner reconstruction ((d3*256 + d2)*256 + d1)*256 + d0
+wraps mod 2^32 exactly like the serial-C oracle's int32 adds.
 """
 
 from __future__ import annotations
@@ -49,6 +61,15 @@ def _cumsum_log(x, axis: int):
     return x
 
 
+def _tri_ones(n: int):
+    """(n, n) upper-triangular ones: U[k, c] = 1 iff k <= c, so
+    (x @ U)[r, c] = sum_{k<=c} x[r, k] — an inclusive lane scan as one
+    MXU matmul."""
+    rk = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    ck = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    return (rk <= ck).astype(jnp.float32)
+
+
 def _scan_kernel(x_ref, o_ref, carry_ref):
     i = pl.program_id(0)
 
@@ -57,11 +78,42 @@ def _scan_kernel(x_ref, o_ref, carry_ref):
         carry_ref[0] = jnp.zeros((), x_ref.dtype)
 
     block = x_ref[:]
-    within = _cumsum_log(block, axis=1)
-    row_tot = within[:, -1:]
+    lanes = block.shape[1]
+    u = _tri_ones(lanes)
+    if jnp.issubdtype(block.dtype, jnp.integer):
+        # exact int32 on the MXU at default (single-pass bf16)
+        # precision: split each value into four 8-bit digits — every
+        # digit is in [-128, 255] (exact in bf16) and every within-row
+        # digit partial sum is < 128*256 = 2^15 (exact in the MXU's
+        # fp32 accumulator). Horner reconstruction in int32 wraps
+        # mod 2^32 exactly like the serial oracle's adds.
+        digits = [
+            (block & 0xFF),
+            (jax.lax.shift_right_logical(block, 8) & 0xFF),
+            (jax.lax.shift_right_logical(block, 16) & 0xFF),
+            jax.lax.shift_right_arithmetic(block, 24),
+        ]
+        scans = [
+            jnp.dot(
+                d.astype(jnp.float32), u,
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            for d in digits
+        ]
+        within = scans[3]
+        for s in (scans[2], scans[1], scans[0]):
+            within = within * 256 + s
+    else:
+        within = jnp.dot(
+            block, u,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    row_tot = within[:, lanes - 1 : lanes]
     # Mosaic can't concat (k, 1)-shaped single-lane arrays ("offset
     # mismatch on non-concat dimension"), so run the sublane scan on a
-    # full-lane broadcast and take one column.
+    # full-lane broadcast and take one column. Sublane shifts are cheap
+    # (no cross-lane relayout), unlike the lane shifts the MXU replaced.
     row_tot_b = jnp.broadcast_to(row_tot, block.shape)
     row_prefix_incl = _cumsum_log(row_tot_b, axis=0)[:, :1]
     o_ref[:] = within + (row_prefix_incl - row_tot) + carry_ref[0]
@@ -96,7 +148,9 @@ def inclusive_scan(x, interpret: bool | None = None):
         interpret = default_interpret()
     n = x.size
     x = x.reshape(-1)
-    padded = cdiv(n, LANES) * LANES
+    rows = max(cdiv(n, LANES), 1)
+    bm = min(_BLOCK_ROWS, rows)  # mirrors _scan_2d's choice
+    padded = cdiv(rows, bm) * bm * LANES
     if padded != n:
         x = jnp.pad(x, (0, padded - n))  # zeros don't disturb the scan
     out = _scan_2d(x.reshape(-1, LANES), interpret=interpret)

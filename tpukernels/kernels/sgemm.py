@@ -14,9 +14,10 @@ MXU precision: fp32 matmuls are emulated on the bf16 systolic array by
 multi-pass splitting. Default is 'high' (bf16_3x): measured 50.9 vs
 28.7 TFLOPS for 'float32' (bf16_6x) at 1024^3 on v5 lite. Worst-case
 rel error of the 3x split is ~3e-4 (the dropped lo@lo term; typical
-elements land ~1e-5) — inside the C golden checker's acceptance bar
-(rtol 1e-4 + atol 1e-3, c/sgemm.c) and the 'high' unit-test tolerance,
-and analogous to CUDA SGEMM on TF32 tensor cores. Set
+elements land ~1e-5) — the C golden checker's acceptance bar
+(rtol 1e-3 + atol 1e-3, c/sgemm.c) keeps >3x margin over that at
+every element magnitude, analogous to CUDA SGEMM on TF32 tensor
+cores. Set
 TPK_SGEMM_PRECISION=float32 (or pass precision=) for fp32-faithful
 accumulation (rtol 2e-5 contract) at half the speed. Caveat shared by
 every bf16-split scheme (including XLA's): inputs with |x| > bf16 max
