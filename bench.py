@@ -119,6 +119,40 @@ def bench_stencil(n=4096):
     return float(n) * n / t / 1e6
 
 
+def bench_stencil3d(n=384):
+    from tpukernels.kernels.stencil import jacobi3d
+
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.standard_normal((n, n, n)), jnp.float32)
+
+    def make(R):
+        return jax.jit(lambda x: jnp.sum(jacobi3d(x, R))), (x,)
+
+    t = _slope(make, 8, 64)
+    return float(n) ** 3 / t / 1e6
+
+
+def bench_saxpy_stream(n=1 << 26):
+    """Streaming SAXPY: working set (512 MiB) far exceeds VMEM, so this
+    measures sustained HBM bandwidth, unlike bench_saxpy's VMEM-resident
+    N=2^20 config of record."""
+    from tpukernels.kernels.vector_add import saxpy
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(n), jnp.float32)
+
+    def make(R):
+        def f(x, y):
+            body = lambda i, yy: saxpy(1e-3, x, yy)
+            return jnp.sum(lax.fori_loop(0, R, body, y)[:1])
+
+        return jax.jit(f), (x, y)
+
+    t = _slope(make, 10, 110)
+    return 3.0 * 4.0 * n / t / 1e9
+
+
 def bench_nbody(n=65536):
     from tpukernels.kernels.nbody import nbody_step
 
@@ -187,9 +221,11 @@ def main():
     for name, fn in [
         ("sgemm_gflops", bench_sgemm),
         ("stencil2d_mcells_s", bench_stencil),
+        ("stencil3d_mcells_s", bench_stencil3d),
         ("nbody_ginter_s", bench_nbody),
         ("scan_hist_melem_s", bench_scan_hist),
         ("saxpy_gb_s", bench_saxpy),
+        ("saxpy_stream_gb_s", bench_saxpy_stream),
     ]:
         try:
             results[name] = round(_with_timeout(fn), 2)

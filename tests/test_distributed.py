@@ -71,6 +71,25 @@ def test_jacobi2d_dist_matches_single_device():
     assert "OK" in out
 
 
+@pytest.mark.parametrize("k", [1, 2, 8, 64])
+def test_jacobi2d_dist_comm_avoiding_k(k):
+    # result must be bitwise independent of the halo depth (k=64
+    # exceeds the 32-row local shard and exercises the clamp)
+    out = run_cpu8(f"""
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import jacobi2d_dist
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((256, 128)), jnp.float32)
+        out = np.asarray(jacobi2d_dist(x, 7, mesh, k={k}))
+        ref = np.asarray(jacobi2d_dist(x, 7, mesh, k=1))
+        np.testing.assert_array_equal(out, ref)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
 @pytest.mark.parametrize("variant", ["psum", "ring"])
 def test_nbody_dist_matches_single_device(variant):
     out = run_cpu8(f"""

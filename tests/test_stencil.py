@@ -48,6 +48,18 @@ def test_jacobi2d_blocked_path(rng):
     )
 
 
+@pytest.mark.parametrize("k,iters", [(1, 3), (2, 5), (4, 4), (8, 13), (8, 16)])
+def test_jacobi2d_temporal_blocking(rng, k, iters):
+    # exercises full k-sweep passes AND the iters % k remainder pass;
+    # result must be bit-for-bit independent of the fusion depth
+    x = jnp.asarray(rng.standard_normal((1024, 1536)), dtype=jnp.float32)
+    out = jacobi2d(x, iters, k=k)
+    ref = jacobi2d(x, iters, k=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    ref64 = _numpy_jacobi2d(np.asarray(x), iters)
+    np.testing.assert_allclose(np.asarray(out), ref64, rtol=1e-4, atol=1e-5)
+
+
 def _numpy_jacobi3d(x, iters):
     x = np.array(x, dtype=np.float64)
     for _ in range(iters):
@@ -76,6 +88,23 @@ def test_jacobi3d_blocked_path(rng):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
     )
+
+
+@pytest.mark.parametrize("k,iters", [(2, 3), (4, 9)])
+def test_jacobi3d_temporal_blocking(rng, k, iters):
+    # 64*64*384*4 B = 6 MiB > _SMALL_BYTES: genuinely exercises the
+    # blocked path (incl. the iters % k remainder pass with its fixed
+    # ghost depth); 64x64x256 would tie the threshold and silently
+    # take the small path, which ignores k
+    from tpukernels.kernels import stencil as _st
+
+    x = jnp.asarray(rng.standard_normal((64, 64, 384)), dtype=jnp.float32)
+    assert 64 * 64 * 384 * 4 > _st._SMALL_BYTES
+    out = jacobi3d(x, iters, k=k)
+    ref = jacobi3d(x, iters, k=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    ref64 = _numpy_jacobi3d(np.asarray(x), iters)
+    np.testing.assert_allclose(np.asarray(out), ref64, rtol=1e-4, atol=1e-5)
 
 
 def test_boundary_held_fixed(rng):

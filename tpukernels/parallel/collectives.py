@@ -50,37 +50,59 @@ def allreduce_sum(x, mesh: Mesh, axis: str = "x"):
 
 # ------------------------------------------------------------- stencil
 
-def jacobi2d_dist(x, iters: int, mesh: Mesh, axis: str = "x"):
+def jacobi2d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4):
     """Row-sharded Jacobi 5-point: halo exchange via ppermute, sweep
     locally; comm + compute fuse into one XLA program per iteration
-    (SURVEY.md §3(b)). x: (H, W) float32 with H % P == 0."""
+    (SURVEY.md §3(b)). x: (H, W) float32 with H % P == 0.
+
+    Comm-avoiding: each round ppermutes a k-deep halo band and runs k
+    fused local sweeps (the multi-chip mirror of the single-chip
+    temporal blocking in kernels/stencil.py), trading k x halo bytes
+    for 1/k as many ICI message rounds. Halo rows go stale one-per-
+    sweep inward — k-deep halos bound that, so owned rows stay exact
+    and the result is bitwise independent of k. Ring-wrapped halos at
+    the global top/bottom carry wrong values, but those rows sit
+    outside the Dirichlet interior mask and are never read by an
+    unmasked row."""
     nranks = mesh.shape[axis]
     h, w = x.shape
     if h % nranks:
         raise ValueError(f"H={h} must divide across {nranks} ranks")
     lh = h // nranks
+    k = max(1, min(int(k), lh))
 
-    up_perm = _ring_perm(nranks, 1)  # my last row -> (r+1)'s top halo
-    down_perm = _ring_perm(nranks, -1)  # my first row -> (r-1)'s bottom
+    up_perm = _ring_perm(nranks, 1)  # my last rows -> (r+1)'s top halo
+    down_perm = _ring_perm(nranks, -1)  # my first rows -> (r-1)'s bottom
 
     def local_fn(xl):  # (lh, w) local rows
         rank = jax.lax.axis_index(axis)
 
-        def sweep(_, v):
-            top_halo = jax.lax.ppermute(v[-1:], axis, up_perm)
-            bot_halo = jax.lax.ppermute(v[:1], axis, down_perm)
-            padded = jnp.concatenate([top_halo, v, bot_halo], axis=0)
-            north = padded[:-2]
-            south = padded[2:]
-            west = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
-            east = jnp.concatenate([v[:, 1:], v[:, -1:]], axis=1)
-            out = 0.25 * (north + south + west + east)
-            gr = rank * lh + jax.lax.broadcasted_iota(jnp.int32, (lh, w), 0)
-            gc = jax.lax.broadcasted_iota(jnp.int32, (lh, w), 1)
+        def rounds(v, kk):
+            top_halo = jax.lax.ppermute(v[-kk:], axis, up_perm)
+            bot_halo = jax.lax.ppermute(v[:kk], axis, down_perm)
+            p = jnp.concatenate([top_halo, v, bot_halo], axis=0)
+            rows = lh + 2 * kk
+            gr = (
+                rank * lh
+                - kk
+                + jax.lax.broadcasted_iota(jnp.int32, (rows, w), 0)
+            )
+            gc = jax.lax.broadcasted_iota(jnp.int32, (rows, w), 1)
             interior = (gr > 0) & (gr < h - 1) & (gc > 0) & (gc < w - 1)
-            return jnp.where(interior, out, v)
+            for _ in range(kk):
+                north = jnp.concatenate([p[:1], p[:-1]], axis=0)
+                south = jnp.concatenate([p[1:], p[-1:]], axis=0)
+                west = jnp.concatenate([p[:, :1], p[:, :-1]], axis=1)
+                east = jnp.concatenate([p[:, 1:], p[:, -1:]], axis=1)
+                out = 0.25 * (north + south + west + east)
+                p = jnp.where(interior, out, p)
+            return p[kk : kk + lh]
 
-        return jax.lax.fori_loop(0, iters, sweep, xl)
+        passes, rem = divmod(iters, k)
+        v = jax.lax.fori_loop(0, passes, lambda _, v: rounds(v, k), xl)
+        if rem:
+            v = rounds(v, rem)
+        return v
 
     f = shard_map(
         local_fn, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None)
