@@ -1,0 +1,93 @@
+"""C-shim marshalling layer tests (SURVEY.md C10, Python side).
+
+Exercises tpukernels.capi.run_from_c exactly as the C shim does: raw
+host pointers + a JSON buffer description, results copied back into
+the caller-owned buffers. Complements c/test_shim_abi.c (the C side of
+the ABI) without needing the compiled shim or a TPU.
+"""
+
+import ctypes
+import json
+
+import numpy as np
+import pytest
+
+from tpukernels import capi
+
+
+def _addr(a: np.ndarray) -> int:
+    return a.ctypes.data
+
+
+def test_vector_add_roundtrip(rng):
+    n = 1000
+    x = np.ascontiguousarray(rng.standard_normal(n), dtype=np.float32)
+    y = np.ascontiguousarray(rng.standard_normal(n), dtype=np.float32)
+    want = 2.5 * x + y
+    params = json.dumps(
+        {
+            "alpha": 2.5,
+            "buffers": [
+                {"shape": [n], "dtype": "f32"},
+                {"shape": [n], "dtype": "f32"},
+            ],
+        }
+    )
+    assert capi.run_from_c("vector_add", params, [_addr(x), _addr(y)]) == 0
+    np.testing.assert_allclose(y, want, rtol=1e-6, atol=1e-6)
+
+
+def test_scan_and_histogram_roundtrip(rng):
+    n, nbins = 5000, 64
+    x = np.ascontiguousarray(rng.integers(0, nbins, n), dtype=np.int32)
+    scan_out = np.zeros(n, dtype=np.int32)
+    params = json.dumps(
+        {
+            "buffers": [
+                {"shape": [n], "dtype": "i32"},
+                {"shape": [n], "dtype": "i32"},
+            ]
+        }
+    )
+    assert capi.run_from_c("scan", params, [_addr(x), _addr(scan_out)]) == 0
+    np.testing.assert_array_equal(scan_out, np.cumsum(x))
+
+    counts = np.zeros(nbins, dtype=np.int32)
+    params = json.dumps(
+        {
+            "nbins": nbins,
+            "buffers": [
+                {"shape": [n], "dtype": "i32"},
+                {"shape": [nbins], "dtype": "i32"},
+            ],
+        }
+    )
+    assert capi.run_from_c("histogram", params, [_addr(x), _addr(counts)]) == 0
+    np.testing.assert_array_equal(counts, np.bincount(x, minlength=nbins))
+
+
+def test_stencil2d_roundtrip(rng):
+    h, w = 64, 128
+    x = np.ascontiguousarray(rng.standard_normal((h, w)), dtype=np.float32)
+    orig = x.copy()
+    params = json.dumps(
+        {"iters": 3, "buffers": [{"shape": [h, w], "dtype": "f32"}]}
+    )
+    assert capi.run_from_c("stencil2d", params, [_addr(x)]) == 0
+    # boundary held fixed, interior changed
+    np.testing.assert_array_equal(x[0], orig[0])
+    np.testing.assert_array_equal(x[-1], orig[-1])
+    assert not np.array_equal(x[1:-1, 1:-1], orig[1:-1, 1:-1])
+
+
+def test_buffer_count_mismatch_raises():
+    x = np.zeros(8, dtype=np.float32)
+    params = json.dumps({"buffers": [{"shape": [8], "dtype": "f32"}]})
+    with pytest.raises(ValueError, match="pointers but"):
+        capi.run_from_c("vector_add", params, [_addr(x), _addr(x)])
+
+
+def test_unknown_kernel_raises():
+    params = json.dumps({"buffers": []})
+    with pytest.raises(KeyError, match="no C adapter"):
+        capi.run_from_c("not_a_kernel", params, [])

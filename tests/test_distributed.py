@@ -71,6 +71,26 @@ def test_jacobi2d_dist_matches_single_device():
     assert "OK" in out
 
 
+def test_jacobi3d_dist_matches_single_device():
+    out = run_cpu8("""
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import jacobi3d_dist
+        from tpukernels.kernels.stencil import jacobi3d_reference
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.standard_normal((32, 16, 64)), jnp.float32)
+        # iters=7 with default k=4 exercises a full round + remainder
+        out = np.asarray(jacobi3d_dist(x, 7, mesh))
+        ref = np.asarray(jacobi3d_reference(x, 7))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        ref_k1 = np.asarray(jacobi3d_dist(x, 7, mesh, k=1))
+        np.testing.assert_array_equal(out, ref_k1)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
 @pytest.mark.parametrize("k", [1, 2, 8, 64])
 def test_jacobi2d_dist_comm_avoiding_k(k):
     # result must be bitwise independent of the halo depth (k=64

@@ -50,53 +50,71 @@ def allreduce_sum(x, mesh: Mesh, axis: str = "x"):
 
 # ------------------------------------------------------------- stencil
 
-def jacobi2d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4):
-    """Row-sharded Jacobi 5-point: halo exchange via ppermute, sweep
-    locally; comm + compute fuse into one XLA program per iteration
-    (SURVEY.md §3(b)). x: (H, W) float32 with H % P == 0.
+def _edge_shift(p, ax: int, toward_end: bool):
+    """Neighbor values along `ax` with edge replication: index i gets
+    i-1 (toward_end=True, the 'previous' neighbor) or i+1."""
+    n = p.shape[ax]
+    sl = jax.lax.slice_in_dim
+    if toward_end:
+        return jnp.concatenate(
+            [sl(p, 0, 1, axis=ax), sl(p, 0, n - 1, axis=ax)], axis=ax
+        )
+    return jnp.concatenate(
+        [sl(p, 1, n, axis=ax), sl(p, n - 1, n, axis=ax)], axis=ax
+    )
+
+
+def _jacobi_dist(x, iters: int, mesh: Mesh, axis: str, k: int):
+    """Dimension-generic sharded Jacobi: dim 0 sharded across the mesh
+    axis, halo exchange via ppermute, mean-of-face-neighbors update,
+    Dirichlet boundary.
 
     Comm-avoiding: each round ppermutes a k-deep halo band and runs k
     fused local sweeps (the multi-chip mirror of the single-chip
     temporal blocking in kernels/stencil.py), trading k x halo bytes
-    for 1/k as many ICI message rounds. Halo rows go stale one-per-
-    sweep inward — k-deep halos bound that, so owned rows stay exact
+    for 1/k as many ICI message rounds. Halo slices go stale one-per-
+    sweep inward — k-deep halos bound that, so owned slices stay exact
     and the result is bitwise independent of k. Ring-wrapped halos at
-    the global top/bottom carry wrong values, but those rows sit
-    outside the Dirichlet interior mask and are never read by an
-    unmasked row."""
+    the global ends carry wrong values, but those sit outside the
+    Dirichlet interior mask and are never read by an unmasked cell."""
     nranks = mesh.shape[axis]
-    h, w = x.shape
-    if h % nranks:
-        raise ValueError(f"H={h} must divide across {nranks} ranks")
-    lh = h // nranks
-    k = max(1, min(int(k), lh))
+    dims = x.shape
+    nd = len(dims)
+    if dims[0] % nranks:
+        raise ValueError(
+            f"dim0={dims[0]} must divide across {nranks} ranks"
+        )
+    l0 = dims[0] // nranks
+    k = max(1, min(int(k), l0))
+    scale = 1.0 / (2 * nd)
 
-    up_perm = _ring_perm(nranks, 1)  # my last rows -> (r+1)'s top halo
-    down_perm = _ring_perm(nranks, -1)  # my first rows -> (r-1)'s bottom
+    up_perm = _ring_perm(nranks, 1)  # my last slices -> (r+1)'s top halo
+    down_perm = _ring_perm(nranks, -1)  # my first -> (r-1)'s bottom
 
-    def local_fn(xl):  # (lh, w) local rows
+    def local_fn(xl):  # (l0, *dims[1:]) local block
         rank = jax.lax.axis_index(axis)
 
         def rounds(v, kk):
-            top_halo = jax.lax.ppermute(v[-kk:], axis, up_perm)
-            bot_halo = jax.lax.ppermute(v[:kk], axis, down_perm)
-            p = jnp.concatenate([top_halo, v, bot_halo], axis=0)
-            rows = lh + 2 * kk
-            gr = (
-                rank * lh
-                - kk
-                + jax.lax.broadcasted_iota(jnp.int32, (rows, w), 0)
+            top = jax.lax.ppermute(v[-kk:], axis, up_perm)
+            bot = jax.lax.ppermute(v[:kk], axis, down_perm)
+            p = jnp.concatenate([top, v, bot], axis=0)
+            shape = (l0 + 2 * kk,) + dims[1:]
+            iota = lambda a: jax.lax.broadcasted_iota(  # noqa: E731
+                jnp.int32, shape, a
             )
-            gc = jax.lax.broadcasted_iota(jnp.int32, (rows, w), 1)
-            interior = (gr > 0) & (gr < h - 1) & (gc > 0) & (gc < w - 1)
+            g0 = rank * l0 - kk + iota(0)
+            interior = (g0 > 0) & (g0 < dims[0] - 1)
+            for a in range(1, nd):
+                ga = iota(a)
+                interior &= (ga > 0) & (ga < dims[a] - 1)
             for _ in range(kk):
-                north = jnp.concatenate([p[:1], p[:-1]], axis=0)
-                south = jnp.concatenate([p[1:], p[-1:]], axis=0)
-                west = jnp.concatenate([p[:, :1], p[:, :-1]], axis=1)
-                east = jnp.concatenate([p[:, 1:], p[:, -1:]], axis=1)
-                out = 0.25 * (north + south + west + east)
+                out = scale * sum(
+                    _edge_shift(p, a, fwd)
+                    for a in range(nd)
+                    for fwd in (True, False)
+                )
                 p = jnp.where(interior, out, p)
-            return p[kk : kk + lh]
+            return p[kk : kk + l0]
 
         passes, rem = divmod(iters, k)
         v = jax.lax.fori_loop(0, passes, lambda _, v: rounds(v, k), xl)
@@ -104,10 +122,21 @@ def jacobi2d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4):
             v = rounds(v, rem)
         return v
 
-    f = shard_map(
-        local_fn, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None)
-    )
+    spec = P(axis, *([None] * (nd - 1)))
+    f = shard_map(local_fn, mesh=mesh, in_specs=spec, out_specs=spec)
     return jax.jit(f)(x)
+
+
+def jacobi2d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4):
+    """Row-sharded Jacobi 5-point (SURVEY.md §3(b)): x (H, W) float32,
+    H % P == 0. See _jacobi_dist for the comm-avoiding halo scheme."""
+    return _jacobi_dist(x, iters, mesh, axis, k)
+
+
+def jacobi3d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4):
+    """z-sharded Jacobi 7-point: x (D, H, W) float32, D % P == 0.
+    See _jacobi_dist for the comm-avoiding halo scheme."""
+    return _jacobi_dist(x, iters, mesh, axis, k)
 
 
 # -------------------------------------------------------------- nbody
