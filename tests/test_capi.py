@@ -6,7 +6,6 @@ the caller-owned buffers. Complements c/test_shim_abi.c (the C side of
 the ABI) without needing the compiled shim or a TPU.
 """
 
-import ctypes
 import json
 
 import numpy as np

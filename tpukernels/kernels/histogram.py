@@ -16,6 +16,7 @@ Counts are exact: int32 adds on the VPU.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +29,7 @@ from tpukernels.utils.shapes import LANES
 _BLOCK_ROWS = 256
 
 
-def _hist_kernel(nbins, chunk, x_ref, o_ref):
+def _hist_kernel(nbins, chunk, acc_dtype, x_ref, o_ref):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -37,32 +38,40 @@ def _hist_kernel(nbins, chunk, x_ref, o_ref):
 
     bm = x_ref.shape[0]
     # 3D broadcast compare: (chunk, 128, 1) == (1, 1, nbins) keeps bins
-    # on the lane dim and needs no layout-hostile reshape. An int8
-    # one-hot halves the VMEM footprint vs int32 (the compare+add per
-    # (element, bin) is the VPU issue-rate floor either way); the inner
-    # fori_loop keeps only a (chunk, 128, nbins) slab live while the
-    # block is large enough to amortize grid-step overhead.
+    # on the lane dim and needs no layout-hostile reshape. The
+    # compare+accumulate per (element, bin) is the VPU issue-rate
+    # floor; acc_dtype picks the one-hot/accumulator type (int8 halves
+    # VMEM; float32 counts are exact below 2^24 per block and may issue
+    # at a different VPU rate — see TPK_HIST_ACC). The inner fori_loop
+    # keeps only a (chunk, 128, nbins) slab live while the block stays
+    # large enough to amortize grid-step overhead.
     bins = jax.lax.broadcasted_iota(jnp.int32, (1, 1, nbins), 2)
+    sum_dtype = jnp.float32 if acc_dtype == jnp.float32 else jnp.int32
 
     def body(c, acc):
         blk = x_ref[pl.ds(c * chunk, chunk), :]
-        onehot = (blk[:, :, None] == bins).astype(jnp.int8)
-        return acc + jnp.sum(onehot, axis=(0, 1), dtype=jnp.int32)[None, :]
+        onehot = (blk[:, :, None] == bins).astype(acc_dtype)
+        return acc + jnp.sum(onehot, axis=(0, 1), dtype=sum_dtype)[None, :]
 
-    o_ref[:] += jax.lax.fori_loop(
-        0, bm // chunk, body, jnp.zeros((1, nbins), jnp.int32)
-    )
+    zero = jnp.zeros((1, nbins), sum_dtype)
+    total = jax.lax.fori_loop(0, bm // chunk, body, zero)
+    o_ref[:] += total.astype(jnp.int32)
 
 
-def _pick_chunk(nbins: int) -> int:
-    """Rows per inner one-hot slab: (chunk, 128, nbins) int8 in ~2 MiB."""
-    limit = 2 * 1024 * 1024 // (LANES * nbins)
+def _pick_chunk(nbins: int, acc_dtype) -> int:
+    """Rows per inner one-hot slab: (chunk, 128, nbins) in ~2 MiB at
+    the accumulator dtype's width."""
+    itemsize = jnp.dtype(acc_dtype).itemsize
+    limit = 2 * 1024 * 1024 // (LANES * nbins * itemsize)
     return max(8, min(_BLOCK_ROWS, limit // 8 * 8))
 
 
-@functools.partial(jax.jit, static_argnames=("nbins", "interpret"))
-def _hist_2d(x2, nbins, interpret=False):
-    chunk = _pick_chunk(nbins)
+@functools.partial(
+    jax.jit, static_argnames=("nbins", "acc_name", "interpret")
+)
+def _hist_2d(x2, nbins, acc_name="i8", interpret=False):
+    acc_dtype = jnp.float32 if acc_name == "f32" else jnp.int8
+    chunk = _pick_chunk(nbins, acc_dtype)
     # bm must be an exact chunk multiple or the in-kernel loop would
     # silently skip the trailing bm % chunk rows of every block
     bm = max(chunk, (2048 // chunk) * chunk)
@@ -73,7 +82,7 @@ def _hist_2d(x2, nbins, interpret=False):
     rows = x2.shape[0]
     grid = (cdiv(rows, bm),)
     return pl.pallas_call(
-        functools.partial(_hist_kernel, nbins, chunk),
+        functools.partial(_hist_kernel, nbins, chunk, acc_dtype),
         out_shape=jax.ShapeDtypeStruct((1, nbins), jnp.int32),
         grid=grid,
         in_specs=[
@@ -87,16 +96,26 @@ def _hist_2d(x2, nbins, interpret=False):
 
 
 def histogram(x, nbins: int, interpret: bool | None = None):
-    """Count int32 values in [0, nbins); returns (nbins,) int32."""
+    """Count int32 values in [0, nbins); returns (nbins,) int32.
+
+    Env TPK_HIST_ACC picks the one-hot accumulator dtype: 'i8'
+    (default) or 'f32'. Counts are exact either way (a block's per-bin
+    count is far below 2^24, float32's exact-integer window). Read
+    here, outside jit, so toggling the knob is never masked by a
+    cached trace."""
     if interpret is None:
         interpret = default_interpret()
+    acc_name = os.environ.get("TPK_HIST_ACC", "i8")
     x = x.reshape(-1).astype(jnp.int32)
     n = x.size
     padded = cdiv(n, LANES) * LANES
     if padded != n:
         # pad with an out-of-range value so padding counts nothing
         x = jnp.pad(x, (0, padded - n), constant_values=nbins)
-    out = _hist_2d(x.reshape(-1, LANES), int(nbins), interpret=interpret)
+    out = _hist_2d(
+        x.reshape(-1, LANES), int(nbins), acc_name=acc_name,
+        interpret=interpret,
+    )
     return out.reshape(-1)
 
 
