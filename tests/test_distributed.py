@@ -17,14 +17,24 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_cpu8(body: str) -> str:
+def _scrubbed_env(fake_devices: int | None = 8) -> dict:
+    """Env for a CPU-backend subprocess: drop the axon pool var (the
+    dev box's sitecustomize force-registers the TPU backend when it is
+    set), force CPU, optionally request fake devices."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    if fake_devices:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={fake_devices}"
+        ).strip()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def run_cpu8(body: str) -> str:
+    env = _scrubbed_env(8)
     proc = subprocess.run(
         [sys.executable, "-c", textwrap.dedent(body)],
         env=env,
@@ -131,6 +141,70 @@ def test_nbody_dist_matches_single_device(variant):
         print('OK')
     """)
     assert "OK" in out
+
+
+def test_multiprocess_allreduce():
+    """Real jax.distributed across 2 processes (4 fake CPU devices
+    each, 8 global): the multi-host path the 8→64-chip bus-bw run
+    uses, where the C driver launches once per host with identical
+    args — the moral equivalent of mpirun (SURVEY.md §7)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = textwrap.dedent(f"""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        import jax
+        pid = int(sys.argv[1])
+        jax.distributed.initialize(
+            "127.0.0.1:{port}", num_processes=2, process_id=pid)
+        import numpy as np
+        assert jax.device_count() == 8
+        assert jax.local_device_count() == 4
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import allreduce_sum
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(0)
+        full = rng.standard_normal((8, 256)).astype(np.float32)
+        sharding = NamedSharding(mesh, P("x", None))
+        local_rows = full[pid * 4:(pid + 1) * 4]
+        arrs = [jax.device_put(local_rows[i:i + 1], d)
+                for i, d in enumerate(jax.local_devices())]
+        x = jax.make_array_from_single_device_arrays(
+            (8, 256), sharding, arrs)
+        out = allreduce_sum(x, mesh)
+        local = np.concatenate(
+            [np.asarray(s.data) for s in out.addressable_shards])
+        np.testing.assert_allclose(
+            local, np.tile(full.sum(axis=0), (4, 1)), rtol=1e-5)
+        print(f"proc {{pid}}: OK")
+    """)
+
+    env = _scrubbed_env(fake_devices=None)  # workers set their own
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker, str(i)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {i} failed:\n{out}"
+            assert f"proc {i}: OK" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
 
 def test_busbw_sweep_runs():

@@ -30,6 +30,7 @@ def test_scan_matches_jnp_reference(rng):
     )
 
 
+@pytest.mark.parametrize("acc", ["i8", "f32"])
 @pytest.mark.parametrize(
     "n,nbins",
     [
@@ -43,12 +44,19 @@ def test_scan_matches_jnp_reference(rng):
         (2**18, 80),
     ],
 )
-def test_histogram_exact(rng, n, nbins):
+def test_histogram_exact(rng, monkeypatch, n, nbins, acc):
+    monkeypatch.setenv("TPK_HIST_ACC", acc)
     x = jnp.asarray(rng.integers(0, nbins, n), dtype=jnp.int32)
     out = np.asarray(histogram(x, nbins))
     ref = np.bincount(np.asarray(x), minlength=nbins)
     np.testing.assert_array_equal(out, ref)
     assert out.sum() == n
+
+
+def test_histogram_bad_acc_env_raises(rng, monkeypatch):
+    monkeypatch.setenv("TPK_HIST_ACC", "float32")
+    with pytest.raises(ValueError, match="TPK_HIST_ACC"):
+        histogram(jnp.zeros(16, jnp.int32), 8)
 
 
 def test_histogram_matches_jnp_reference(rng):

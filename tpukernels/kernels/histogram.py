@@ -106,6 +106,10 @@ def histogram(x, nbins: int, interpret: bool | None = None):
     if interpret is None:
         interpret = default_interpret()
     acc_name = os.environ.get("TPK_HIST_ACC", "i8")
+    if acc_name not in ("i8", "f32"):
+        raise ValueError(
+            f"TPK_HIST_ACC={acc_name!r}: expected 'i8' or 'f32'"
+        )
     x = x.reshape(-1).astype(jnp.int32)
     n = x.size
     padded = cdiv(n, LANES) * LANES
