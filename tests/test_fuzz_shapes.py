@@ -1,0 +1,104 @@
+"""Seeded shape-fuzz sweep: every kernel vs its oracle on awkward
+shapes (primes, off-by-one from tile boundaries, tiny). Padding and
+edge-mask logic is where silent corruption hides; this pins it across
+the whole surface with one bounded, deterministic sweep.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukernels.kernels.histogram import histogram
+from tpukernels.kernels.nbody import nbody_reference, nbody_step
+from tpukernels.kernels.scan import inclusive_scan
+from tpukernels.kernels.sgemm import sgemm
+from tpukernels.kernels.stencil import (
+    jacobi2d,
+    jacobi2d_reference,
+    jacobi3d,
+    jacobi3d_reference,
+)
+from tpukernels.kernels.vector_add import saxpy
+
+# off tile boundaries on purpose: primes, 128k+-1, sub-tile
+_SIZES = [1, 7, 127, 128, 129, 1000, 4093, 65537]
+
+
+@pytest.mark.parametrize("n", _SIZES)
+def test_fuzz_saxpy(rng, n):
+    x = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(saxpy(0.7, x, y)),
+        0.7 * np.asarray(x) + np.asarray(y),
+        rtol=1e-6,
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("n", _SIZES)
+def test_fuzz_scan_exact(rng, n):
+    x = jnp.asarray(rng.integers(-1000, 1000, n), jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(inclusive_scan(x)), np.cumsum(np.asarray(x))
+    )
+
+
+@pytest.mark.parametrize("n", [1, 129, 4093])
+@pytest.mark.parametrize("nbins", [1, 3, 17, 256])
+def test_fuzz_histogram_exact(rng, n, nbins):
+    x = jnp.asarray(rng.integers(0, nbins, n), jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(histogram(x, nbins)),
+        np.bincount(np.asarray(x), minlength=nbins),
+    )
+
+
+@pytest.mark.parametrize(
+    "m,n,k",
+    [(1, 1, 1), (3, 5, 7), (127, 129, 130), (8, 513, 64), (256, 1, 300)],
+)
+def test_fuzz_sgemm(rng, m, n, k):
+    a = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+    c = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+    out = np.asarray(sgemm(1.25, a, b, -0.5, c, precision="float32"))
+    want = 1.25 * (
+        np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+    ) - 0.5 * np.asarray(c, np.float64)
+    np.testing.assert_allclose(out, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("shape", [(3, 3), (5, 129), (31, 100), (130, 7)])
+def test_fuzz_jacobi2d(rng, shape):
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(jacobi2d(x, 3)),
+        np.asarray(jacobi2d_reference(x, 3)),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("shape", [(3, 3, 3), (5, 9, 129), (17, 8, 50)])
+def test_fuzz_jacobi3d(rng, shape):
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(jacobi3d(x, 2)),
+        np.asarray(jacobi3d_reference(x, 2)),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("n", [1, 5, 127, 300])
+def test_fuzz_nbody(rng, n):
+    state = tuple(
+        jnp.asarray(rng.standard_normal(n), jnp.float32) for _ in range(6)
+    ) + (jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32),)
+    out = nbody_step(*state, steps=2)
+    ref = nbody_reference(*state, steps=2)
+    for got, want in zip(out, ref):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-5
+        )
