@@ -53,33 +53,37 @@ def _split_bf16(x):
     return hi, lo
 
 
-def _sgemm_kernel(
-    precision, alpha_ref, beta_ref, a_ref, b_ref, c_ref, o_ref, acc_ref
-):
+def _sgemm_kernel(mode, alpha_ref, beta_ref, *refs):
+    """K-accumulating matmul kernel; one scaffolding, two operand modes.
+
+    mode 'split3': refs = (ah, al, bh, bl, c, o, acc) — bf16_3x with
+    the hi/lo split hoisted OUT of the kernel. Neither XLA's
+    Precision.HIGH nor Mosaic lowers HIGH inside Pallas, so the three
+    MXU passes are emitted by hand: a@b ≈ hi@hi + hi@lo + lo@hi, f32
+    accumulate (dropping lo@lo loses ~2^-16 rel, measured 1.5e-5 at
+    K=1024). Splitting in-kernel cost ~2 us of serialized VPU work per
+    512^3 K-step against ~4 us of MXU dots (and re-split each A block
+    once per j, each B block once per i); the wrapper pre-splits once
+    in one fused XLA pass, and the bf16 halves read the same HBM bytes
+    as the f32 originals.
+
+    other modes: refs = (a, b, c, o, acc), mode is the jnp.dot
+    precision ('float32' = bf16_6x, 'default' = single-pass bf16).
+    """
     k = pl.program_id(2)
+    dot = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
+    if mode == "split3":
+        ah, al, bh, bl, c_ref, o_ref, acc_ref = refs
+        update = dot(ah[:], bh[:]) + dot(ah[:], bl[:]) + dot(al[:], bh[:])
+    else:
+        a_ref, b_ref, c_ref, o_ref, acc_ref = refs
+        update = dot(a_ref[:], b_ref[:], precision=mode)
 
     @pl.when(k == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    if precision == "high":
-        # bf16_3x: neither XLA's Precision.HIGH nor Mosaic lowers HIGH
-        # inside Pallas, so emit the three MXU passes by hand:
-        # a@b ≈ hi(a)@hi(b) + hi(a)@lo(b) + lo(a)@hi(b), f32 accumulate.
-        # Dropping lo@lo loses ~2^-16 rel — measured 1.5e-5 at K=1024.
-        a_hi, a_lo = _split_bf16(a_ref[:])
-        b_hi, b_lo = _split_bf16(b_ref[:])
-        dot = functools.partial(
-            jnp.dot, preferred_element_type=jnp.float32
-        )
-        acc_ref[:] += dot(a_hi, b_hi) + dot(a_hi, b_lo) + dot(a_lo, b_hi)
-    else:
-        acc_ref[:] += jnp.dot(
-            a_ref[:],
-            b_ref[:],
-            preferred_element_type=jnp.float32,
-            precision=precision,
-        )
+    acc_ref[:] += update
 
     @pl.when(k == pl.num_programs(2) - 1)
     def _commit():
@@ -95,20 +99,20 @@ def _sgemm_padded(
     m, k = a.shape
     _, n = b.shape
     grid = (cdiv(m, bm), cdiv(n, bn), cdiv(k, bk))
-    return pl.pallas_call(
-        functools.partial(_sgemm_kernel, precision),
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    a_spec = pl.BlockSpec(
+        (bm, bk), lambda i, j, kk: (i, kk), memory_space=pltpu.VMEM
+    )
+    b_spec = pl.BlockSpec(
+        (bk, bn), lambda i, j, kk: (kk, j), memory_space=pltpu.VMEM
+    )
+    c_spec = pl.BlockSpec(
+        (bm, bn), lambda i, j, kk: (i, j), memory_space=pltpu.VMEM
+    )
+    common = dict(
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (bm, bn), lambda i, j, kk: (i, j), memory_space=pltpu.VMEM
-        ),
+        out_specs=c_spec,
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -119,6 +123,19 @@ def _sgemm_padded(
             transcendentals=0,
         ),
         interpret=interpret,
+    )
+    if precision == "high":
+        a_hi, a_lo = _split_bf16(a)
+        b_hi, b_lo = _split_bf16(b)
+        return pl.pallas_call(
+            functools.partial(_sgemm_kernel, "split3"),
+            in_specs=[smem, smem, a_spec, a_spec, b_spec, b_spec, c_spec],
+            **common,
+        )(alpha, beta, a_hi, a_lo, b_hi, b_lo, c)
+    return pl.pallas_call(
+        functools.partial(_sgemm_kernel, precision),
+        in_specs=[smem, smem, a_spec, b_spec, c_spec],
+        **common,
     )(alpha, beta, a, b, c)
 
 
