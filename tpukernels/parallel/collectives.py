@@ -4,8 +4,10 @@ Each function is the TPU-native rebuild of one of the reference's MPI
 patterns, as a `shard_map` program over a 1-D ring mesh:
 
 - `allreduce_sum`    — MPI_Allreduce               → jax.lax.psum
-- `jacobi2d_dist`    — halo MPI_Sendrecv + sweep   → ppermute halos,
-                        fused into the per-iteration XLA program
+- `jacobi2d_dist` /
+  `jacobi3d_dist`    — halo MPI_Sendrecv + sweep   → comm-avoiding
+                        k-deep ppermute halo bands, fused into the
+                        per-round XLA program (shared _jacobi_dist)
 - `nbody_dist_psum`  — partial forces allreduced   → psum (the
                         north-star's named formulation)
 - `nbody_dist_ring`  — ring body-block rotation    → ppermute ring
@@ -19,7 +21,6 @@ on a real v5e pod the same code rides ICI.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
