@@ -123,6 +123,12 @@ def _sgemm_padded(
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+            # The tall-K blocks need ~18 MiB once double-buffered
+            # (B hi+lo at 1024x1024 bf16 is 4 MiB before buffering),
+            # just over Mosaic's 16 MiB default scoped budget. 32 MiB
+            # is safe: flat 2-D buffers, no unrolled-slab compile-time
+            # blowup (cf. docs/PERF.md VMEM note).
+            vmem_limit_bytes=32 * 1024 * 1024,
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * m * n * k,
@@ -173,9 +179,15 @@ def sgemm(
     m, k = a.shape
     k2, n = b.shape
     assert k == k2 and c.shape == (m, n)
-    bm = _pick_block(m, 512, 8)
-    bn = _pick_block(n, 512, 128)
-    bk = _pick_block(k, 512, 128)
+    # Tall-K tiling: (bm,bn,bk)=(256,1024,1024) measured 62 TFLOPS at
+    # 1024^3 vs 48 for 512^3 — with the full K in one dot the kernel
+    # sits at the bf16_3x compute ceiling (single-pass bf16 measures
+    # 184 TFLOPS; /3 = 61). Wide bn amortizes A-block reloads; small
+    # bm keeps A+C+acc VMEM under Mosaic's 16 MiB scoped budget
+    # (B hi+lo at 1024x1024 bf16 is the 4 MiB anchor).
+    bm = _pick_block(m, 256, 8)
+    bn = _pick_block(n, 1024, 128)
+    bk = _pick_block(k, 1024, 128)
     pm, pn, pk = (cdiv(m, bm) * bm, cdiv(n, bn) * bn, cdiv(k, bk) * bk)
     if (pm, pk) != (m, k):
         a = jnp.pad(a, ((0, pm - m), (0, pk - k)))
