@@ -2,7 +2,33 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpukernels.kernels.sgemm import sgemm, sgemm_reference
+from tpukernels.kernels.sgemm import _pick_block, sgemm, sgemm_reference
+
+
+@pytest.mark.parametrize(
+    "dim,preferred,align,expect",
+    [
+        # benchmark-scale shapes the kernel tests never reach: the
+        # picker must neither collapse to degenerate tiles (strict
+        # padding minimization) nor pad ~2x (blind preferred blocks)
+        (2176, 1024, 128, 768),   # not bk=128 (17 K-steps), pad 6%
+        (2176, 2048, 128, 1152),  # not bn=2048 (would pad to 4096)
+        (1042, 256, 8, 216),      # not bm=8 (6% MXU row utilization)
+        (1023, 1024, 128, 1024),  # one full-K step, not 8x bk=128
+        (3072, 2048, 128, 1536),  # exact divisor beats bigger+pad
+        # aligned shapes keep full-size blocks
+        (1024, 1024, 128, 1024),
+        (2048, 2048, 128, 2048),
+        (65536, 256, 8, 256),
+        # small dims: single (possibly sub-align) block
+        (100, 256, 8, 104),
+        (100, 2048, 128, 100),
+    ],
+)
+def test_pick_block(dim, preferred, align, expect):
+    b = _pick_block(dim, preferred, align)
+    assert b == expect
+    assert b <= preferred and (b <= align or b % align == 0)
 
 
 # Tolerances are per-precision contracts: 'float32' (bf16_6x) must be

@@ -6,13 +6,14 @@ Metric of record: GFLOPS/chip = 2*M*N*K / t (BASELINE.md).
 TPU design: MXU-tiled Pallas matmul. Grid is (M/bm, N/bn, K/bk) with the
 K dimension innermost (sequential on TPU), accumulating partial products
 into a float32 VMEM scratch block and committing alpha*acc + beta*C on
-the final K step. Block sizes default to 512^3 (five 1 MiB f32 tiles in
-VMEM, measured fastest at 1024^3) and every matmul is a multiple of the
-128x128 systolic array.
+the final K step. Blocks default to tall-K tiles (bm,bn,bk) =
+(256, N up to 2048, 1024) — measured at the bf16_3x compute ceiling,
+see docs/PERF.md — and every matmul is a multiple of the 128x128
+systolic array.
 
 MXU precision: fp32 matmuls are emulated on the bf16 systolic array by
-multi-pass splitting. Default is 'high' (bf16_3x): measured 50.9 vs
-28.7 TFLOPS for 'float32' (bf16_6x) at 1024^3 on v5 lite. Worst-case
+multi-pass splitting. Default is 'high' (bf16_3x): measured 60-64 vs
+29.8 TFLOPS for 'float32' (bf16_6x) at 1024^3 on v5 lite. Worst-case
 rel error of the 3x split is ~3e-4 (the dropped lo@lo term; typical
 elements land ~1e-5) — the C golden checker's acceptance bar
 (rtol 1e-3 + atol 1e-3, c/sgemm.c) keeps >3x margin over that at
@@ -38,11 +39,31 @@ from tpukernels.utils import cdiv, default_interpret
 
 
 def _pick_block(dim: int, preferred: int, align: int) -> int:
-    if dim >= preferred:
-        return preferred
-    if dim % align == 0:
+    """Aligned block size <= preferred balancing padding vs tile size.
+
+    Among aligned candidates whose padded total is within ~9% of the
+    achievable minimum, picks the one giving the FEWEST blocks, then
+    the least padding on ties. The two failure modes this splits:
+    strict padding-minimization collapses awkward dims to degenerate
+    tiles (k=2176 -> bk=128: 17 K-steps of accumulator turnaround;
+    m=1042 -> bm=8: 6% systolic-row utilization), while a blind
+    preferred-size block can nearly double the work (n=2176 with
+    bn=2048 pads to 4096). A few percent padding buys full-size
+    tiles; ties cost nothing."""
+    if dim <= align:
         return dim
-    return min(dim, align)
+    hi = min(preferred, cdiv(dim, align) * align)
+    cands = range(align, hi + 1, align)
+    padded = lambda b: cdiv(dim, b) * b  # noqa: E731
+    pad_min = min(padded(b) for b in cands)
+    ok = [b for b in cands if padded(b) <= pad_min * 1.09]
+    # fewest blocks first (big tiles), then least padding: padding
+    # only buys something when it reduces the block count — at equal
+    # count a bigger block is the same traffic for more zeros
+    nb_min = min(cdiv(dim, b) for b in ok)
+    return min(
+        (b for b in ok if cdiv(dim, b) == nb_min), key=padded
+    )
 
 
 def _split_bf16(x):
@@ -68,11 +89,11 @@ def _sgemm_kernel(mode, alpha_ref, beta_ref, *refs):
     Precision.HIGH nor Mosaic lowers HIGH inside Pallas, so the three
     MXU passes are emitted by hand: a@b ≈ hi@hi + hi@lo + lo@hi, f32
     accumulate (dropping lo@lo loses ~2^-16 rel, measured 1.5e-5 at
-    K=1024). Splitting in-kernel cost ~2 us of serialized VPU work per
-    512^3 K-step against ~4 us of MXU dots (and re-split each A block
-    once per j, each B block once per i); the wrapper pre-splits once
-    in one fused XLA pass, and the bf16 halves read the same HBM bytes
-    as the f32 originals.
+    K=1024). Splitting in-kernel serialized VPU work against the MXU
+    dots every K-step (and re-split each A block once per j, each B
+    block once per i); the wrapper pre-splits once in one fused XLA
+    pass, and the bf16 halves read the same HBM bytes as the f32
+    originals.
 
     other modes: refs = (a, b, c, o, acc), mode is the jnp.dot
     precision ('float32' = bf16_6x, 'default' = single-pass bf16).
@@ -123,11 +144,13 @@ def _sgemm_padded(
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
-            # The tall-K blocks need ~18 MiB once double-buffered
-            # (B hi+lo at 1024x1024 bf16 is 4 MiB before buffering),
-            # just over Mosaic's 16 MiB default scoped budget. 32 MiB
-            # is safe: flat 2-D buffers, no unrolled-slab compile-time
-            # blowup (cf. docs/PERF.md VMEM note).
+            # The tall-K blocks need ~28 MiB once double-buffered at
+            # the widest case (B hi+lo at 1024x2048 bf16 is 8 MiB
+            # before buffering — 16 after), over Mosaic's 16 MiB
+            # default scoped budget with only ~4 MiB headroom left
+            # under 32. Don't enlarge any block without redoing this
+            # arithmetic. 32 MiB stays safe compile-time-wise: flat
+            # 2-D buffers, no unrolled-slab blowup (docs/PERF.md).
             vmem_limit_bytes=32 * 1024 * 1024,
         ),
         cost_estimate=pl.CostEstimate(
@@ -179,14 +202,16 @@ def sgemm(
     m, k = a.shape
     k2, n = b.shape
     assert k == k2 and c.shape == (m, n)
-    # Tall-K tiling: (bm,bn,bk)=(256,1024,1024) measured 62 TFLOPS at
-    # 1024^3 vs 48 for 512^3 — with the full K in one dot the kernel
-    # sits at the bf16_3x compute ceiling (single-pass bf16 measures
-    # 184 TFLOPS; /3 = 61). Wide bn amortizes A-block reloads; small
-    # bm keeps A+C+acc VMEM under Mosaic's 16 MiB scoped budget
-    # (B hi+lo at 1024x1024 bf16 is the 4 MiB anchor).
+    # Tall-K tiling: with the full K in one dot per grid step the
+    # kernel sits at the bf16_3x compute ceiling (single-pass bf16
+    # measures 184 TFLOPS; /3 = 61; measured 62 at 1024^3 vs 48 for
+    # the 512^3 tiling this replaced). Wide bn amortizes A-block
+    # reloads — bn prefers the full N up to 2048 (at 2048^3: 60.7
+    # TFLOPS vs 52.7 with bn=1024); past 2048, B's double-buffered
+    # hi+lo pair would blow the 32 MiB VMEM budget. Small bm keeps
+    # A+C+acc in the remaining headroom.
     bm = _pick_block(m, 256, 8)
-    bn = _pick_block(n, 1024, 128)
+    bn = _pick_block(n, 2048, 128)
     bk = _pick_block(k, 1024, 128)
     pm, pn, pk = (cdiv(m, bm) * bm, cdiv(n, bn) * bn, cdiv(k, bk) * bk)
     if (pm, pk) != (m, k):
