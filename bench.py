@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import signal
+import statistics
 import sys
 import time
 
@@ -65,7 +66,7 @@ def _timeit(fn, *args, reps=4, warmup=2):
     return best
 
 
-def _slope(make_fn, r_small, r_big):
+def _slope(make_fn, r_small, r_big, samples=5):
     """Marginal seconds per loop iteration.
 
     make_fn(R) -> (jitted_fn, args) where fn runs R dependent
@@ -73,16 +74,29 @@ def _slope(make_fn, r_small, r_big):
     difference cancels the fixed per-dispatch cost (axon tunnel
     round-trip, host overhead) that a single-call measurement would
     mis-attribute to the kernel.
+
+    The tunnel's fixed cost also JITTERS run to run (observed ~30%
+    swings), so one slope sample can be badly off in either
+    direction; take the median of several (each from fresh best-of-3
+    timings at both R values — cheap, compile is already done) and
+    drop non-positive samples from stall-corrupted readings.
     """
     f_s, a_s = make_fn(r_small)
     f_b, a_b = make_fn(r_big)
-    t_s = _timeit(f_s, *a_s)
-    t_b = _timeit(f_b, *a_b)
-    if t_b <= t_s:  # tunnel stall corrupted a reading; don't report garbage
+    np.asarray(f_s(*a_s))  # compile + warm
+    np.asarray(f_b(*a_b))
+    ests = []
+    for _ in range(samples):
+        t_s = _timeit(f_s, *a_s, reps=3, warmup=0)
+        t_b = _timeit(f_b, *a_b, reps=3, warmup=0)
+        if t_b > t_s:
+            ests.append((t_b - t_s) / (r_big - r_small))
+    if not ests:
         raise RuntimeError(
-            f"non-positive slope: t({r_small})={t_s:.4f}s >= t({r_big})={t_b:.4f}s"
+            f"all {samples} slope samples non-positive "
+            f"(tunnel stalls corrupted every reading)"
         )
-    return (t_b - t_s) / (r_big - r_small)
+    return statistics.median(ests)
 
 
 def bench_sgemm(m=1024):
