@@ -86,15 +86,20 @@ def _slope(make_fn, r_small, r_big, samples=5):
     np.asarray(f_s(*a_s))  # compile + warm
     np.asarray(f_b(*a_b))
     ests = []
-    for _ in range(samples):
+    min_valid = min(3, samples)
+    for attempt in range(3 * samples):
+        if len(ests) >= samples:
+            break
         t_s = _timeit(f_s, *a_s, reps=3, warmup=0)
         t_b = _timeit(f_b, *a_b, reps=3, warmup=0)
         if t_b > t_s:
             ests.append((t_b - t_s) / (r_big - r_small))
-    if not ests:
+    if len(ests) < min_valid:
+        # a median of 1-2 surviving samples is just the single-slope
+        # jitter problem again; refuse to report it as a median
         raise RuntimeError(
-            f"all {samples} slope samples non-positive "
-            f"(tunnel stalls corrupted every reading)"
+            f"only {len(ests)} valid slope samples after {3 * samples} "
+            f"attempts (tunnel stalls corrupted the rest)"
         )
     return statistics.median(ests)
 

@@ -22,3 +22,26 @@ def test_saxpy_alpha_zero(rng):
     x = jnp.asarray(rng.standard_normal(512), dtype=jnp.float32)
     y = jnp.asarray(rng.standard_normal(512), dtype=jnp.float32)
     np.testing.assert_array_equal(np.asarray(saxpy(0.0, x, y)), np.asarray(y))
+
+
+def test_saxpy_does_not_clobber_live_y(rng):
+    # the kernel aliases y into its output (input_output_aliases);
+    # functional semantics require the caller's y to survive when it
+    # is still live after the call. Real buffer aliasing only happens
+    # on the compiled path, so force interpret=False when a TPU is
+    # attached (interpret mode on CPU hosts cannot exercise it).
+    import jax
+
+    modes = [None]
+    if jax.default_backend() != "cpu":
+        modes.append(False)
+    for interpret in modes:
+        x = jnp.asarray(rng.standard_normal(2048), dtype=jnp.float32)
+        y = jnp.asarray(rng.standard_normal(2048), dtype=jnp.float32)
+        y_before = np.asarray(y).copy()
+        out = saxpy(3.0, x, y, interpret=interpret)
+        np.testing.assert_array_equal(np.asarray(y), y_before)
+        np.testing.assert_allclose(
+            np.asarray(out), 3.0 * np.asarray(x) + y_before,
+            rtol=1e-6, atol=1e-6,
+        )

@@ -8,6 +8,14 @@ TPU design: a VPU elementwise kernel. The 1-D problem array is reshaped
 to (rows, 128) to satisfy lane tiling, gridded over row blocks so
 arbitrarily large N streams through VMEM. alpha rides in SMEM as a
 (1, 1) scalar.
+
+The y operand is aliased to the output (input_output_aliases): without
+it, chaining saxpy through a fori_loop carry makes XLA copy the
+custom-call result back into the carry buffer every iteration — two
+extra HBM streams that cap the measured bandwidth at ~400 GB/s vs
+~655 with the alias (XLA's own fused a*x+y measures 683). Functional
+semantics are preserved: XLA inserts a defensive copy only when the
+caller's y is still live after the call.
 """
 
 from __future__ import annotations
@@ -44,6 +52,7 @@ def _saxpy_2d(alpha, x2, y2, interpret=False):
             pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+        input_output_aliases={2: 0},
         interpret=interpret,
     )(alpha, x2, y2)
 
