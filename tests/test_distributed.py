@@ -207,6 +207,81 @@ def test_multiprocess_allreduce():
                 p.kill()
 
 
+def test_capi_mesh_routing():
+    """TPK_MESH>1 routes the C-shim adapters through the shard_map
+    collective variants (SURVEY.md §5 config system) — the C driver's
+    `mpirun -np N` analog. Verified against the single-device oracle
+    on 8 fake CPU devices."""
+    out = run_cpu8("""
+        import os
+        os.environ["TPK_MESH"] = "8"
+        import json
+        import numpy as np
+        import jax.numpy as jnp
+        from tpukernels import capi
+        from tpukernels.kernels.stencil import jacobi2d_reference
+        from tpukernels.kernels.nbody import nbody_reference
+
+        rng = np.random.default_rng(7)
+        h, w = 256, 128
+        x = np.ascontiguousarray(rng.standard_normal((h, w)), np.float32)
+        ref = np.asarray(jacobi2d_reference(jnp.asarray(x), 5))
+        params = json.dumps(
+            {"iters": 5, "buffers": [{"shape": [h, w], "dtype": "f32"}]})
+        assert capi.run_from_c("stencil2d", params, [x.ctypes.data]) == 0
+        np.testing.assert_allclose(x, ref, rtol=1e-5, atol=1e-6)
+
+        for variant in ("psum", "ring"):
+            os.environ["TPK_NBODY_DIST"] = variant
+            n = 512
+            state = [np.ascontiguousarray(rng.standard_normal(n), np.float32)
+                     for _ in range(6)]
+            m = np.ascontiguousarray(rng.uniform(0.5, 1.5, n), np.float32)
+            ref6 = nbody_reference(
+                *(jnp.asarray(a) for a in state), jnp.asarray(m), steps=2)
+            params = json.dumps({
+                "steps": 2,
+                "buffers": [{"shape": [n], "dtype": "f32"}] * 7,
+            })
+            bufs = state + [m]
+            assert capi.run_from_c(
+                "nbody", params, [a.ctypes.data for a in bufs]) == 0
+            for got, want in zip(state, ref6):
+                np.testing.assert_allclose(
+                    got, np.asarray(want), rtol=5e-4, atol=5e-5)
+
+        # allreduce honors TPK_MESH for its contribution count
+        s = 256
+        xs = np.ascontiguousarray(rng.standard_normal(s), np.float32)
+        out_buf = np.zeros(s, np.float32)
+        params = json.dumps(
+            {"buffers": [{"shape": [s], "dtype": "f32"}] * 2})
+        assert capi.run_from_c(
+            "allreduce", params, [xs.ctypes.data, out_buf.ctypes.data]) == 0
+        np.testing.assert_allclose(out_buf, 8 * xs, rtol=1e-5)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
+def test_capi_mesh_too_large_raises():
+    out = run_cpu8("""
+        import os, json
+        os.environ["TPK_MESH"] = "64"
+        import numpy as np
+        from tpukernels import capi
+        x = np.zeros((64, 128), np.float32)
+        params = json.dumps(
+            {"iters": 1, "buffers": [{"shape": [64, 128], "dtype": "f32"}]})
+        try:
+            capi.run_from_c("stencil2d", params, [x.ctypes.data])
+        except RuntimeError as e:
+            assert "TPK_MESH=64" in str(e), e
+            print('OK')
+    """)
+    assert "OK" in out
+
+
 def test_busbw_sweep_runs():
     out = run_cpu8("""
         from tpukernels.parallel.busbw import sweep, bus_bandwidth

@@ -12,6 +12,7 @@ variant that times memcpy+kernel+sync (SURVEY.md §7 "honest timing").
 from __future__ import annotations
 
 import ctypes
+import functools
 import json
 import math
 import os
@@ -50,6 +51,31 @@ _DTYPES = {
 }
 
 
+def _mesh_size() -> int:
+    """TPK_MESH (SURVEY.md §5 config system): device count the
+    shim-dispatched kernels shard over. >1 routes the stencils and
+    N-body through the shard_map collective variants (C9) on a ring
+    mesh — the C driver's `mpirun -np N` analog with zero new C flags.
+    Unset/1 keeps the single-device Pallas path (the allreduce
+    adapter is the one TPK_MESH=1-vs-unset difference: an explicit 1
+    pins its rank count to 1, unset means all visible devices)."""
+    n = int(os.environ.get("TPK_MESH", "1"))
+    if n < 1:
+        raise ValueError(f"TPK_MESH={n}: must be >= 1")
+    if n == 1:
+        return 1
+    import jax
+
+    have = jax.device_count()
+    if have < n:
+        raise RuntimeError(
+            f"TPK_MESH={n} but only {have} device(s) visible. For "
+            "logic runs without a pod: JAX_PLATFORMS=cpu "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+        )
+    return n
+
+
 def _wrap(addr: int, spec: dict) -> np.ndarray:
     dt = np.dtype(_DTYPES[spec["dtype"]])
     shape = tuple(spec["shape"])
@@ -86,23 +112,30 @@ def _adapt_sgemm(p, arrs):
     np.copyto(c, np.asarray(out))
 
 
-def _adapt_stencil2d(p, arrs):
+def _adapt_stencil(name, p, arrs):
     import jax.numpy as jnp
 
     from tpukernels import registry
 
     (x,) = arrs
-    out = registry.lookup("stencil2d")(jnp.asarray(x), int(p["iters"]))
-    np.copyto(x, np.asarray(out))
+    n = _mesh_size()
+    if n > 1:
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel import collectives
 
-
-def _adapt_stencil3d(p, arrs):
-    import jax.numpy as jnp
-
-    from tpukernels import registry
-
-    (x,) = arrs
-    out = registry.lookup("stencil3d")(jnp.asarray(x), int(p["iters"]))
+        dist = {
+            "stencil2d": collectives.jacobi2d_dist,
+            "stencil3d": collectives.jacobi3d_dist,
+        }[name]
+        # honor the temporal-blocking knob in mesh mode too (the
+        # dist k is the comm-avoiding halo depth, the multi-chip
+        # mirror of the single-device TPK_STENCIL_K)
+        kw = {}
+        if "TPK_STENCIL_K" in os.environ:
+            kw["k"] = int(os.environ["TPK_STENCIL_K"])
+        out = dist(jnp.asarray(x), int(p["iters"]), make_mesh(n), **kw)
+    else:
+        out = registry.lookup(name)(jnp.asarray(x), int(p["iters"]))
     np.copyto(x, np.asarray(out))
 
 
@@ -132,13 +165,43 @@ def _adapt_nbody(p, arrs):
     from tpukernels import registry
 
     px, py, pz, vx, vy, vz, m = arrs
-    out = registry.lookup("nbody")(
-        *(jnp.asarray(a) for a in (px, py, pz, vx, vy, vz)),
-        jnp.asarray(m),
-        dt=p.get("dt", 1e-3),
-        eps=p.get("eps", 1e-2),
-        steps=int(p.get("steps", 1)),
-    )
+    n = _mesh_size()
+    if n > 1:
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel import collectives
+
+        # TPK_NBODY_DIST picks the formulation: 'psum' (j-sharded
+        # partial forces, the north-star's named scheme) or 'ring'
+        # (i-sharded with j-blocks rotating via ppermute)
+        variant = os.environ.get("TPK_NBODY_DIST", "psum")
+        variants = {
+            "psum": collectives.nbody_dist_psum,
+            "ring": collectives.nbody_dist_ring,
+        }
+        if variant not in variants:
+            raise ValueError(
+                f"TPK_NBODY_DIST={variant!r}: expected one of "
+                f"{sorted(variants)}"
+            )
+        fn = variants[variant]
+        state = tuple(
+            jnp.asarray(a) for a in (px, py, pz, vx, vy, vz, m)
+        )
+        out = fn(
+            state,
+            int(p.get("steps", 1)),
+            make_mesh(n),
+            dt=p.get("dt", 1e-3),
+            eps=p.get("eps", 1e-2),
+        )
+    else:
+        out = registry.lookup("nbody")(
+            *(jnp.asarray(a) for a in (px, py, pz, vx, vy, vz)),
+            jnp.asarray(m),
+            dt=p.get("dt", 1e-3),
+            eps=p.get("eps", 1e-2),
+            steps=int(p.get("steps", 1)),
+        )
     for host, dev in zip((px, py, pz, vx, vy, vz), out):
         np.copyto(host, np.asarray(dev))
 
@@ -151,7 +214,7 @@ def _adapt_allreduce(p, arrs):
     from tpukernels.parallel.collectives import allreduce_sum
 
     x, out = arrs
-    ndev = jax.device_count()
+    ndev = _mesh_size() if "TPK_MESH" in os.environ else jax.device_count()
     contrib = jnp.tile(jnp.asarray(x)[None, :], (ndev, 1))
     res = allreduce_sum(contrib, make_mesh(ndev))
     np.copyto(out, np.asarray(res[0]))
@@ -160,8 +223,8 @@ def _adapt_allreduce(p, arrs):
 _ADAPTERS = {
     "vector_add": _adapt_vector_add,
     "sgemm": _adapt_sgemm,
-    "stencil2d": _adapt_stencil2d,
-    "stencil3d": _adapt_stencil3d,
+    "stencil2d": functools.partial(_adapt_stencil, "stencil2d"),
+    "stencil3d": functools.partial(_adapt_stencil, "stencil3d"),
     "scan": _adapt_scan,
     "histogram": _adapt_histogram,
     "nbody": _adapt_nbody,
