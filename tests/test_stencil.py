@@ -89,6 +89,23 @@ def test_jacobi3d_blocked_path(rng):
     )
 
 
+def test_jacobi3d_wide_plane_bz_floor(rng):
+    # a ~4.5 MiB z-plane drives _pick_bz to its floor of 1; k must be
+    # clamped to bz so the slab stays at 3 planes instead of the
+    # (1 + 2k)-plane slab that blows the 100 MiB vmem limit on chip
+    from tpukernels.kernels import stencil as _st
+
+    assert _st._pick_bz(1024, 1152, 8) == 1
+    x = jnp.asarray(
+        rng.standard_normal((8, 1024, 1150)), dtype=jnp.float32
+    )
+    out = jacobi3d(x, 2, k=8)
+    ref = jacobi3d_reference(x, 2)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+    )
+
+
 @pytest.mark.parametrize("k,iters", [(2, 3), (4, 9)])
 def test_jacobi3d_temporal_blocking(rng, k, iters):
     # 64*64*384*4 B = 6 MiB > _SMALL_BYTES: genuinely exercises the

@@ -18,7 +18,7 @@ TPU design — two Pallas paths chosen by problem size:
   provably 8-aligned; dynamic clamped offsets are not).
 
 The blocked path is *temporally blocked*: k sweeps run back-to-back
-on the VMEM slab per HBM pass (default k=8 in 2D / 4 in 3D, env
+on the VMEM slab per HBM pass (default k=8, env
 TPK_STENCIL_K), cutting HBM traffic per sweep to 8/k bytes/cell and
 lifting the single-chip roofline by k. Rows near a slab edge go stale
 one-per-sweep (no true neighbors); the ghost band bounds that, so the
@@ -67,11 +67,13 @@ def _pick_bm(wp: int) -> int:
 
 def _pick_bz(hp: int, wp: int, k: int = 1) -> int:
     """z-planes per 3D block: slab (bz+2k) + two out blocks of bz
-    planes inside a deliberately modest 16 MiB budget — large unrolled
-    3D slabs (tried up to ~96 MiB against the raised scoped-vmem
-    limit) sent Mosaic compile times through the roof for little gain
-    over the k-deep traffic win itself."""
-    total_planes = (16 * 1024 * 1024) // (4 * hp * wp)
+    planes inside a 32 MiB budget. Thin slabs lose most of their
+    planes to ghost recompute (at 16 MiB / 384² the ghost fraction
+    was 57% and measured 65 Gcells/s vs 83.6 at 32 MiB); 40+ MiB fails
+    remote compile with VMEM exhaustion, and very large unrolled
+    slabs (tried up to ~96 MiB) sent Mosaic compile times through
+    the roof."""
+    total_planes = (32 * 1024 * 1024) // (4 * hp * wp)
     bz = (total_planes - 2 * k) // 3
     return max(1, min(32, bz))
 
@@ -213,7 +215,11 @@ def jacobi2d(
     h, w = x.shape
     wp = max(cdiv(w, LANES) * LANES, LANES)
     bm = _pick_bm(wp)
-    blocked = h >= bm + 2 and h * wp * 4 > _SMALL_BYTES
+    # blocked purely by size: the small path holds the whole grid in
+    # VMEM under Mosaic's default scoped limit, so any >4 MiB grid
+    # must take the blocked path (h < bm is handled by padding rows
+    # up to one block)
+    blocked = h * wp * 4 > _SMALL_BYTES
     pads = [(0, 0), (0, wp - w)]
     if blocked:
         # 8 ghost rows each side + round rows up to a block multiple
@@ -360,17 +366,35 @@ def jacobi3d(
     """Run `iters` Jacobi 7-point sweeps on (D, H, W) float32.
 
     `k` is the temporal-blocking depth (sweeps fused per HBM pass) for
-    the blocked path; default 4, or env TPK_STENCIL_K."""
+    the blocked path; default 8, or env TPK_STENCIL_K."""
     if interpret is None:
         interpret = default_interpret()
     if k is None:
-        k = int(os.environ.get("TPK_STENCIL_K", "4"))
+        k = int(os.environ.get("TPK_STENCIL_K", "8"))
     k = max(1, min(k, 8))
     d, h, w = x.shape
     wp = max(cdiv(w, LANES) * LANES, LANES)
     hp8 = cdiv(h, 8) * 8
-    bz = _pick_bz(hp8, wp, k)
-    blocked = d >= bz + 2 and d * h * wp * 4 > _SMALL_BYTES
+    # joint (k, bz) pick: wide planes shrink bz toward its floor of 1,
+    # and a slab of (bz + 2k) planes with k >> bz both blows the
+    # 100 MiB vmem limit (e.g. 7 MiB planes at k=8: 17 planes =
+    # 120 MiB) and drowns in ghost recompute. Walk k down until the
+    # budget supports bz >= k rather than clamping against a bz that
+    # assumed the larger k (a 2 MiB plane at k=8 would collapse to
+    # bz=1/k=1 when bz=4/k=2 fits).
+    for kk in range(k, 0, -1):
+        bz = _pick_bz(hp8, wp, kk)
+        if bz >= kk:
+            k = kk
+            break
+    else:
+        k, bz = 1, _pick_bz(hp8, wp, 1)
+    # blocked purely by size: the small path holds the whole grid (and
+    # its sweep temporaries) in VMEM under Mosaic's default scoped
+    # limit, so any >4 MiB grid must take the blocked path — bz and
+    # padding handle shallow d (bz <= d keeps pad waste < one block)
+    bz = min(bz, d)
+    blocked = d * h * wp * 4 > _SMALL_BYTES
     pads = [(0, 0), (0, 0), (0, wp - w)]
     if blocked:
         pads[0] = (k, k + cdiv(d, bz) * bz - d)
