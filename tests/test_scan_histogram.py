@@ -30,7 +30,7 @@ def test_scan_matches_jnp_reference(rng):
     )
 
 
-@pytest.mark.parametrize("acc", ["i8", "f32"])
+@pytest.mark.parametrize("impl", ["mxu", "vpu"])
 @pytest.mark.parametrize(
     "n,nbins",
     [
@@ -44,8 +44,10 @@ def test_scan_matches_jnp_reference(rng):
         (2**18, 80),
     ],
 )
-def test_histogram_exact(rng, monkeypatch, n, nbins, acc):
-    monkeypatch.setenv("TPK_HIST_ACC", acc)
+def test_histogram_exact(rng, monkeypatch, n, nbins, impl):
+    if impl == "mxu" and nbins > 256:
+        pytest.skip("mxu path is nbins <= 256 by construction")
+    monkeypatch.setenv("TPK_HIST_IMPL", impl)
     x = jnp.asarray(rng.integers(0, nbins, n), dtype=jnp.int32)
     out = np.asarray(histogram(x, nbins))
     ref = np.bincount(np.asarray(x), minlength=nbins)
@@ -53,10 +55,49 @@ def test_histogram_exact(rng, monkeypatch, n, nbins, acc):
     assert out.sum() == n
 
 
+@pytest.mark.parametrize("acc", ["i8", "f32"])
+def test_histogram_vpu_acc_dtypes(rng, monkeypatch, acc):
+    monkeypatch.setenv("TPK_HIST_IMPL", "vpu")
+    monkeypatch.setenv("TPK_HIST_ACC", acc)
+    x = jnp.asarray(rng.integers(0, 256, 100000), dtype=jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(histogram(x, 256)),
+        np.bincount(np.asarray(x), minlength=256),
+    )
+
+
+def test_histogram_mxu_skewed_and_out_of_range(monkeypatch):
+    # all-same-value input stresses single-cell accumulation (the f32
+    # per-block exactness bound); out-of-range values count nothing
+    monkeypatch.setenv("TPK_HIST_IMPL", "mxu")
+    x = np.full(300000, 7, dtype=np.int32)
+    x[:100] = -3
+    x[100:200] = 256
+    out = np.asarray(histogram(jnp.asarray(x), 256))
+    assert out[7] == 300000 - 200 and out.sum() == 300000 - 200
+
+
+def test_histogram_empty_input():
+    np.testing.assert_array_equal(
+        np.asarray(histogram(jnp.zeros(0, jnp.int32), 64)),
+        np.zeros(64, np.int32),
+    )
+
+
 def test_histogram_bad_acc_env_raises(rng, monkeypatch):
+    monkeypatch.setenv("TPK_HIST_IMPL", "vpu")
     monkeypatch.setenv("TPK_HIST_ACC", "float32")
     with pytest.raises(ValueError, match="TPK_HIST_ACC"):
         histogram(jnp.zeros(16, jnp.int32), 8)
+
+
+def test_histogram_bad_impl_env_raises(rng, monkeypatch):
+    monkeypatch.setenv("TPK_HIST_IMPL", "gpu")
+    with pytest.raises(ValueError, match="TPK_HIST_IMPL"):
+        histogram(jnp.zeros(16, jnp.int32), 8)
+    monkeypatch.setenv("TPK_HIST_IMPL", "mxu")
+    with pytest.raises(ValueError, match="nbins"):
+        histogram(jnp.zeros(16, jnp.int32), 1024)
 
 
 def test_histogram_matches_jnp_reference(rng):

@@ -384,11 +384,9 @@ def jacobi3d(
     # bz=1/k=1 when bz=4/k=2 fits).
     for kk in range(k, 0, -1):
         bz = _pick_bz(hp8, wp, kk)
-        if bz >= kk:
+        if bz >= kk:  # always true by kk=1 (_pick_bz floors at 1)
             k = kk
             break
-    else:
-        k, bz = 1, _pick_bz(hp8, wp, 1)
     # blocked purely by size: the small path holds the whole grid (and
     # its sweep temporaries) in VMEM under Mosaic's default scoped
     # limit, so any >4 MiB grid must take the blocked path — bz and
