@@ -37,6 +37,32 @@ run scan_histogram --n=100000
 run nbody --n=1024 --iters=2
 run allreduce_bench --n=1048576
 
+# Mesh acceptance rows (SURVEY.md C9): TPK_TEST_MESH=N re-runs the
+# distributed-capable kernels with the shim sharding over N fake CPU
+# devices — the mpirun-analog path, no pod needed.
+if [ -n "${TPK_TEST_MESH:-}" ] && [ "${TPK_TEST_MESH}" != "0" ]; then
+  n="${TPK_TEST_MESH}"
+  mesh_env="PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_MESH=$n"
+  mesh_env="$mesh_env XLA_FLAGS=--xla_force_host_platform_device_count=$n"
+  for cmd in \
+      "stencil --n=256 --iters=10" \
+      "stencil --n=64 --z=64 --iters=5" \
+      "nbody --n=1024 --iters=2" \
+      "allreduce_bench --n=1048576"; do
+    # shellcheck disable=SC2086
+    set -- $cmd
+    bin="bin/$1"
+    shift
+    [ -x "$bin" ] || continue
+    echo "== TPK_MESH=$n $bin --device=tpu $*"
+    # shellcheck disable=SC2086
+    if ! env $mesh_env "$bin" --device=tpu --check --reps=1 "$@"; then
+      echo "FAILED (mesh): $bin $*"
+      fail=1
+    fi
+  done
+fi
+
 if [ "$fail" = "1" ]; then
   echo "ACCEPTANCE: FAIL"
   exit 1

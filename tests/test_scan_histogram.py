@@ -56,13 +56,23 @@ def test_histogram_exact(rng, monkeypatch, n, nbins, impl):
 
 
 @pytest.mark.parametrize("acc", ["i8", "f32"])
-def test_histogram_vpu_acc_dtypes(rng, monkeypatch, acc):
+@pytest.mark.parametrize(
+    "n,nbins",
+    [
+        (100000, 256),
+        # f32 acc at large nbins drives _pick_chunk to its floor of 8
+        # (the (chunk, 128, nbins) slab budget divides to zero)
+        (4096, 1024),
+        (2**18, 80),
+    ],
+)
+def test_histogram_vpu_acc_dtypes(rng, monkeypatch, n, nbins, acc):
     monkeypatch.setenv("TPK_HIST_IMPL", "vpu")
     monkeypatch.setenv("TPK_HIST_ACC", acc)
-    x = jnp.asarray(rng.integers(0, 256, 100000), dtype=jnp.int32)
+    x = jnp.asarray(rng.integers(0, nbins, n), dtype=jnp.int32)
     np.testing.assert_array_equal(
-        np.asarray(histogram(x, 256)),
-        np.bincount(np.asarray(x), minlength=256),
+        np.asarray(histogram(x, nbins)),
+        np.bincount(np.asarray(x), minlength=nbins),
     )
 
 
