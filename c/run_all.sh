@@ -11,16 +11,23 @@ if [ "${TPK_TEST_TPU:-0}" = "1" ]; then
 fi
 
 fail=0
-run() {
-  # $1 binary, rest: args
-  bin="bin/$1"; shift
+run_row() {
+  # $1 space-separated env assignments (may be empty), $2 binary,
+  # $3 device, rest: args
+  row_env="$1"; bin="bin/$2"; dev="$3"; shift 3
   [ -x "$bin" ] || return 0
+  echo "== ${row_env:+$row_env }$bin --device=$dev $*"
+  # shellcheck disable=SC2086
+  if ! env $row_env "$bin" --device="$dev" --check --reps=1 "$@"; then
+    echo "FAILED: ${row_env:+$row_env }$bin --device=$dev $*"
+    fail=1
+  fi
+}
+run() {
+  # $1 binary, rest: args; one row per device in $devices
+  b="$1"; shift
   for dev in $devices; do
-    echo "== $bin --device=$dev $*"
-    if ! "$bin" --device="$dev" --check --reps=1 "$@"; then
-      echo "FAILED: $bin --device=$dev"
-      fail=1
-    fi
+    run_row "" "$b" "$dev" "$@"
   done
 }
 
@@ -51,15 +58,9 @@ if [ -n "${TPK_TEST_MESH:-}" ] && [ "${TPK_TEST_MESH}" != "0" ]; then
       "allreduce_bench --n=1048576"; do
     # shellcheck disable=SC2086
     set -- $cmd
-    bin="bin/$1"
+    b="$1"
     shift
-    [ -x "$bin" ] || continue
-    echo "== TPK_MESH=$n $bin --device=tpu $*"
-    # shellcheck disable=SC2086
-    if ! env $mesh_env "$bin" --device=tpu --check --reps=1 "$@"; then
-      echo "FAILED (mesh): $bin $*"
-      fail=1
-    fi
+    run_row "$mesh_env" "$b" tpu "$@"
   done
 fi
 

@@ -3,7 +3,12 @@ import numpy as np
 import pytest
 
 from tpukernels.kernels.histogram import histogram, histogram_reference
-from tpukernels.kernels.scan import inclusive_scan, inclusive_scan_reference
+from tpukernels.kernels.scan import (
+    exclusive_scan,
+    exclusive_scan_reference,
+    inclusive_scan,
+    inclusive_scan_reference,
+)
 
 
 @pytest.mark.parametrize("n", [128, 1000, 2**17, 7])
@@ -21,6 +26,25 @@ def test_scan_i32_exact(rng, n):
     out = np.asarray(inclusive_scan(x))
     ref = np.cumsum(np.asarray(x))
     np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.parametrize("n", [128, 4096, 333, 1, 0])
+def test_exclusive_scan(rng, n):
+    x = jnp.asarray(rng.integers(-100, 100, n), dtype=jnp.int32)
+    out = np.asarray(exclusive_scan(x))
+    ref = np.asarray(exclusive_scan_reference(x))
+    np.testing.assert_array_equal(out, ref)
+    assert out.shape == (n,)
+    if n:
+        assert out[0] == 0
+    xf = jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)
+    # same tolerance as the inclusive f32 contract: prefix sums
+    # accumulate ~sqrt(n)*eps of order-dependent error
+    np.testing.assert_allclose(
+        np.asarray(exclusive_scan(xf)),
+        np.asarray(exclusive_scan_reference(xf)),
+        rtol=1e-4, atol=1e-2,
+    )
 
 
 def test_scan_matches_jnp_reference(rng):

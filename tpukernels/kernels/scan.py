@@ -157,6 +157,27 @@ def inclusive_scan(x, interpret: bool | None = None):
     return out.reshape(-1)[:n]
 
 
+def exclusive_scan(x, interpret: bool | None = None):
+    """Exclusive prefix sum of a 1-D array (float32 or int32):
+    out[i] = sum(x[:i]), out[0] = 0 — CUB DeviceScan::ExclusiveSum's
+    contract, derived from the inclusive kernel by a one-element
+    right shift (bitwise-identical partial sums, no re-rounding)."""
+    incl = inclusive_scan(x, interpret=interpret)
+    if incl.size == 0:
+        return incl
+    return jnp.concatenate(
+        [jnp.zeros((1,), incl.dtype), incl[:-1]]
+    )
+
+
 def inclusive_scan_reference(x):
     """jnp oracle (mirrors the serial-C running-sum golden)."""
     return jnp.cumsum(x)
+
+
+def exclusive_scan_reference(x):
+    """jnp oracle: cumsum shifted right with a leading zero."""
+    c = jnp.cumsum(x)
+    if c.size == 0:
+        return c
+    return jnp.concatenate([jnp.zeros((1,), c.dtype), c[:-1]])

@@ -57,6 +57,7 @@ def _populate():
         import tpukernels.kernels.histogram as _histogram
 
         _REGISTRY["scan"] = _scan.inclusive_scan
+        _REGISTRY["scan_exclusive"] = _scan.exclusive_scan
         _REGISTRY["histogram"] = _histogram.histogram
     except ImportError:
         pass
