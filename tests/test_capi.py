@@ -51,6 +51,21 @@ def test_scan_and_histogram_roundtrip(rng):
     assert capi.run_from_c("scan", params, [_addr(x), _addr(scan_out)]) == 0
     np.testing.assert_array_equal(scan_out, np.cumsum(x))
 
+    excl_out = np.zeros(n, dtype=np.int32)
+    excl_params = json.dumps(
+        {
+            "exclusive": True,
+            "buffers": [
+                {"shape": [n], "dtype": "i32"},
+                {"shape": [n], "dtype": "i32"},
+            ],
+        }
+    )
+    assert capi.run_from_c("scan", excl_params, [_addr(x), _addr(excl_out)]) == 0
+    np.testing.assert_array_equal(
+        excl_out, np.concatenate([[0], np.cumsum(x)[:-1]])
+    )
+
     counts = np.zeros(nbins, dtype=np.int32)
     params = json.dumps(
         {

@@ -145,7 +145,8 @@ def _adapt_scan(p, arrs):
     from tpukernels import registry
 
     x, out = arrs
-    res = registry.lookup("scan")(jnp.asarray(x))
+    name = "scan_exclusive" if p.get("exclusive") else "scan"
+    res = registry.lookup(name)(jnp.asarray(x))
     np.copyto(out, np.asarray(res))
 
 
