@@ -19,10 +19,20 @@ the previous iteration so XLA cannot hoist or batch the work.
 from __future__ import annotations
 
 import json
+import os
 import signal
 import statistics
 import sys
 import time
+
+# Persist compiled executables across bench invocations (same knob the
+# C shim sets in capi.py): each metric compiles two jitted repeat-count
+# variants at 20-40 s per remote compile, which otherwise dominates the
+# run's wall clock. Must be set before jax initializes a backend.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
 
 import jax
 import jax.numpy as jnp
