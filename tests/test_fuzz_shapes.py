@@ -10,7 +10,7 @@ import pytest
 
 from tpukernels.kernels.histogram import histogram
 from tpukernels.kernels.nbody import nbody_reference, nbody_step
-from tpukernels.kernels.scan import inclusive_scan
+from tpukernels.kernels.scan import exclusive_scan, inclusive_scan
 from tpukernels.kernels.sgemm import sgemm
 from tpukernels.kernels.stencil import (
     jacobi2d,
@@ -41,6 +41,10 @@ def test_fuzz_scan_exact(rng, n):
     x = jnp.asarray(rng.integers(-1000, 1000, n), jnp.int32)
     np.testing.assert_array_equal(
         np.asarray(inclusive_scan(x)), np.cumsum(np.asarray(x))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(exclusive_scan(x)),
+        np.concatenate([[0], np.cumsum(np.asarray(x))[:-1]]),
     )
 
 
