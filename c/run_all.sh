@@ -54,6 +54,7 @@ if [ -n "${TPK_TEST_MESH:-}" ] && [ "${TPK_TEST_MESH}" != "0" ]; then
   for cmd in \
       "stencil --n=256 --iters=10" \
       "stencil --n=64 --z=64 --iters=5" \
+      "scan_histogram --n=100000" \
       "nbody --n=1024 --iters=2" \
       "allreduce_bench --n=1048576"; do
     # shellcheck disable=SC2086

@@ -120,6 +120,54 @@ def test_jacobi2d_dist_comm_avoiding_k(k):
     assert "OK" in out
 
 
+@pytest.mark.parametrize("exclusive", [False, True])
+def test_scan_dist_matches_oracle(exclusive):
+    # int32 must be bitwise-exact (mod-2^32 wraparound included: the
+    # large random values overflow int32 partial sums on purpose);
+    # float32 matches the cumsum oracle to rtol
+    out = run_cpu8(f"""
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import scan_dist
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(5)
+        n = 4096
+        xi = rng.integers(-2**30, 2**30, n).astype(np.int32)
+        got = np.asarray(scan_dist(jnp.asarray(xi), mesh,
+                                   exclusive={exclusive}))
+        want = np.cumsum(xi.astype(np.int64)).astype(np.int32)
+        if {exclusive}:
+            want = np.concatenate([[np.int32(0)], want[:-1]])
+        np.testing.assert_array_equal(got, want)
+        xf = rng.standard_normal(n).astype(np.float32)
+        gotf = np.asarray(scan_dist(jnp.asarray(xf), mesh,
+                                    exclusive={exclusive}))
+        wantf = np.cumsum(xf, dtype=np.float64)
+        if {exclusive}:
+            wantf = np.concatenate([[0.0], wantf[:-1]])
+        np.testing.assert_allclose(gotf, wantf, rtol=1e-4, atol=1e-4)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
+def test_histogram_dist_matches_oracle():
+    out = run_cpu8("""
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import histogram_dist
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(6)
+        n, nbins = 100000 - 100000 % 8, 256
+        x = rng.integers(-4, nbins + 4, n).astype(np.int32)  # incl. OOR
+        got = np.asarray(histogram_dist(jnp.asarray(x), nbins, mesh))
+        want = np.bincount(x[(x >= 0) & (x < nbins)], minlength=nbins)
+        np.testing.assert_array_equal(got, want)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
 @pytest.mark.parametrize("variant", ["psum", "ring"])
 def test_nbody_dist_matches_single_device(variant):
     out = run_cpu8(f"""
@@ -249,6 +297,26 @@ def test_capi_mesh_routing():
             for got, want in zip(state, ref6):
                 np.testing.assert_allclose(
                     got, np.asarray(want), rtol=5e-4, atol=5e-5)
+
+        # scan + histogram route through the dist variants under mesh
+        ns = 4096
+        xs_i = np.ascontiguousarray(
+            rng.integers(0, 256, ns).astype(np.int32))
+        scan_buf = np.zeros(ns, np.int32)
+        params = json.dumps(
+            {"buffers": [{"shape": [ns], "dtype": "i32"}] * 2})
+        assert capi.run_from_c(
+            "scan", params, [xs_i.ctypes.data, scan_buf.ctypes.data]) == 0
+        np.testing.assert_array_equal(scan_buf, np.cumsum(xs_i))
+        hist_buf = np.zeros(256, np.int32)
+        params = json.dumps({
+            "nbins": 256,
+            "buffers": [{"shape": [ns], "dtype": "i32"},
+                        {"shape": [256], "dtype": "i32"}]})
+        assert capi.run_from_c(
+            "histogram", params, [xs_i.ctypes.data, hist_buf.ctypes.data]) == 0
+        np.testing.assert_array_equal(
+            hist_buf, np.bincount(xs_i, minlength=256))
 
         # allreduce honors TPK_MESH for its contribution count
         s = 256

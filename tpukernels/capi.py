@@ -53,9 +53,10 @@ _DTYPES = {
 
 def _mesh_size() -> int:
     """TPK_MESH (SURVEY.md §5 config system): device count the
-    shim-dispatched kernels shard over. >1 routes the stencils and
-    N-body through the shard_map collective variants (C9) on a ring
-    mesh — the C driver's `mpirun -np N` analog with zero new C flags.
+    shim-dispatched kernels shard over. >1 routes the stencils,
+    N-body, scan and histogram through the shard_map collective
+    variants (C9) on a ring mesh — the C driver's `mpirun -np N`
+    analog with zero new C flags.
     Unset/1 keeps the single-device Pallas path (the allreduce
     adapter is the one TPK_MESH=1-vs-unset difference: an explicit 1
     pins its rank count to 1, unset means all visible devices)."""
@@ -145,8 +146,18 @@ def _adapt_scan(p, arrs):
     from tpukernels import registry
 
     x, out = arrs
-    name = "scan_exclusive" if p.get("exclusive") else "scan"
-    res = registry.lookup(name)(jnp.asarray(x))
+    n = _mesh_size()
+    if n > 1:
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import scan_dist
+
+        res = scan_dist(
+            jnp.asarray(x), make_mesh(n),
+            exclusive=bool(p.get("exclusive")),
+        )
+    else:
+        name = "scan_exclusive" if p.get("exclusive") else "scan"
+        res = registry.lookup(name)(jnp.asarray(x))
     np.copyto(out, np.asarray(res))
 
 
@@ -156,7 +167,14 @@ def _adapt_histogram(p, arrs):
     from tpukernels import registry
 
     x, counts = arrs
-    res = registry.lookup("histogram")(jnp.asarray(x), int(p["nbins"]))
+    n = _mesh_size()
+    if n > 1:
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import histogram_dist
+
+        res = histogram_dist(jnp.asarray(x), int(p["nbins"]), make_mesh(n))
+    else:
+        res = registry.lookup("histogram")(jnp.asarray(x), int(p["nbins"]))
     np.copyto(counts, np.asarray(res))
 
 

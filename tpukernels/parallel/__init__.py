@@ -11,7 +11,8 @@ NCCL/Gloo/UCX anywhere.
 - ``mesh``        — device mesh construction (single- and multi-host)
 - ``collectives`` — distributed kernel variants: row-sharded stencil
                     with ppermute halos, i-sharded N-body with a
-                    j-block ring, plain allreduce
+                    j-block ring, two-level prefix scan, psum-merged
+                    histogram, plain allreduce
 - ``busbw``       — the allreduce bus-bandwidth microbenchmark
 """
 

@@ -13,6 +13,12 @@ patterns, as a `shard_map` program over a 1-D ring mesh:
 - `nbody_dist_ring`  — ring body-block rotation    → ppermute ring
                         (memory O(N/P) per chip; the ring-attention
                         structural analog, SURVEY.md §5)
+- `scan_dist`        — MPI two-level prefix sum    → local cumsum +
+                        all_gather of rank totals (the MPI_Exscan
+                        decomposition)
+- `histogram_dist`   — privatized bins + MPI merge → local count +
+                        psum (SURVEY.md §5 "MPI_Allreduce for ...
+                        histogram merge")
 
 On the dev box these are logic-tested on 8 fake CPU devices
 (tests/test_distributed.py spawns subprocesses with the right env);
@@ -159,6 +165,98 @@ def jacobi3d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4):
     """z-sharded Jacobi 7-point: x (D, H, W) float32, D % P == 0.
     See _jacobi_dist for the comm-avoiding halo scheme."""
     return _jacobi_dist(x, iters, mesh, axis, k)
+
+
+# ---------------------------------------------------- scan + histogram
+
+def scan_dist(x, mesh: Mesh, axis: str = "x", exclusive: bool = False):
+    """Distributed prefix sum (SURVEY.md C7 under C9): x (N,) int32 or
+    float32, N % P == 0, elements block-sharded across ranks. The MPI
+    two-level decomposition — each rank scans its local block, ranks
+    exchange block totals (MPI_Exscan / Allgather), and the exclusive
+    prefix of totals offsets every local result. int32 stays exact:
+    XLA's int32 adds wrap mod 2^32 like the serial-C oracle's."""
+    n = x.shape[0]
+    nranks = mesh.shape[axis]
+    if n % nranks:
+        raise ValueError(f"N={n} must divide across {nranks} ranks")
+    return _scan_dist_build(mesh, axis, bool(exclusive))(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _scan_dist_build(mesh: Mesh, axis: str, exclusive: bool):
+    nranks = mesh.shape[axis]
+
+    def local_fn(xl):  # (N/P,) local block
+        incl = jnp.cumsum(xl)
+        totals = jax.lax.all_gather(incl[-1], axis)  # (P,) rank totals
+        rank = jax.lax.axis_index(axis)
+        offset = jnp.sum(
+            jnp.where(jnp.arange(nranks) < rank, totals, 0)
+        ).astype(xl.dtype)
+        # the exclusive variant shifts *locally*: rank r's element 0 is
+        # exactly the sum of all previous ranks' elements (= offset).
+        # Derived by shifting, not subtracting, so float partial sums
+        # are never re-rounded (mirrors kernels/scan.py exclusive_scan).
+        if exclusive:
+            incl = jnp.concatenate(
+                [jnp.zeros((1,), incl.dtype), incl[:-1]]
+            )
+        return incl + offset
+
+    return jax.jit(
+        shard_map(
+            local_fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+        )
+    )
+
+
+def histogram_dist(x, nbins: int, mesh: Mesh, axis: str = "x"):
+    """Distributed histogram (SURVEY.md §5: "MPI_Allreduce for ...
+    histogram merge"): x (N,) int32 values, N % P == 0, elements
+    block-sharded; each rank privatizes its own bin counts (the OpenMP
+    per-thread-bins pattern, rank-level) and one psum merges them.
+    Returns replicated (nbins,) int32 counts; out-of-range values count
+    nothing (same contract as kernels/histogram.py)."""
+    n = x.shape[0]
+    nranks = mesh.shape[axis]
+    if n % nranks:
+        raise ValueError(f"N={n} must divide across {nranks} ranks")
+    return _hist_dist_build(int(nbins), mesh, axis)(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _hist_dist_build(nbins: int, mesh: Mesh, axis: str):
+    chunk = 32768  # bound the (chunk, nbins) one-hot working set
+
+    def local_fn(xl):  # (N/P,) local block of int32 values
+        n = xl.shape[0]
+        c = min(chunk, n)
+        nchunks = cdiv(n, c)
+        if n % c:
+            # -1 is out of range for every bin: counts nothing
+            xl = jnp.pad(xl, (0, nchunks * c - n), constant_values=-1)
+        ids = jnp.arange(nbins, dtype=xl.dtype)
+
+        def body(i, acc):
+            v = jax.lax.dynamic_slice_in_dim(xl, i * c, c)
+            return acc + jnp.sum(
+                (v[:, None] == ids[None, :]).astype(jnp.int32), axis=0
+            )
+
+        # the carry must be typed as device-varying over the mesh axis
+        # (the body mixes in xl, which is) or the scan carry types clash
+        init = jax.lax.pcast(
+            jnp.zeros((nbins,), jnp.int32), (axis,), to="varying"
+        )
+        counts = jax.lax.fori_loop(0, nchunks, body, init)
+        return jax.lax.psum(counts, axis)
+
+    return jax.jit(
+        shard_map(
+            local_fn, mesh=mesh, in_specs=P(axis), out_specs=P()
+        )
+    )
 
 
 # -------------------------------------------------------------- nbody
