@@ -120,6 +120,52 @@ def test_jacobi2d_dist_comm_avoiding_k(k):
     assert "OK" in out
 
 
+def test_bcast_matches_mpi_semantics():
+    out = run_cpu8("""
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import bcast
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.standard_normal((8, 64)), jnp.float32)
+        for root in (0, 3, 7):
+            out = np.asarray(bcast(x, mesh, root=root))
+            for r in range(8):
+                np.testing.assert_array_equal(out[r], np.asarray(x)[root])
+        try:
+            bcast(x, mesh, root=8)
+            raise SystemExit('bcast(root=8) did not raise')
+        except ValueError as e:
+            assert 'root=8' in str(e)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
+def test_jacobi_dist_residual():
+    # residual=True returns the same grid plus the global squared norm
+    # of the next sweep's update — checked against the single-device
+    # oracle run one iteration further
+    out = run_cpu8("""
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import jacobi2d_dist
+        from tpukernels.kernels.stencil import jacobi2d_reference
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(10)
+        x = jnp.asarray(rng.standard_normal((128, 128)), jnp.float32)
+        grid, res = jacobi2d_dist(x, 5, mesh, residual=True)
+        plain = np.asarray(jacobi2d_dist(x, 5, mesh))
+        np.testing.assert_array_equal(np.asarray(grid), plain)
+        r5 = np.asarray(jacobi2d_reference(x, 5), dtype=np.float64)
+        r6 = np.asarray(jacobi2d_reference(x, 6), dtype=np.float64)
+        want = ((r6 - r5) ** 2).sum()
+        np.testing.assert_allclose(float(res), want, rtol=1e-4)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
 @pytest.mark.parametrize("exclusive", [False, True])
 def test_scan_dist_matches_oracle(exclusive):
     # int32 must be bitwise-exact (mod-2^32 wraparound included: the

@@ -134,7 +134,25 @@ def _adapt_stencil(name, p, arrs):
         kw = {}
         if "TPK_STENCIL_K" in os.environ:
             kw["k"] = int(os.environ["TPK_STENCIL_K"])
-        out = dist(jnp.asarray(x), int(p["iters"]), make_mesh(n), **kw)
+        # TPK_STENCIL_RESIDUAL=1: also run the loop's residual
+        # allreduce (SURVEY.md §3(b)) and report it on stderr, with
+        # zero new C flags. Diagnostic knob: it adds one extra sweep
+        # + a global psum per tpu_run() call, so timed benchmark runs
+        # should leave it unset (use it with --check / --reps=1)
+        if os.environ.get("TPK_STENCIL_RESIDUAL") == "1":
+            out, res = dist(
+                jnp.asarray(x), int(p["iters"]), make_mesh(n),
+                residual=True, **kw,
+            )
+            import sys
+
+            print(
+                f"tpukernels: {name} residual "
+                f"||x_k+1 - x_k||^2 = {float(res):.6e}",
+                file=sys.stderr,
+            )
+        else:
+            out = dist(jnp.asarray(x), int(p["iters"]), make_mesh(n), **kw)
     else:
         out = registry.lookup(name)(jnp.asarray(x), int(p["iters"]))
     np.copyto(x, np.asarray(out))

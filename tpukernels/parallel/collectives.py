@@ -19,6 +19,10 @@ patterns, as a `shard_map` program over a 1-D ring mesh:
 - `histogram_dist`   — privatized bins + MPI merge → local count +
                         psum (SURVEY.md §5 "MPI_Allreduce for ...
                         histogram merge")
+- `bcast`            — MPI_Bcast of root's params  → masked psum
+- `jacobi*_dist(residual=True)` — the stencil loop's periodic
+                        residual MPI_Allreduce (SURVEY.md §3(b)):
+                        global ||x_{k+1} - x_k||² via psum
 
 On the dev box these are logic-tested on 8 fake CPU devices
 (tests/test_distributed.py spawns subprocesses with the right env);
@@ -68,6 +72,34 @@ def allreduce_sum(x, mesh: Mesh, axis: str = "x"):
     return _allreduce_build(mesh, axis)(x)
 
 
+@functools.lru_cache(maxsize=None)
+def _bcast_build(mesh: Mesh, axis: str, root: int):
+    def local_fn(xl):  # (1, S) local row
+        rank = jax.lax.axis_index(axis)
+        contrib = jnp.where(rank == root, xl, jnp.zeros_like(xl))
+        return jax.lax.psum(contrib, axis)
+
+    return jax.jit(
+        shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=P(axis, None),
+            out_specs=P(axis, None),
+        )
+    )
+
+
+def bcast(x, mesh: Mesh, axis: str = "x", root: int = 0):
+    """MPI_Bcast (SURVEY.md §5 "likely ... MPI_Bcast params"): x is
+    (P, S) with row r = rank r's buffer; every row of the result is
+    row `root`'s data. Expressed as a masked psum — only root
+    contributes — which XLA lowers to the same one-to-all movement."""
+    nranks = mesh.shape[axis]
+    if not 0 <= root < nranks:
+        raise ValueError(f"root={root} out of range for {nranks} ranks")
+    return _bcast_build(mesh, axis, int(root))(x)
+
+
 # ------------------------------------------------------------- stencil
 
 def _edge_shift(p, ax: int, toward_end: bool):
@@ -84,7 +116,8 @@ def _edge_shift(p, ax: int, toward_end: bool):
     )
 
 
-def _jacobi_dist(x, iters: int, mesh: Mesh, axis: str, k: int):
+def _jacobi_dist(x, iters: int, mesh: Mesh, axis: str, k: int,
+                 residual: bool = False):
     """Dimension-generic sharded Jacobi: dim 0 sharded across the mesh
     axis, halo exchange via ppermute, mean-of-face-neighbors update,
     Dirichlet boundary.
@@ -105,11 +138,14 @@ def _jacobi_dist(x, iters: int, mesh: Mesh, axis: str, k: int):
     # clamp BEFORE the cache lookup so raw k values with the same
     # effective depth share one compiled program
     k = max(1, min(int(k), x.shape[0] // nranks))
-    return _jacobi_dist_build(x.shape, int(iters), mesh, axis, k)(x)
+    return _jacobi_dist_build(
+        x.shape, int(iters), mesh, axis, k, bool(residual)
+    )(x)
 
 
 @functools.lru_cache(maxsize=None)
-def _jacobi_dist_build(dims, iters: int, mesh: Mesh, axis: str, k: int):
+def _jacobi_dist_build(dims, iters: int, mesh: Mesh, axis: str, k: int,
+                       residual: bool = False):
     nranks = mesh.shape[axis]
     nd = len(dims)
     l0 = dims[0] // nranks
@@ -147,24 +183,39 @@ def _jacobi_dist_build(dims, iters: int, mesh: Mesh, axis: str, k: int):
         v = jax.lax.fori_loop(0, passes, lambda _, v: rounds(v, k), xl)
         if rem:
             v = rounds(v, rem)
+        if residual:
+            # the reference's periodic residual MPI_Allreduce
+            # (SURVEY.md §3(b)): the Jacobi convergence monitor
+            # ||x_{k+1} - x_k||² measured by one extra 1-deep-halo
+            # sweep whose result is only used for the delta — the
+            # returned grid is untouched, and psum over owned slices
+            # gives the exact global norm.
+            d = rounds(v, 1) - v
+            return v, jax.lax.psum(jnp.sum(d * d), axis)
         return v
 
     spec = P(axis, *([None] * (nd - 1)))
+    out_spec = (spec, P()) if residual else spec
     return jax.jit(
-        shard_map(local_fn, mesh=mesh, in_specs=spec, out_specs=spec)
+        shard_map(local_fn, mesh=mesh, in_specs=spec, out_specs=out_spec)
     )
 
 
-def jacobi2d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4):
+def jacobi2d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4,
+                  residual: bool = False):
     """Row-sharded Jacobi 5-point (SURVEY.md §3(b)): x (H, W) float32,
-    H % P == 0. See _jacobi_dist for the comm-avoiding halo scheme."""
-    return _jacobi_dist(x, iters, mesh, axis, k)
+    H % P == 0. See _jacobi_dist for the comm-avoiding halo scheme.
+    residual=True also returns the global ||x_{iters+1} - x_iters||²
+    (the loop's residual MPI_Allreduce) as a second output."""
+    return _jacobi_dist(x, iters, mesh, axis, k, residual)
 
 
-def jacobi3d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4):
+def jacobi3d_dist(x, iters: int, mesh: Mesh, axis: str = "x", k: int = 4,
+                  residual: bool = False):
     """z-sharded Jacobi 7-point: x (D, H, W) float32, D % P == 0.
-    See _jacobi_dist for the comm-avoiding halo scheme."""
-    return _jacobi_dist(x, iters, mesh, axis, k)
+    See _jacobi_dist for the comm-avoiding halo scheme; residual as in
+    jacobi2d_dist."""
+    return _jacobi_dist(x, iters, mesh, axis, k, residual)
 
 
 # ---------------------------------------------------- scan + histogram
