@@ -46,6 +46,40 @@ def run_cpu8(body: str) -> str:
     return proc.stdout
 
 
+def run_two_procs(worker_body: str) -> None:
+    """Launch a 2-process jax.distributed job (4 fake CPU devices per
+    process, 8 global). `worker_body` is formatted with {port} and run
+    with the process id as argv[1]; each worker must print
+    'proc <pid>: OK'."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = textwrap.dedent(worker_body.format(port=port))
+    env = _scrubbed_env(fake_devices=None)  # workers set their own
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker, str(i)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {i} failed:\n{out}"
+            assert f"proc {i}: OK" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
 def test_allreduce_sum_matches_mpi_semantics():
     out = run_cpu8("""
         import jax, numpy as np, jax.numpy as jnp
@@ -242,13 +276,7 @@ def test_multiprocess_allreduce():
     each, 8 global): the multi-host path the 8→64-chip bus-bw run
     uses, where the C driver launches once per host with identical
     args — the moral equivalent of mpirun (SURVEY.md §7)."""
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-
-    worker = textwrap.dedent(f"""
+    run_two_procs("""
         import os, sys
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -279,26 +307,28 @@ def test_multiprocess_allreduce():
         print(f"proc {{pid}}: OK")
     """)
 
-    env = _scrubbed_env(fake_devices=None)  # workers set their own
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", worker, str(i)],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for i in range(2)
-    ]
-    try:
-        outs = [p.communicate(timeout=240)[0] for p in procs]
-        for i, (p, out) in enumerate(zip(procs, outs)):
-            assert p.returncode == 0, f"proc {i} failed:\n{out}"
-            assert f"proc {i}: OK" in out
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+
+def test_multiprocess_busbw_sweep():
+    """The bus-bw microbenchmark must run under real multi-process
+    jax.distributed (the 8→64-chip configuration): global input arrays
+    are assembled shard-by-shard and the timing probe is a replicated
+    scalar every host can fetch."""
+    run_two_procs("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        import jax
+        pid = int(sys.argv[1])
+        jax.distributed.initialize(
+            "127.0.0.1:{port}", num_processes=2, process_id=pid)
+        assert jax.device_count() == 8
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.busbw import sweep
+        res = sweep(min_bytes=1024, max_bytes=4096, reps=2,
+                    mesh=make_mesh(8), verbose=False)
+        assert len(res) == 2 and all(bw > 0 for _, _, bw in res)
+        print(f"proc {{pid}}: OK")
+    """)
 
 
 def test_capi_mesh_routing():

@@ -23,7 +23,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpukernels.parallel.collectives import allreduce_sum
-from tpukernels.parallel.mesh import make_mesh, maybe_distributed_init
+from tpukernels.parallel.mesh import (
+    make_mesh,
+    maybe_distributed_init,
+    row_sharding,
+)
 
 
 def bus_bandwidth(seconds: float, nbytes: int, nranks: int) -> float:
@@ -41,15 +45,25 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
         maybe_distributed_init()
         mesh = make_mesh()
     nranks = mesh.shape["x"]
+    sharding = row_sharding(mesh)
     results = []
     size = min_bytes
     while size <= max_bytes:
         elems = max(size // 4, 1)
-        x = jnp.ones((nranks, elems), jnp.float32)
-
-        fn = jax.jit(
-            lambda v: allreduce_sum(v, mesh).ravel()[:1]
+        # multi-host safe: on a multi-process run (8→64-chip pods) a
+        # host-local jnp.ones can't feed a mesh spanning other hosts'
+        # devices — build the global array shard-by-shard, each host
+        # populating only its addressable slice
+        x = jax.make_array_from_callback(
+            (nranks, elems), sharding,
+            lambda idx: np.ones((1, elems), np.float32),
         )
+
+        # the timing probe must be fetchable on every host, so reduce
+        # to a fully-replicated scalar: one column summed across the
+        # rank axis — P extra scalars of traffic, negligible vs the
+        # message itself
+        fn = jax.jit(lambda v: jnp.sum(allreduce_sum(v, mesh)[:, :1]))
         # warm-up (compile) then per-call timing with a 4-byte
         # materialization to force real completion (device-side
         # block_until_ready is unreliable through the axon tunnel)
