@@ -331,6 +331,80 @@ def test_multiprocess_busbw_sweep():
     """)
 
 
+def test_multiprocess_capi_mesh():
+    """The C-shim adapters must work under real multi-process
+    jax.distributed (SURVEY.md §7 "multi-chip under a C driver"): the
+    driver runs once per host holding FULL buffers, so inputs are
+    assembled shard-by-shard and sharded outputs all-gathered back.
+    Exercises a sharded-in/sharded-out kernel (stencil), a
+    sharded-in/replicated-out one (histogram), the scan, and the
+    ring N-body (all-sharded state)."""
+    run_two_procs("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        os.environ["TPK_MESH"] = "8"
+        import jax
+        pid = int(sys.argv[1])
+        jax.distributed.initialize(
+            "127.0.0.1:{port}", num_processes=2, process_id=pid)
+        assert jax.device_count() == 8
+        import json
+        import numpy as np
+        import jax.numpy as jnp
+        from tpukernels import capi
+        from tpukernels.kernels.stencil import jacobi2d_reference
+        from tpukernels.kernels.nbody import nbody_reference
+
+        rng = np.random.default_rng(11)  # same seed on both hosts
+        h, w = 64, 128
+        x = np.ascontiguousarray(rng.standard_normal((h, w)), np.float32)
+        ref = np.asarray(jacobi2d_reference(jnp.asarray(x), 4))
+        params = json.dumps(
+            {{"iters": 4, "buffers": [{{"shape": [h, w], "dtype": "f32"}}]}})
+        assert capi.run_from_c("stencil2d", params, [x.ctypes.data]) == 0
+        np.testing.assert_allclose(x, ref, rtol=1e-5, atol=1e-6)
+
+        ns = 2048
+        xi = np.ascontiguousarray(rng.integers(0, 256, ns).astype(np.int32))
+        scan_buf = np.zeros(ns, np.int32)
+        params = json.dumps(
+            {{"buffers": [{{"shape": [ns], "dtype": "i32"}}] * 2}})
+        assert capi.run_from_c(
+            "scan", params, [xi.ctypes.data, scan_buf.ctypes.data]) == 0
+        np.testing.assert_array_equal(scan_buf, np.cumsum(xi))
+
+        hist_buf = np.zeros(256, np.int32)
+        params = json.dumps({{
+            "nbins": 256,
+            "buffers": [{{"shape": [ns], "dtype": "i32"}},
+                        {{"shape": [256], "dtype": "i32"}}]}})
+        assert capi.run_from_c(
+            "histogram", params, [xi.ctypes.data, hist_buf.ctypes.data]) == 0
+        np.testing.assert_array_equal(
+            hist_buf, np.bincount(xi, minlength=256))
+
+        os.environ["TPK_NBODY_DIST"] = "ring"
+        nb = 256
+        state = [np.ascontiguousarray(rng.standard_normal(nb), np.float32)
+                 for _ in range(6)]
+        m = np.ascontiguousarray(rng.uniform(0.5, 1.5, nb), np.float32)
+        ref6 = nbody_reference(
+            *(jnp.asarray(a) for a in state), jnp.asarray(m), steps=2)
+        params = json.dumps({{
+            "steps": 2,
+            "buffers": [{{"shape": [nb], "dtype": "f32"}}] * 7}})
+        bufs = state + [m]
+        assert capi.run_from_c(
+            "nbody", params, [a.ctypes.data for a in bufs]) == 0
+        for got, want in zip(state, ref6):
+            np.testing.assert_allclose(
+                got, np.asarray(want), rtol=5e-4, atol=5e-5)
+
+        print(f"proc {{pid}}: OK")
+    """)
+
+
 def test_capi_mesh_routing():
     """TPK_MESH>1 routes the C-shim adapters through the shard_map
     collective variants (SURVEY.md §5 config system) — the C driver's

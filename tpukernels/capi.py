@@ -77,6 +77,22 @@ def _mesh_size() -> int:
     return n
 
 
+def _to_global(a, mesh, spec):
+    """Mesh-path adapter input: see parallel.mesh.host_to_global."""
+    from jax.sharding import NamedSharding
+
+    from tpukernels.parallel.mesh import host_to_global
+
+    return host_to_global(a, NamedSharding(mesh, spec))
+
+
+def _to_host(o) -> np.ndarray:
+    """Mesh-path adapter output: see parallel.mesh.global_to_host."""
+    from tpukernels.parallel.mesh import global_to_host
+
+    return global_to_host(o)
+
+
 def _wrap(addr: int, spec: dict) -> np.ndarray:
     dt = np.dtype(_DTYPES[spec["dtype"]])
     shape = tuple(spec["shape"])
@@ -121,6 +137,8 @@ def _adapt_stencil(name, p, arrs):
     (x,) = arrs
     n = _mesh_size()
     if n > 1:
+        from jax.sharding import PartitionSpec as P
+
         from tpukernels.parallel import make_mesh
         from tpukernels.parallel import collectives
 
@@ -128,6 +146,8 @@ def _adapt_stencil(name, p, arrs):
             "stencil2d": collectives.jacobi2d_dist,
             "stencil3d": collectives.jacobi3d_dist,
         }[name]
+        mesh = make_mesh(n)
+        xg = _to_global(x, mesh, P("x", *[None] * (x.ndim - 1)))
         # honor the temporal-blocking knob in mesh mode too (the
         # dist k is the comm-avoiding halo depth, the multi-chip
         # mirror of the single-device TPK_STENCIL_K)
@@ -140,10 +160,7 @@ def _adapt_stencil(name, p, arrs):
         # + a global psum per tpu_run() call, so timed benchmark runs
         # should leave it unset (use it with --check / --reps=1)
         if os.environ.get("TPK_STENCIL_RESIDUAL") == "1":
-            out, res = dist(
-                jnp.asarray(x), int(p["iters"]), make_mesh(n),
-                residual=True, **kw,
-            )
+            out, res = dist(xg, int(p["iters"]), mesh, residual=True, **kw)
             import sys
 
             print(
@@ -152,10 +169,11 @@ def _adapt_stencil(name, p, arrs):
                 file=sys.stderr,
             )
         else:
-            out = dist(jnp.asarray(x), int(p["iters"]), make_mesh(n), **kw)
+            out = dist(xg, int(p["iters"]), mesh, **kw)
+        np.copyto(x, _to_host(out))
     else:
         out = registry.lookup(name)(jnp.asarray(x), int(p["iters"]))
-    np.copyto(x, np.asarray(out))
+        np.copyto(x, np.asarray(out))
 
 
 def _adapt_scan(p, arrs):
@@ -166,17 +184,21 @@ def _adapt_scan(p, arrs):
     x, out = arrs
     n = _mesh_size()
     if n > 1:
+        from jax.sharding import PartitionSpec as P
+
         from tpukernels.parallel import make_mesh
         from tpukernels.parallel.collectives import scan_dist
 
+        mesh = make_mesh(n)
         res = scan_dist(
-            jnp.asarray(x), make_mesh(n),
+            _to_global(x, mesh, P("x")), mesh,
             exclusive=bool(p.get("exclusive")),
         )
+        np.copyto(out, _to_host(res))
     else:
         name = "scan_exclusive" if p.get("exclusive") else "scan"
         res = registry.lookup(name)(jnp.asarray(x))
-    np.copyto(out, np.asarray(res))
+        np.copyto(out, np.asarray(res))
 
 
 def _adapt_histogram(p, arrs):
@@ -187,13 +209,19 @@ def _adapt_histogram(p, arrs):
     x, counts = arrs
     n = _mesh_size()
     if n > 1:
+        from jax.sharding import PartitionSpec as P
+
         from tpukernels.parallel import make_mesh
         from tpukernels.parallel.collectives import histogram_dist
 
-        res = histogram_dist(jnp.asarray(x), int(p["nbins"]), make_mesh(n))
+        mesh = make_mesh(n)
+        res = histogram_dist(
+            _to_global(x, mesh, P("x")), int(p["nbins"]), mesh
+        )
+        np.copyto(counts, _to_host(res))
     else:
         res = registry.lookup("histogram")(jnp.asarray(x), int(p["nbins"]))
-    np.copyto(counts, np.asarray(res))
+        np.copyto(counts, np.asarray(res))
 
 
 def _adapt_nbody(p, arrs):
@@ -221,16 +249,28 @@ def _adapt_nbody(p, arrs):
                 f"{sorted(variants)}"
             )
         fn = variants[variant]
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh(n)
+        # the psum formulation replicates positions/velocities and
+        # shards masses (force *sources*); the ring shards everything
+        if variant == "psum":
+            specs = (P(),) * 6 + (P("x"),)
+        else:
+            specs = (P("x"),) * 7
         state = tuple(
-            jnp.asarray(a) for a in (px, py, pz, vx, vy, vz, m)
+            _to_global(a, mesh, s)
+            for a, s in zip((px, py, pz, vx, vy, vz, m), specs)
         )
         out = fn(
             state,
             int(p.get("steps", 1)),
-            make_mesh(n),
+            mesh,
             dt=p.get("dt", 1e-3),
             eps=p.get("eps", 1e-2),
         )
+        for host, dev in zip((px, py, pz, vx, vy, vz), out):
+            np.copyto(host, _to_host(dev))
     else:
         out = registry.lookup("nbody")(
             *(jnp.asarray(a) for a in (px, py, pz, vx, vy, vz)),
@@ -239,22 +279,28 @@ def _adapt_nbody(p, arrs):
             eps=p.get("eps", 1e-2),
             steps=int(p.get("steps", 1)),
         )
-    for host, dev in zip((px, py, pz, vx, vy, vz), out):
-        np.copyto(host, np.asarray(dev))
+        for host, dev in zip((px, py, pz, vx, vy, vz), out):
+            np.copyto(host, np.asarray(dev))
 
 
 def _adapt_allreduce(p, arrs):
     import jax
-    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
 
     from tpukernels.parallel import make_mesh
     from tpukernels.parallel.collectives import allreduce_sum
 
     x, out = arrs
     ndev = _mesh_size() if "TPK_MESH" in os.environ else jax.device_count()
-    contrib = jnp.tile(jnp.asarray(x)[None, :], (ndev, 1))
-    res = allreduce_sum(contrib, make_mesh(ndev))
-    np.copyto(out, np.asarray(res[0]))
+    mesh = make_mesh(ndev)
+    contrib = _to_global(
+        np.broadcast_to(x, (ndev, x.shape[0])), mesh, P("x", None)
+    )
+    res = allreduce_sum(contrib, mesh)
+    # every row is identical, so fetch ONE locally-addressable shard
+    # row — a full-result D2H (let alone a cross-host gather) would
+    # multiply the timed transfer cost ndev-fold for identical data
+    np.copyto(out, np.asarray(res.addressable_shards[0].data)[0])
 
 
 _ADAPTERS = {

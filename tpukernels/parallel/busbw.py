@@ -24,6 +24,7 @@ import numpy as np
 
 from tpukernels.parallel.collectives import allreduce_sum
 from tpukernels.parallel.mesh import (
+    host_to_global,
     make_mesh,
     maybe_distributed_init,
     row_sharding,
@@ -50,13 +51,10 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
     size = min_bytes
     while size <= max_bytes:
         elems = max(size // 4, 1)
-        # multi-host safe: on a multi-process run (8→64-chip pods) a
-        # host-local jnp.ones can't feed a mesh spanning other hosts'
-        # devices — build the global array shard-by-shard, each host
-        # populating only its addressable slice
-        x = jax.make_array_from_callback(
-            (nranks, elems), sharding,
-            lambda idx: np.ones((1, elems), np.float32),
+        # multi-host safe: see mesh.host_to_global (a host-local array
+        # can't feed a mesh spanning other hosts' devices)
+        x = host_to_global(
+            np.ones((nranks, elems), np.float32), sharding
         )
 
         # the timing probe must be fetchable on every host, so reduce

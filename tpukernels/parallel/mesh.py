@@ -55,3 +55,32 @@ def row_sharding(mesh: Mesh, axis: str = "x") -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def host_to_global(a, sharding: NamedSharding):
+    """Device input for a shard_map program from a FULL per-host copy
+    (SURVEY.md §7 "multi-chip under a C driver": every host runs the
+    same driver with identical buffers). Single-process: plain
+    transfer, jit (re)shards it. Multi-process (8→64-chip pods): a
+    host-local array can't feed a mesh spanning other hosts' devices,
+    so assemble the global array shard-by-shard — each host
+    materializes only the slices its own devices hold."""
+    import jax.numpy as jnp
+
+    if jax.process_count() == 1:
+        return jnp.asarray(a)
+    return jax.make_array_from_callback(
+        a.shape, sharding, lambda idx: a[idx]
+    )
+
+
+def global_to_host(o) -> np.ndarray:
+    """Full host value of a shard_map output. Replicated outputs are
+    fetchable from any local shard; sharded outputs on a multi-process
+    run live partly on other hosts and are all-gathered first so every
+    host's driver sees (and checks) the whole result."""
+    if jax.process_count() > 1 and not o.is_fully_replicated:
+        from jax.experimental import multihost_utils
+
+        o = multihost_utils.process_allgather(o, tiled=True)
+    return np.asarray(o)
