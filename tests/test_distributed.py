@@ -500,6 +500,90 @@ def test_capi_mesh_too_large_raises():
     assert "OK" in out
 
 
+def test_fuzz_dist_shapes():
+    """Seeded shape-fuzz of every distributed variant across mesh
+    sizes 2/4/8 (the single-chip analog lives in test_fuzz_shapes.py):
+    divisible-but-awkward extents — one row per rank, prime multiples,
+    halo depths past the shard size — are where sharding/clamp logic
+    silently corrupts. One subprocess runs the whole deterministic
+    sweep."""
+    out = run_cpu8("""
+        import numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import (
+            bcast, histogram_dist, jacobi2d_dist, jacobi3d_dist,
+            nbody_dist_psum, nbody_dist_ring, scan_dist)
+        from tpukernels.kernels.stencil import (
+            jacobi2d_reference, jacobi3d_reference)
+        from tpukernels.kernels.nbody import nbody_reference
+        rng = np.random.default_rng(42)
+
+        for P_ in (2, 4, 8):
+            mesh = make_mesh(P_)
+
+            for n in (P_, 37 * P_, 128 * P_ + P_):
+                xi = jnp.asarray(
+                    rng.integers(-2**30, 2**30, n), jnp.int32)
+                want = np.cumsum(
+                    np.asarray(xi, np.int64)).astype(np.int32)
+                np.testing.assert_array_equal(
+                    np.asarray(scan_dist(xi, mesh)), want)
+                np.testing.assert_array_equal(
+                    np.asarray(scan_dist(xi, mesh, exclusive=True)),
+                    np.concatenate([[np.int32(0)], want[:-1]]))
+                xf = jnp.asarray(rng.standard_normal(n), jnp.float32)
+                np.testing.assert_allclose(
+                    np.asarray(scan_dist(xf, mesh)),
+                    np.cumsum(np.asarray(xf, np.float64)),
+                    rtol=1e-4, atol=1e-4)
+
+            for nbins in (1, 17, 256):
+                n = 41 * P_
+                xh = jnp.asarray(
+                    rng.integers(-2, nbins + 2, n), jnp.int32)
+                xh_np = np.asarray(xh)
+                np.testing.assert_array_equal(
+                    np.asarray(histogram_dist(xh, nbins, mesh)),
+                    np.bincount(xh_np[(xh_np >= 0) & (xh_np < nbins)],
+                                minlength=nbins))
+
+            for rows, k in ((1, 1), (5, 3), (3, 64)):
+                g = jnp.asarray(
+                    rng.standard_normal((rows * P_, 37)), jnp.float32)
+                np.testing.assert_allclose(
+                    np.asarray(jacobi2d_dist(g, 4, mesh, k=k)),
+                    np.asarray(jacobi2d_reference(g, 4)),
+                    rtol=1e-5, atol=1e-6)
+            g3 = jnp.asarray(
+                rng.standard_normal((3 * P_, 5, 37)), jnp.float32)
+            np.testing.assert_allclose(
+                np.asarray(jacobi3d_dist(g3, 3, mesh, k=2)),
+                np.asarray(jacobi3d_reference(g3, 3)),
+                rtol=1e-5, atol=1e-6)
+
+            nb = 9 * P_
+            state = tuple(
+                jnp.asarray(rng.standard_normal(nb), jnp.float32)
+                for _ in range(6)) + (
+                jnp.asarray(rng.uniform(0.5, 1.5, nb), jnp.float32),)
+            ref = nbody_reference(*state, steps=2)
+            for fn in (nbody_dist_psum, nbody_dist_ring):
+                for got, want in zip(fn(state, 2, mesh), ref):
+                    np.testing.assert_allclose(
+                        np.asarray(got), np.asarray(want),
+                        rtol=5e-4, atol=5e-5)
+
+            xb = jnp.asarray(
+                rng.standard_normal((P_, 13)), jnp.float32)
+            for root in (0, P_ - 1):
+                np.testing.assert_array_equal(
+                    np.asarray(bcast(xb, mesh, root=root)),
+                    np.tile(np.asarray(xb)[root], (P_, 1)))
+        print('OK')
+    """)
+    assert "OK" in out
+
+
 def test_busbw_sweep_runs():
     out = run_cpu8("""
         from tpukernels.parallel.busbw import sweep, bus_bandwidth
