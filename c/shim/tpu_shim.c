@@ -75,6 +75,9 @@ int tpu_init(void) {
         return 1;
     }
     g_initialized = 1;
+    /* Flush-on-exit for every C host, including ones that dlopen the
+     * ABI directly and never call tpu_shutdown themselves. */
+    atexit(tpu_shutdown);
     if (verbose()) fprintf(stderr, "tpu_shim: initialized (root=%s)\n", root);
     return 0;
 }
@@ -109,7 +112,27 @@ int tpu_run(const char *name, const char *params_json, void **bufs,
 void tpu_shutdown(void) {
     /* Intentionally do NOT Py_FinalizeEx: PJRT/runtime threads may
      * still be alive and finalization ordering with the TPU plugin is
-     * undefined (SURVEY.md §7 "hard parts"). The OS reclaims
-     * everything at exit. */
-    if (verbose()) fprintf(stderr, "tpu_shim: shutdown (noop)\n");
+     * undefined (SURVEY.md §7 "hard parts"). The OS reclaims memory at
+     * exit — but state that only flushes on clean teardown (the
+     * profiler trace) is flushed through a Python-side hook, since a
+     * never-finalized interpreter never runs Python atexit handlers. */
+    static int done = 0;
+    if (g_initialized && !done) {
+        done = 1; /* atexit + an explicit host call must not double-run */
+        /* The exiting thread may not hold the GIL (or any Python
+         * thread state at all) — acquire it properly. */
+        PyGILState_STATE gil = PyGILState_Ensure();
+        PyObject *mod = PyImport_ImportModule("tpukernels.capi");
+        if (mod) {
+            PyObject *res =
+                PyObject_CallMethod(mod, "shutdown_from_c", NULL);
+            if (!res) PyErr_Print();
+            Py_XDECREF(res);
+            Py_DECREF(mod);
+        } else {
+            PyErr_Print();
+        }
+        PyGILState_Release(gil);
+    }
+    if (verbose()) fprintf(stderr, "tpu_shim: shutdown\n");
 }

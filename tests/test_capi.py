@@ -105,3 +105,68 @@ def test_unknown_kernel_raises():
     params = json.dumps({"buffers": []})
     with pytest.raises(KeyError, match="no C adapter"):
         capi.run_from_c("not_a_kernel", params, [])
+
+
+def test_profiler_trace_flushes_on_exit(tmp_path):
+    """TPU_KERNELS_PROFILE traces only reach disk on stop_trace; a
+    Python host flushes via the capi atexit hook; C hosts go through
+    the shim's tpu_shutdown → shutdown_from_c instead (registered with
+    C atexit inside tpu_init, see test_profiler_trace_flushes_c_host)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_KERNELS_PROFILE"] = str(tmp_path)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    body = textwrap.dedent("""
+        import json
+        import numpy as np
+        from tpukernels import capi
+        n = 256
+        x = np.ascontiguousarray(np.arange(n), dtype=np.float32)
+        y = np.zeros(n, dtype=np.float32)
+        params = json.dumps({"alpha": 1.0, "buffers": [
+            {"shape": [n], "dtype": "f32"}] * 2})
+        assert capi.run_from_c(
+            "vector_add", params, [x.ctypes.data, y.ctypes.data]) == 0
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", body],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    traced = [
+        p for p in tmp_path.rglob("*") if p.is_file()
+    ]
+    assert traced, "no profile trace files were flushed"
+
+
+def test_profiler_trace_flushes_c_host(tmp_path):
+    """The C-host flush path: a built C driver binary exits without
+    finalizing the embedded interpreter, so the trace must flush via
+    the shim's atexit(tpu_shutdown) → shutdown_from_c chain."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.path.join(repo, "c", "bin", "vector_add")
+    if not os.path.exists(binary):
+        pytest.skip("c/bin not built (run `make -C c`)")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_KERNELS_PROFILE"] = str(tmp_path)
+    proc = subprocess.run(
+        [binary, "--device=tpu", "--check", "--reps=1", "--n=10000"],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.join(repo, "c"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CHECK PASS" in proc.stdout
+    traced = [p for p in tmp_path.rglob("*") if p.is_file()]
+    assert traced, "C host exited without flushing the profile trace"

@@ -34,13 +34,38 @@ _profiling = False
 def _maybe_start_profiler():
     """Opt-in tracing (SURVEY.md §5): TPU_KERNELS_PROFILE=<dir> wraps
     all shim-dispatched kernel work in a jax.profiler trace
-    (Perfetto/XProf) so MXU utilization and DMA traffic are visible."""
+    (Perfetto/XProf) so MXU utilization and DMA traffic are visible.
+    The trace only flushes to disk on stop_trace, so two flush paths
+    cover both host kinds: a Python atexit hook (Python hosts finalize
+    the interpreter, which runs atexit) and shutdown_from_c (C hosts
+    never finalize — the shim's tpu_shutdown, registered with C
+    atexit, calls it instead)."""
     global _profiling
     if _PROFILE_DIR and not _profiling:
+        import atexit
+
         import jax
 
         jax.profiler.start_trace(_PROFILE_DIR)
         _profiling = True
+        atexit.register(stop_profiler)
+
+
+def stop_profiler():
+    """Flush the opt-in profiler trace (idempotent)."""
+    global _profiling
+    if _profiling:
+        _profiling = False
+        import jax
+
+        jax.profiler.stop_trace()
+
+
+def shutdown_from_c() -> int:
+    """Called by the shim's tpu_shutdown (C atexit): flush anything
+    that only flushes on clean teardown — today, the profiler trace."""
+    stop_profiler()
+    return 0
 
 _DTYPES = {
     "f32": np.float32,
