@@ -75,20 +75,16 @@ static int sh_omp(const bench_params_t *p, void **bufs) {
 }
 
 static int sh_tpu(const bench_params_t *p, void **bufs) {
+    /* one combined dispatch: x crosses the host->device boundary once
+     * and feeds both halves (two separate calls would re-upload x and
+     * pay the fixed dispatch cost twice per timed rep) */
     char json[512];
     snprintf(json, sizeof(json),
-             "{\"buffers\":[{\"shape\":[%ld],\"dtype\":\"i32\"},"
-             "{\"shape\":[%ld],\"dtype\":\"i32\"}]}",
-             p->n, p->n);
-    void *scan_bufs[2] = {bufs[0], bufs[1]};
-    if (tpk_tpu_run("scan", json, scan_bufs, 2) != 0) return 1;
-
-    snprintf(json, sizeof(json),
              "{\"nbins\":%d,\"buffers\":[{\"shape\":[%ld],\"dtype\":\"i32\"},"
+             "{\"shape\":[%ld],\"dtype\":\"i32\"},"
              "{\"shape\":[%d],\"dtype\":\"i32\"}]}",
-             p->nbins, p->n, p->nbins);
-    void *hist_bufs[2] = {bufs[0], bufs[2]};
-    return tpk_tpu_run("histogram", json, hist_bufs, 2);
+             p->nbins, p->n, p->n, p->nbins);
+    return tpk_tpu_run("scan_histogram", json, bufs, 3);
 }
 
 static const tpk_dispatch_entry TABLE[] = {

@@ -117,7 +117,11 @@ void tpu_shutdown(void) {
      * profiler trace) is flushed through a Python-side hook, since a
      * never-finalized interpreter never runs Python atexit handlers. */
     static int done = 0;
-    if (g_initialized && !done) {
+    /* A Python host (ctypes/dlopen into a normal interpreter) will
+     * have finalized the runtime before C atexit handlers run —
+     * touching the C-API then aborts the process. Its own Python
+     * atexit hook has already flushed (capi registers one). */
+    if (g_initialized && !done && Py_IsInitialized()) {
         done = 1; /* atexit + an explicit host call must not double-run */
         /* The exiting thread may not hold the GIL (or any Python
          * thread state at all) — acquire it properly. */

@@ -170,3 +170,27 @@ def test_profiler_trace_flushes_c_host(tmp_path):
     assert "CHECK PASS" in proc.stdout
     traced = [p for p in tmp_path.rglob("*") if p.is_file()]
     assert traced, "C host exited without flushing the profile trace"
+
+
+def test_scan_histogram_combined_roundtrip(rng):
+    """The combined adapter the C driver's tpu row dispatches: one
+    upload of x feeding both halves."""
+    n, nbins = 3000, 32
+    x = np.ascontiguousarray(rng.integers(0, nbins, n), dtype=np.int32)
+    scan_out = np.zeros(n, dtype=np.int32)
+    counts = np.zeros(nbins, dtype=np.int32)
+    params = json.dumps(
+        {
+            "nbins": nbins,
+            "buffers": [
+                {"shape": [n], "dtype": "i32"},
+                {"shape": [n], "dtype": "i32"},
+                {"shape": [nbins], "dtype": "i32"},
+            ],
+        }
+    )
+    assert capi.run_from_c(
+        "scan_histogram", params, [_addr(x), _addr(scan_out), _addr(counts)]
+    ) == 0
+    np.testing.assert_array_equal(scan_out, np.cumsum(x))
+    np.testing.assert_array_equal(counts, np.bincount(x, minlength=nbins))

@@ -201,52 +201,76 @@ def _adapt_stencil(name, p, arrs):
         np.copyto(x, np.asarray(out))
 
 
-def _adapt_scan(p, arrs):
-    import jax.numpy as jnp
-
-    from tpukernels import registry
-
-    x, out = arrs
+def _mesh_ctx():
+    """(mesh_size, mesh-or-None) for the element-sharded adapters."""
     n = _mesh_size()
+    if n == 1:
+        return 1, None
+    from tpukernels.parallel import make_mesh
+
+    return n, make_mesh(n)
+
+
+def _upload_1d(x, n, mesh):
+    """One H2D of a 1-D buffer, element-sharded when a mesh is up."""
     if n > 1:
         from jax.sharding import PartitionSpec as P
 
-        from tpukernels.parallel import make_mesh
+        return _to_global(x, mesh, P("x"))
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
+def _run_scan(xd, exclusive, n, mesh):
+    if n > 1:
         from tpukernels.parallel.collectives import scan_dist
 
-        mesh = make_mesh(n)
-        res = scan_dist(
-            _to_global(x, mesh, P("x")), mesh,
-            exclusive=bool(p.get("exclusive")),
-        )
-        np.copyto(out, _to_host(res))
-    else:
-        name = "scan_exclusive" if p.get("exclusive") else "scan"
-        res = registry.lookup(name)(jnp.asarray(x))
-        np.copyto(out, np.asarray(res))
+        return scan_dist(xd, mesh, exclusive=exclusive)
+    from tpukernels import registry
+
+    return registry.lookup("scan_exclusive" if exclusive else "scan")(xd)
+
+
+def _run_histogram(xd, nbins, n, mesh):
+    if n > 1:
+        from tpukernels.parallel.collectives import histogram_dist
+
+        return histogram_dist(xd, nbins, mesh)
+    from tpukernels import registry
+
+    return registry.lookup("histogram")(xd, nbins)
+
+
+def _adapt_scan(p, arrs):
+    x, out = arrs
+    n, mesh = _mesh_ctx()
+    xd = _upload_1d(x, n, mesh)
+    np.copyto(
+        out, _to_host(_run_scan(xd, bool(p.get("exclusive")), n, mesh))
+    )
 
 
 def _adapt_histogram(p, arrs):
-    import jax.numpy as jnp
-
-    from tpukernels import registry
-
     x, counts = arrs
-    n = _mesh_size()
-    if n > 1:
-        from jax.sharding import PartitionSpec as P
+    n, mesh = _mesh_ctx()
+    xd = _upload_1d(x, n, mesh)
+    np.copyto(counts, _to_host(_run_histogram(xd, int(p["nbins"]), n, mesh)))
 
-        from tpukernels.parallel import make_mesh
-        from tpukernels.parallel.collectives import histogram_dist
 
-        mesh = make_mesh(n)
-        res = histogram_dist(
-            _to_global(x, mesh, P("x")), int(p["nbins"]), mesh
-        )
-        np.copyto(counts, _to_host(res))
-    else:
-        res = registry.lookup("histogram")(jnp.asarray(x), int(p["nbins"]))
-        np.copyto(counts, np.asarray(res))
+def _adapt_scan_histogram(p, arrs):
+    """Combined benchmark pass: one H2D of x feeds both halves (two
+    separate dispatches would re-upload x — through the tunnel that
+    doubles both the transfer bytes and the fixed dispatch cost inside
+    the C driver's timed loop; a CUDA variant would likewise reuse the
+    device-resident input)."""
+    x, scan_out, counts = arrs
+    n, mesh = _mesh_ctx()
+    xd = _upload_1d(x, n, mesh)
+    s = _run_scan(xd, bool(p.get("exclusive")), n, mesh)
+    h = _run_histogram(xd, int(p["nbins"]), n, mesh)
+    np.copyto(scan_out, _to_host(s))
+    np.copyto(counts, _to_host(h))
 
 
 def _adapt_nbody(p, arrs):
@@ -335,6 +359,7 @@ _ADAPTERS = {
     "stencil3d": functools.partial(_adapt_stencil, "stencil3d"),
     "scan": _adapt_scan,
     "histogram": _adapt_histogram,
+    "scan_histogram": _adapt_scan_histogram,
     "nbody": _adapt_nbody,
     "allreduce": _adapt_allreduce,
 }
