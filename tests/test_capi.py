@@ -194,3 +194,41 @@ def test_scan_histogram_combined_roundtrip(rng):
     ) == 0
     np.testing.assert_array_equal(scan_out, np.cumsum(x))
     np.testing.assert_array_equal(counts, np.bincount(x, minlength=nbins))
+
+
+def test_registry_reports_broken_kernel_module():
+    """A kernel module that fails to import must surface its real
+    error from lookup(), not a bare 'unknown kernel' dispatch miss."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    body = textwrap.dedent("""
+        import sys
+        import tpukernels.registry as reg
+        sys.modules["tpukernels.kernels.scan"] = None  # import raises
+        try:
+            reg.lookup("scan")
+            raise SystemExit("lookup('scan') did not raise")
+        except KeyError as e:
+            assert "failed to import" in str(e), e
+        assert "vector_add" in reg.names() and "scan" not in reg.names()
+        try:
+            reg.lookup("nope")
+            raise SystemExit("lookup('nope') did not raise")
+        except KeyError as e:
+            assert "unknown kernel" in str(e), e
+        print("OK")
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", body],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Callable, Dict
 
 _REGISTRY: Dict[str, Callable] = {}
+_IMPORT_ERRORS: Dict[str, BaseException] = {}  # kernel -> why it's absent
 _POPULATED = False
 
 
@@ -24,6 +25,10 @@ def lookup(name: str) -> Callable:
     try:
         return _REGISTRY[name]
     except KeyError:
+        if name in _IMPORT_ERRORS:
+            raise KeyError(
+                f"kernel {name!r} failed to import: {_IMPORT_ERRORS[name]!r}"
+            ) from _IMPORT_ERRORS[name]
         raise KeyError(
             f"unknown kernel {name!r}; known: {sorted(_REGISTRY)}"
         ) from None
@@ -38,32 +43,54 @@ def _populate():
     global _POPULATED
     if _POPULATED:
         return
-    _POPULATED = True
 
-    import tpukernels.kernels.vector_add as _vector_add
-    import tpukernels.kernels.sgemm as _sgemm
+    # Modules register in groups; a failed import leaves its kernels
+    # absent but lookup() then reports the REAL cause instead of
+    # "unknown kernel" (a bare except:pass here once meant a syntax
+    # error in a kernel module surfaced as a dispatch-table miss).
+    # Tracebacks are stripped before storing: the module-level dict
+    # lives as long as the (possibly C-embedded) interpreter, and a
+    # live traceback would pin every frame in the failed import.
+    # A failed REQUIRED group leaves _POPULATED false so a transient
+    # failure (e.g. TPU runtime hiccup at first import) is retryable.
+    def _group(names, load, required=False):
+        try:
+            load()
+        except Exception as e:  # noqa: BLE001 — recorded, re-raised on use
+            stripped = e.with_traceback(None)
+            for n in names:
+                _IMPORT_ERRORS[n] = stripped
+            if required:
+                raise
 
-    _REGISTRY["vector_add"] = _vector_add.saxpy
-    _REGISTRY["sgemm"] = _sgemm.sgemm
-    try:
+    def _load_core():
+        import tpukernels.kernels.vector_add as _vector_add
+        import tpukernels.kernels.sgemm as _sgemm
+
+        _REGISTRY["vector_add"] = _vector_add.saxpy
+        _REGISTRY["sgemm"] = _sgemm.sgemm
+
+    def _load_stencil():
         import tpukernels.kernels.stencil as _stencil
 
         _REGISTRY["stencil2d"] = _stencil.jacobi2d
         _REGISTRY["stencil3d"] = _stencil.jacobi3d
-    except ImportError:
-        pass
-    try:
+
+    def _load_scan_hist():
         import tpukernels.kernels.scan as _scan
         import tpukernels.kernels.histogram as _histogram
 
         _REGISTRY["scan"] = _scan.inclusive_scan
         _REGISTRY["scan_exclusive"] = _scan.exclusive_scan
         _REGISTRY["histogram"] = _histogram.histogram
-    except ImportError:
-        pass
-    try:
+
+    def _load_nbody():
         import tpukernels.kernels.nbody as _nbody
 
         _REGISTRY["nbody"] = _nbody.nbody_step
-    except ImportError:
-        pass
+
+    _group(("vector_add", "sgemm"), _load_core, required=True)
+    _group(("stencil2d", "stencil3d"), _load_stencil)
+    _group(("scan", "scan_exclusive", "histogram"), _load_scan_hist)
+    _group(("nbody",), _load_nbody)
+    _POPULATED = True
