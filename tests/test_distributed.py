@@ -176,6 +176,23 @@ def test_bcast_matches_mpi_semantics():
     assert "OK" in out
 
 
+def test_ring_shift_matches_sendrecv_semantics():
+    out = run_cpu8("""
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import ring_shift
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(12)
+        x = jnp.asarray(rng.standard_normal((8, 32)), jnp.float32)
+        for shift in (1, -1, 3):
+            got = np.asarray(ring_shift(x, mesh, shift=shift))
+            want = np.roll(np.asarray(x), shift, axis=0)
+            np.testing.assert_array_equal(got, want)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
 def test_jacobi_dist_residual():
     # residual=True returns the same grid plus the global squared norm
     # of the next sweep's update — checked against the single-device
@@ -590,6 +607,16 @@ def test_busbw_sweep_runs():
         res = sweep(min_bytes=1024, max_bytes=16384, reps=2, verbose=True)
         assert len(res) == 3
         assert all(bw > 0 for _, _, bw in res)
+        # the sendrecv-analog mode (per-link point-to-point accounting)
+        res_pp = sweep(min_bytes=1024, max_bytes=4096, reps=2,
+                       op="ppermute", verbose=False)
+        assert len(res_pp) == 2
+        assert all(bw > 0 for _, _, bw in res_pp)
+        try:
+            sweep(op="nope")
+            raise SystemExit("sweep(op='nope') did not raise")
+        except ValueError as e:
+            assert "nope" in str(e)
         # accounting formula spot-checks
         assert abs(bus_bandwidth(1.0, 1e9, 8) - 2*7/8) < 1e-9
         assert abs(bus_bandwidth(1.0, 1e9, 1) - 1.0) < 1e-9

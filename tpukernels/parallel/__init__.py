@@ -13,7 +13,8 @@ NCCL/Gloo/UCX anywhere.
                     with ppermute halos, i-sharded N-body with a
                     j-block ring, two-level prefix scan, psum-merged
                     histogram, plain allreduce
-- ``busbw``       — the allreduce bus-bandwidth microbenchmark
+- ``busbw``       — collective bandwidth microbenchmark (allreduce
+                    bus-bw; ppermute per-link point-to-point)
 """
 
 from tpukernels.parallel.mesh import make_mesh, maybe_distributed_init  # noqa: F401

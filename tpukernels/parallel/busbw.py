@@ -1,4 +1,4 @@
-"""Allreduce bus-bandwidth microbenchmark (SURVEY.md C9, §3(d)).
+"""Collective bandwidth microbenchmark (SURVEY.md C9, §3(d)).
 
 The reference's measured metric: MPI_Allreduce bus bandwidth swept
 over message sizes at 8→64 ranks. Bus bandwidth uses the standard
@@ -11,7 +11,14 @@ ring; run on a v5e pod slice this measures achieved ICI bandwidth.
 On fewer chips it still runs (n=1 is a degenerate no-comm copy) so
 the C driver's acceptance check works anywhere.
 
+op="ppermute" instead sweeps the bare neighbor exchange (the
+MPI_Sendrecv pattern under the stencil halos and the N-body j-ring):
+every rank sends its S-byte buffer one hop, so the reported figure is
+per-link point-to-point bandwidth, bytes / t — the number that
+predicts halo-exchange cost directly.
+
 CLI:  python -m tpukernels.parallel.busbw [--min=1KB] [--max=64MB]
+          [--op=allreduce|ppermute]
 """
 
 from __future__ import annotations
@@ -22,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpukernels.parallel.collectives import allreduce_sum
+from tpukernels.parallel.collectives import allreduce_sum, ring_shift
 from tpukernels.parallel.mesh import (
     host_to_global,
     make_mesh,
@@ -39,9 +46,13 @@ def bus_bandwidth(seconds: float, nbytes: int, nranks: int) -> float:
 
 
 def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
-          reps: int = 10, mesh=None, verbose: bool = True):
-    """Time psum-allreduce over message sizes; returns
-    [(bytes, seconds, busbw_GBps)]."""
+          reps: int = 10, mesh=None, verbose: bool = True,
+          op: str = "allreduce"):
+    """Time a collective over message sizes; returns
+    [(bytes, seconds, bw_GBps)]. op: "allreduce" (bus-bw accounting)
+    or "ppermute" (per-link point-to-point bandwidth)."""
+    if op not in ("allreduce", "ppermute"):
+        raise ValueError(f"op={op!r}: expected allreduce or ppermute")
     if mesh is None:
         maybe_distributed_init()
         mesh = make_mesh()
@@ -61,7 +72,8 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
         # to a fully-replicated scalar: one column summed across the
         # rank axis — P extra scalars of traffic, negligible vs the
         # message itself
-        fn = jax.jit(lambda v: jnp.sum(allreduce_sum(v, mesh)[:, :1]))
+        coll = allreduce_sum if op == "allreduce" else ring_shift
+        fn = jax.jit(lambda v: jnp.sum(coll(v, mesh)[:, :1]))
         # warm-up (compile) then per-call timing with a 4-byte
         # materialization to force real completion (device-side
         # block_until_ready is unreliable through the axon tunnel)
@@ -72,12 +84,15 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
             np.asarray(fn(x))
             t1 = time.perf_counter()
             best = min(best, t1 - t0)
-        bw = bus_bandwidth(best, size, nranks)
+        if op == "allreduce":
+            bw = bus_bandwidth(best, size, nranks)
+        else:
+            bw = size / best / 1e9  # per-link point-to-point
         results.append((size, best, bw))
         if verbose:
             print(
-                f"allreduce n={nranks} size={size:>10d}B "
-                f"time={best * 1e3:9.3f}ms busbw={bw:8.3f} GB/s"
+                f"{op} n={nranks} size={size:>10d}B "
+                f"time={best * 1e3:9.3f}ms bw={bw:8.3f} GB/s"
             )
         size *= 4
     return results
@@ -102,4 +117,6 @@ if __name__ == "__main__":
             kw["max_bytes"] = _parse_size(a[6:])
         elif a.startswith("--reps="):
             kw["reps"] = int(a[7:])
+        elif a.startswith("--op="):
+            kw["op"] = a[5:]
     sweep(**kw)

@@ -20,6 +20,8 @@ patterns, as a `shard_map` program over a 1-D ring mesh:
                         psum (SURVEY.md §5 "MPI_Allreduce for ...
                         histogram merge")
 - `bcast`            — MPI_Bcast of root's params  → masked psum
+- `ring_shift`       — bare MPI_Sendrecv neighbor  → ppermute (the
+                        halo/j-ring primitive, measurable alone)
 - `jacobi*_dist(residual=True)` — the stencil loop's periodic
                         residual MPI_Allreduce (SURVEY.md §3(b)):
                         global ||x_{k+1} - x_k||² via psum
@@ -87,6 +89,32 @@ def _bcast_build(mesh: Mesh, axis: str, root: int):
             out_specs=P(axis, None),
         )
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_shift_build(mesh: Mesh, axis: str, shift: int):
+    perm = _ring_perm(mesh.shape[axis], shift)
+
+    def local_fn(xl):  # (1, S) local row
+        return jax.lax.ppermute(xl, axis, perm)
+
+    return jax.jit(
+        shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=P(axis, None),
+            out_specs=P(axis, None),
+        )
+    )
+
+
+def ring_shift(x, mesh: Mesh, axis: str = "x", shift: int = 1):
+    """Neighbor exchange (the MPI_Sendrecv halo pattern in isolation):
+    x is (P, S) with row r = rank r's send buffer; row r of the result
+    is what rank r received, i.e. row (r - shift) mod P. This is the
+    primitive under the stencil halo exchange and the N-body j-ring —
+    exposed bare so its link bandwidth is measurable (busbw.py)."""
+    return _ring_shift_build(mesh, axis, int(shift))(x)
 
 
 def bcast(x, mesh: Mesh, axis: str = "x", root: int = 0):
