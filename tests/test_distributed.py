@@ -325,6 +325,51 @@ def test_multiprocess_allreduce():
     """)
 
 
+def test_multiprocess_small_collectives():
+    """bcast, ring_shift and the stencil residual under real
+    2-process jax.distributed — the masked-psum, ppermute and
+    replicated-scalar-output seams that fake-device runs can't prove
+    cross-host."""
+    run_two_procs("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        import jax
+        pid = int(sys.argv[1])
+        jax.distributed.initialize(
+            "127.0.0.1:{port}", num_processes=2, process_id=pid)
+        import numpy as np
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.mesh import host_to_global, \\
+            global_to_host, row_sharding
+        from tpukernels.parallel.collectives import (
+            bcast, jacobi2d_dist, ring_shift)
+        from tpukernels.kernels.stencil import jacobi2d_reference
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(13)  # same seed on both hosts
+        full = rng.standard_normal((8, 32)).astype(np.float32)
+        x = host_to_global(full, row_sharding(mesh))
+        np.testing.assert_array_equal(
+            global_to_host(bcast(x, mesh, root=5)),
+            np.tile(full[5], (8, 1)))
+        np.testing.assert_array_equal(
+            global_to_host(ring_shift(x, mesh, shift=1)),
+            np.roll(full, 1, axis=0))
+        grid_full = rng.standard_normal((64, 32)).astype(np.float32)
+        g = host_to_global(grid_full, row_sharding(mesh))
+        out, res = jacobi2d_dist(g, 3, mesh, residual=True)
+        # exact cross-host psum value vs the single-device oracle
+        # (a wrong reduction would still be >= 0 — compare the value)
+        r3 = np.asarray(jacobi2d_reference(grid_full, 3), np.float64)
+        r4 = np.asarray(jacobi2d_reference(grid_full, 4), np.float64)
+        np.testing.assert_allclose(
+            float(res), ((r4 - r3) ** 2).sum(), rtol=1e-4)
+        plain = global_to_host(jacobi2d_dist(g, 3, mesh))
+        np.testing.assert_array_equal(global_to_host(out), plain)
+        print(f"proc {{pid}}: OK")
+    """)
+
+
 def test_multiprocess_busbw_sweep():
     """The bus-bw microbenchmark must run under real multi-process
     jax.distributed (the 8→64-chip configuration): global input arrays
