@@ -63,6 +63,8 @@ if [ -n "${TPK_TEST_MESH:-}" ] && [ "${TPK_TEST_MESH}" != "0" ]; then
     shift
     run_row "$mesh_env" "$b" tpu "$@"
   done
+  # both N-body formulations (default row above is psum)
+  run_row "$mesh_env TPK_NBODY_DIST=ring" nbody tpu --n=1024 --iters=2
 fi
 
 if [ "$fail" = "1" ]; then
