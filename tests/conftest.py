@@ -12,6 +12,110 @@ spawns subprocesses with a scrubbed env.
 """
 
 import os
+import subprocess
+import sys
+import time
+
+# A wedged axon tunnel HANGS (never errors) anything that initializes
+# the TPU backend — which on this box is the whole suite, since
+# sitecustomize force-registers axon whenever PALLAS_AXON_POOL_IPS is
+# set. pytest_configure (below) probes it in a killable subprocess
+# before any test module imports jax; if the chip doesn't answer, it
+# re-execs pytest with the axon env scrubbed so the suite runs
+# CPU-interpret instead of hanging until some outer timeout kills it.
+# TPK_FORCE_TPU_PROBE_FAIL=1 forces the dead-tunnel path (used by the
+# regression test).
+_PROBE_GUARD = "TPK_TPU_PROBE_DONE"
+_PROBE_SENTINEL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache",
+    "tpu_probe_ok",
+)
+_PROBE_TTL_S = 600  # healthy probes are cached this long
+
+
+def _tpu_hangs() -> bool:
+    """True only when the tunnel HANGS (the wedge mode this guard
+    exists for). A fast nonzero exit means the backend errors loudly —
+    the suite won't hang, so it proceeds on the TPU path and fails
+    honestly; the probe's stderr is surfaced as a warning."""
+    if os.environ.get("TPK_FORCE_TPU_PROBE_FAIL") == "1":
+        return True
+    try:
+        if (
+            os.path.exists(_PROBE_SENTINEL)
+            and time.time() - os.path.getmtime(_PROBE_SENTINEL)
+            < _PROBE_TTL_S
+        ):
+            return False  # recently proven alive; skip the slow probe
+    except OSError:
+        pass
+    try:
+        probe = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp; "
+                "(jnp.ones((8,8)) @ jnp.ones((8,8)))"
+                ".block_until_ready()",
+            ],
+            timeout=120,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return True
+    if probe.returncode != 0:
+        print(
+            "conftest: TPU probe exited nonzero (suite stays on the "
+            "TPU path):\n" + probe.stderr[-2000:],
+            file=sys.stderr,
+        )
+        return False
+    try:
+        os.makedirs(os.path.dirname(_PROBE_SENTINEL), exist_ok=True)
+        with open(_PROBE_SENTINEL, "w") as f:
+            f.write(str(time.time()))
+    except OSError:
+        pass
+    return False
+
+
+def pytest_configure(config):
+    if not os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get(
+        _PROBE_GUARD
+    ):
+        return
+    os.environ[_PROBE_GUARD] = "1"  # never probe (or re-exec) twice
+    if not _tpu_hangs():
+        return
+    if os.environ.get("TPK_REQUIRE_TPU") == "1":
+        # the caller (tools/tpu_revalidate.sh) is specifically asking
+        # "is the compiled path back?" — a silent CPU fallback would
+        # answer yes with the chip still dead
+        raise RuntimeError(
+            "TPU tunnel unreachable and TPK_REQUIRE_TPU=1 - refusing "
+            "the CPU fallback"
+        )
+    # restore the real stdout/stderr fds before replacing the process:
+    # pytest's fd-level capture is already active, and the exec'd
+    # pytest would otherwise write into this process's capture files
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    print(
+        "conftest: TPU tunnel unreachable - re-running the suite "
+        "on CPU (interpret mode)",
+        file=sys.stderr,
+    )
+    sys.stderr.flush()
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "pytest"] + sys.argv[1:],
+        env,
+    )
 
 # Explicit assignment, not setdefault: the dev/CI shell may have
 # JAX_PLATFORMS pre-set to a TPU plugin (e.g. axon), and the contract

@@ -5,8 +5,10 @@
 set -x -o pipefail
 cd /root/repo
 
-# 1. Compiled-path test suite (axon backend, kernels compile on chip)
-timeout 1800 python -m pytest tests/ -q | tail -2
+# 1. Compiled-path test suite (axon backend, kernels compile on chip).
+# TPK_REQUIRE_TPU=1: a still-wedged tunnel must FAIL here, not slip
+# into conftest's silent CPU fallback.
+timeout 1800 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
 
 # 2. C acceptance gate: serial/omp + real TPU rows + fake-device mesh
 make -C c -s
