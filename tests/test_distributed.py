@@ -399,8 +399,9 @@ def test_multiprocess_capi_mesh():
     driver runs once per host holding FULL buffers, so inputs are
     assembled shard-by-shard and sharded outputs all-gathered back.
     Exercises a sharded-in/sharded-out kernel (stencil), a
-    sharded-in/replicated-out one (histogram), the scan, and the
-    ring N-body (all-sharded state)."""
+    sharded-in/replicated-out one (histogram), the scan, and both
+    N-body formulations (ring: all-sharded state; psum: replicated
+    positions + sharded masses)."""
     run_two_procs("""
         import os, sys
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -446,22 +447,27 @@ def test_multiprocess_capi_mesh():
         np.testing.assert_array_equal(
             hist_buf, np.bincount(xi, minlength=256))
 
-        os.environ["TPK_NBODY_DIST"] = "ring"
-        nb = 256
-        state = [np.ascontiguousarray(rng.standard_normal(nb), np.float32)
-                 for _ in range(6)]
-        m = np.ascontiguousarray(rng.uniform(0.5, 1.5, nb), np.float32)
-        ref6 = nbody_reference(
-            *(jnp.asarray(a) for a in state), jnp.asarray(m), steps=2)
-        params = json.dumps({{
-            "steps": 2,
-            "buffers": [{{"shape": [nb], "dtype": "f32"}}] * 7}})
-        bufs = state + [m]
-        assert capi.run_from_c(
-            "nbody", params, [a.ctypes.data for a in bufs]) == 0
-        for got, want in zip(state, ref6):
-            np.testing.assert_allclose(
-                got, np.asarray(want), rtol=5e-4, atol=5e-5)
+        # ring: all-sharded state; psum: replicated positions + sharded
+        # masses — two different multi-host input-assembly seams
+        for variant in ("ring", "psum"):
+            os.environ["TPK_NBODY_DIST"] = variant
+            nb = 256
+            state = [np.ascontiguousarray(
+                         rng.standard_normal(nb), np.float32)
+                     for _ in range(6)]
+            m = np.ascontiguousarray(
+                rng.uniform(0.5, 1.5, nb), np.float32)
+            ref6 = nbody_reference(
+                *(jnp.asarray(a) for a in state), jnp.asarray(m), steps=2)
+            params = json.dumps({{
+                "steps": 2,
+                "buffers": [{{"shape": [nb], "dtype": "f32"}}] * 7}})
+            bufs = state + [m]
+            assert capi.run_from_c(
+                "nbody", params, [a.ctypes.data for a in bufs]) == 0
+            for got, want in zip(state, ref6):
+                np.testing.assert_allclose(
+                    got, np.asarray(want), rtol=5e-4, atol=5e-5)
 
         print(f"proc {{pid}}: OK")
     """)
