@@ -38,6 +38,7 @@ fi
 
 run vector_add --n=100000
 run sgemm --n=256
+run sgemm --m=64 --n=192 --k=320   # rectangular + off-tile extents
 run stencil --n=256 --iters=10
 run stencil --n=64 --z=64 --iters=5
 run scan_histogram --n=100000
