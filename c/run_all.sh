@@ -40,6 +40,7 @@ run vector_add --n=100000
 run sgemm --n=256
 run sgemm --m=64 --n=192 --k=320   # rectangular + off-tile extents
 run stencil --n=256 --iters=10
+run stencil --n=128 --m=320 --iters=5   # rectangular H x W
 run stencil --n=64 --z=64 --iters=5
 run scan_histogram --n=100000
 run nbody --n=1024 --iters=2
@@ -54,6 +55,7 @@ if [ -n "${TPK_TEST_MESH:-}" ] && [ "${TPK_TEST_MESH}" != "0" ]; then
   mesh_env="$mesh_env XLA_FLAGS=--xla_force_host_platform_device_count=$n"
   for cmd in \
       "stencil --n=256 --iters=10" \
+      "stencil --n=128 --m=320 --iters=5" \
       "stencil --n=64 --z=64 --iters=5" \
       "scan_histogram --n=100000" \
       "nbody --n=1024 --iters=2" \
