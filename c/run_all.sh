@@ -43,6 +43,7 @@ run stencil --n=256 --iters=10
 run stencil --n=128 --m=320 --iters=5   # rectangular H x W
 run stencil --n=64 --z=64 --iters=5
 run scan_histogram --n=100000
+run scan_histogram --n=50000 --nbins=64
 run nbody --n=1024 --iters=2
 run allreduce_bench --n=1048576
 
