@@ -1,7 +1,5 @@
 from tpukernels.utils.shapes import (  # noqa: F401
     cdiv,
-    round_up,
-    pad_to_multiple,
     default_interpret,
 )
 from tpukernels.utils.timing import time_jitted  # noqa: F401

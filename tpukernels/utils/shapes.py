@@ -11,7 +11,6 @@ import functools
 import os
 
 import jax
-import jax.numpy as jnp
 
 LANES = 128
 SUBLANES_F32 = 8
@@ -19,24 +18,6 @@ SUBLANES_F32 = 8
 
 def cdiv(a: int, b: int) -> int:
     return -(-a // b)
-
-
-def round_up(a: int, b: int) -> int:
-    return cdiv(a, b) * b
-
-
-def pad_to_multiple(x: jax.Array, multiple: int, axis: int = 0, value=0):
-    """Pad `x` along `axis` so its size is a multiple of `multiple`.
-
-    Returns (padded, original_size).
-    """
-    n = x.shape[axis]
-    target = round_up(n, multiple)
-    if target == n:
-        return x, n
-    pad_width = [(0, 0)] * x.ndim
-    pad_width[axis] = (0, target - n)
-    return jnp.pad(x, pad_width, constant_values=value), n
 
 
 @functools.cache
