@@ -1,0 +1,52 @@
+"""Shared-utils tests: the interpret-mode knob every Pallas kernel
+consults (TPU_KERNELS_INTERPRET, documented in README) and cdiv."""
+
+import os
+import subprocess
+import sys
+
+from tpukernels.utils import cdiv
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_cdiv():
+    assert cdiv(0, 8) == 0
+    assert cdiv(1, 8) == 1
+    assert cdiv(8, 8) == 1
+    assert cdiv(9, 8) == 2
+
+
+def _interpret_in_subprocess(override: str | None) -> str:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("TPU_KERNELS_INTERPRET", None)
+    if override is not None:
+        env["TPU_KERNELS_INTERPRET"] = override
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from tpukernels.utils import default_interpret; "
+         "print(default_interpret())"],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout.strip()
+
+
+def test_default_interpret_cpu_backend_defaults_on():
+    assert _interpret_in_subprocess(None) == "True"
+
+
+def test_default_interpret_env_override(monkeypatch):
+    # the override branch returns before any backend query, so it can
+    # be exercised in-process (only the defaults case needs subprocess
+    # isolation for backend selection)
+    from tpukernels.utils import default_interpret
+
+    for value, want in (("0", False), ("1", True), ("false", False)):
+        monkeypatch.setenv("TPU_KERNELS_INTERPRET", value)
+        default_interpret.cache_clear()
+        assert default_interpret() is want
+    default_interpret.cache_clear()  # don't leak state to other tests
