@@ -116,13 +116,15 @@ void tpu_shutdown(void) {
      * exit — but state that only flushes on clean teardown (the
      * profiler trace) is flushed through a Python-side hook, since a
      * never-finalized interpreter never runs Python atexit handlers. */
-    static int done = 0;
     /* A Python host (ctypes/dlopen into a normal interpreter) will
      * have finalized the runtime before C atexit handlers run —
      * touching the C-API then aborts the process. Its own Python
-     * atexit hook has already flushed (capi registers one). */
-    if (g_initialized && !done && Py_IsInitialized()) {
-        done = 1; /* atexit + an explicit host call must not double-run */
+     * atexit hook has already flushed (capi registers one).
+     * No run-once latch: shutdown_from_c is idempotent, and a host
+     * that calls tpu_shutdown explicitly and then keeps dispatching
+     * restarts the profiler trace — the atexit flush must still run
+     * for it. */
+    if (g_initialized && Py_IsInitialized()) {
         /* The exiting thread may not hold the GIL (or any Python
          * thread state at all) — acquire it properly. */
         PyGILState_STATE gil = PyGILState_Ensure();

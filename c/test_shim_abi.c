@@ -5,7 +5,10 @@
  *
  * Exercises the shim directly, without a benchmark driver on top.
  */
+#define _GNU_SOURCE /* RTLD_DEFAULT */
+#include <dlfcn.h>
 #include <math.h>
+#include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -67,6 +70,49 @@ int main(void) {
     /* 5. interpreter reuse: second good call still works */
     rc = tpk_tpu_run("vector_add", json, bufs, 2);
     CHECK(rc == 0, "shim survives errors and keeps working");
+
+    /* 6. three-buffer int32 kernel (the combined benchmark dispatch) */
+    enum { NS = 512, NB = 16 };
+    int32_t xi[NS], scan_out[NS], hist[NB];
+    for (int i = 0; i < NS; i++) xi[i] = i % NB;
+    memset(scan_out, 0, sizeof(scan_out));
+    memset(hist, 0, sizeof(hist));
+    void *bufs3[3] = {xi, scan_out, hist};
+    snprintf(json, sizeof(json),
+             "{\"nbins\":%d,\"buffers\":[{\"shape\":[%d],\"dtype\":\"i32\"},"
+             "{\"shape\":[%d],\"dtype\":\"i32\"},"
+             "{\"shape\":[%d],\"dtype\":\"i32\"}]}",
+             NB, NS, NS, NB);
+    rc = tpk_tpu_run("scan_histogram", json, bufs3, 3);
+    CHECK(rc == 0, "scan_histogram (3 buffers, i32) returns 0");
+    bad = 0;
+    int32_t run = 0;
+    for (int i = 0; i < NS; i++) {
+        run += xi[i];
+        if (scan_out[i] != run) bad++;
+    }
+    for (int b = 0; b < NB; b++)
+        if (hist[b] != NS / NB) bad++;
+    CHECK(bad == 0, "i32 scan + histogram values exact");
+
+    /* 7. explicit tpu_shutdown is safe, idempotent, and does not
+     * break later calls (the interpreter stays alive; only the
+     * teardown flush runs, once) */
+    /* the client loads the shim with RTLD_GLOBAL, so the symbol is
+     * visible in the default namespace */
+    void (*shutdown_fn)(void) =
+        (void (*)(void))dlsym(RTLD_DEFAULT, "tpu_shutdown");
+    CHECK(shutdown_fn != NULL, "tpu_shutdown symbol resolvable");
+    if (shutdown_fn) {
+        shutdown_fn();
+        shutdown_fn(); /* idempotent */
+        snprintf(json, sizeof(json),
+                 "{\"alpha\":2.0,\"buffers\":[{\"shape\":[%d],"
+                 "\"dtype\":\"f32\"},{\"shape\":[%d],\"dtype\":\"f32\"}]}",
+                 N, N);
+        rc = tpk_tpu_run("vector_add", json, bufs, 2);
+        CHECK(rc == 0, "calls still work after explicit shutdown");
+    }
 
     if (failures) {
         printf("test_shim_abi: %d FAILURES\n", failures);

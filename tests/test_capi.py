@@ -282,3 +282,42 @@ def test_require_tpu_refuses_cpu_fallback():
     )
     assert proc.returncode != 0
     assert "refusing the CPU fallback" in proc.stdout + proc.stderr
+
+
+def test_profiler_restart_after_shutdown_flushes(tmp_path):
+    """A host that flushes (shutdown_from_c) and keeps dispatching
+    restarts the trace; the restarted trace must flush too — two
+    stop_trace dumps, not one."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_KERNELS_PROFILE"] = str(tmp_path)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    body = textwrap.dedent("""
+        import json, time
+        import numpy as np
+        from tpukernels import capi
+        n = 128
+        x = np.ascontiguousarray(np.arange(n), dtype=np.float32)
+        y = np.zeros(n, dtype=np.float32)
+        params = json.dumps({"alpha": 1.0, "buffers": [
+            {"shape": [n], "dtype": "f32"}] * 2})
+        for _ in range(2):
+            assert capi.run_from_c(
+                "vector_add", params, [x.ctypes.data, y.ctypes.data]) == 0
+            capi.shutdown_from_c()
+            time.sleep(1.1)  # dump dirs are second-granularity stamps
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", body],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    dumps = list(tmp_path.glob("plugins/profile/*"))
+    assert len(dumps) == 2, f"expected 2 trace dumps, got {dumps}"
