@@ -1,0 +1,52 @@
+"""Host-side unit tests for bench.py's timing machinery — the slope
+methodology everything in BASELINE.md rests on. The benchmark bodies
+need a chip; the watchdog, best-of timer, and slope arithmetic are
+pure host code and testable here."""
+
+import time
+
+import numpy as np
+import pytest
+
+import bench
+
+
+def test_with_timeout_interrupts_a_hang():
+    with pytest.raises(bench._Timeout):
+        bench._with_timeout(lambda: time.sleep(5), seconds=1)
+
+
+def test_with_timeout_passes_result_and_restores_alarm():
+    assert bench._with_timeout(lambda: 42, seconds=1) == 42
+    # sleep PAST the 1s alarm: if the cancel in _with_timeout's
+    # finally block regressed, the stale alarm fires here and kills
+    # the test instead of shipping silently
+    time.sleep(1.2)
+
+
+def test_timeit_returns_best_and_counts_calls():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return np.zeros(1)
+
+    best = bench._timeit(fn, reps=3, warmup=2)
+    assert best >= 0.0
+    assert len(calls) == 5  # warmup + reps
+
+
+def test_slope_cancels_fixed_cost():
+    # fake "kernel": cost = FIXED + R * PER_ITER, implemented with
+    # sleeps; the slope must recover PER_ITER, not FIXED + PER_ITER
+    fixed, per_iter = 0.05, 0.01
+
+    def make_fn(r):
+        def fn():
+            time.sleep(fixed + r * per_iter)
+            return np.zeros(1)
+
+        return fn, ()
+
+    est = bench._slope(make_fn, 2, 10, samples=3)
+    assert est == pytest.approx(per_iter, rel=0.3)
