@@ -1,0 +1,62 @@
+"""Unit tests for conftest's TPU-liveness probe plumbing (the wedge
+fallback itself is covered end-to-end in test_capi.py). The probe
+subprocess is faked to always "hang" so the tests prove the sentinel
+short-circuit ordering rather than the environment's TPU state."""
+
+import subprocess
+import time
+
+import conftest as cft
+
+
+def _fake_hanging_probe(monkeypatch):
+    def fake_run(*args, **kwargs):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(cft.subprocess, "run", fake_run)
+
+
+def test_fresh_sentinel_skips_the_probe(tmp_path, monkeypatch):
+    sentinel = tmp_path / "tpu_probe_ok"
+    sentinel.write_text(str(time.time()))
+    monkeypatch.setattr(cft, "_PROBE_SENTINEL", str(sentinel))
+    monkeypatch.delenv("TPK_FORCE_TPU_PROBE_FAIL", raising=False)
+    _fake_hanging_probe(monkeypatch)
+    # the fake probe would report a hang; False proves the fresh
+    # sentinel short-circuited before probing
+    assert cft._tpu_hangs() is False
+
+
+def test_stale_sentinel_probes(tmp_path, monkeypatch):
+    import os
+
+    sentinel = tmp_path / "tpu_probe_ok"
+    sentinel.write_text("old")
+    old = time.time() - (cft._PROBE_TTL_S + 60)
+    os.utime(sentinel, (old, old))
+    monkeypatch.setattr(cft, "_PROBE_SENTINEL", str(sentinel))
+    monkeypatch.delenv("TPK_FORCE_TPU_PROBE_FAIL", raising=False)
+    _fake_hanging_probe(monkeypatch)
+    # stale sentinel must NOT short-circuit: the (fake, hanging)
+    # probe runs and reports the wedge
+    assert cft._tpu_hangs() is True
+
+
+def test_missing_sentinel_probes(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        cft, "_PROBE_SENTINEL", str(tmp_path / "never_written")
+    )
+    monkeypatch.delenv("TPK_FORCE_TPU_PROBE_FAIL", raising=False)
+    _fake_hanging_probe(monkeypatch)
+    assert cft._tpu_hangs() is True
+
+
+def test_forced_fail_wins_over_sentinel(tmp_path, monkeypatch):
+    sentinel = tmp_path / "tpu_probe_ok"
+    sentinel.write_text(str(time.time()))
+    monkeypatch.setattr(cft, "_PROBE_SENTINEL", str(sentinel))
+    monkeypatch.setenv("TPK_FORCE_TPU_PROBE_FAIL", "1")
+    # fake the probe too so an ordering regression fails fast and
+    # deterministically instead of spawning the real 120s probe
+    _fake_hanging_probe(monkeypatch)
+    assert cft._tpu_hangs() is True
