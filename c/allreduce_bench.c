@@ -8,7 +8,10 @@
  * (BASELINE.json metric). On the TPU path nranks = however many chips
  * the mesh has (1 on the dev box — a degenerate but honest check);
  * serial/omp model the single-rank case. The full sweep lives in
- * `python -m tpukernels.parallel.busbw`.
+ * `python -m tpukernels.parallel.busbw`, and TPK_BUSBW_SWEEP=1 makes
+ * THIS binary emit the same swept table once, shim-side, during the
+ * untimed --check pass (TPK_BUSBW_MIN/MAX/REPS/OP tune it) — one C
+ * invocation per host produces the metric-of-record table on a pod.
  */
 #include <math.h>
 #include <stdio.h>

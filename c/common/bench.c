@@ -126,13 +126,6 @@ size_t bench_check_f32(const float *got, const float *want, size_t n,
     return bad;
 }
 
-size_t bench_check_u64(const uint64_t *got, const uint64_t *want, size_t n) {
-    size_t bad = 0;
-    for (size_t i = 0; i < n; i++)
-        if (got[i] != want[i]) bad++;
-    return bad;
-}
-
 int bench_report_check(const char *kernel, size_t mismatches, size_t n,
                        double max_err) {
     if (mismatches == 0) {

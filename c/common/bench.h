@@ -55,7 +55,6 @@ void bench_fill_u32(uint32_t *dst, size_t n, uint32_t bound,
  * and writes the worst absolute error to *max_err if non-NULL. */
 size_t bench_check_f32(const float *got, const float *want, size_t n,
                        double rtol, double atol, double *max_err);
-size_t bench_check_u64(const uint64_t *got, const uint64_t *want, size_t n);
 
 /* Prints "CHECK PASS"/"CHECK FAIL ..." and returns 0 on pass. */
 int bench_report_check(const char *kernel, size_t mismatches, size_t n,

@@ -69,6 +69,10 @@ if [ -n "${TPK_TEST_MESH:-}" ] && [ "${TPK_TEST_MESH}" != "0" ]; then
   done
   # both N-body formulations (default row above is psum)
   run_row "$mesh_env TPK_NBODY_DIST=ring" nbody tpu --n=1024 --iters=2
+  # the shim-side bus-bw sweep (SURVEY.md §3(d)): the C binary itself
+  # must be able to emit the metric-of-record table
+  run_row "$mesh_env TPK_BUSBW_SWEEP=1 TPK_BUSBW_MIN=1K TPK_BUSBW_MAX=16K TPK_BUSBW_REPS=2" \
+    allreduce_bench tpu --n=1048576
 fi
 
 if [ "$fail" = "1" ]; then

@@ -2,12 +2,18 @@
  * the tpukernels Python package (SURVEY.md C10; north-star: "a thin
  * ctypes shim" seen from the C side of the ABI).
  */
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE /* on_exit (glibc): exit status for the watchdog */
+#endif
+
 #include "tpu_shim.h"
 
 #include <Python.h>
+#include <pthread.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
 
 #ifndef TPK_DEFAULT_ROOT
 #define TPK_DEFAULT_ROOT "."
@@ -18,6 +24,8 @@
 
 static PyObject *g_run_from_c = NULL; /* tpukernels.capi.run_from_c */
 static int g_initialized = 0;
+
+static void shutdown_on_exit(int status, void *arg);
 
 static int verbose(void) {
     const char *v = getenv("TPU_KERNELS_VERBOSE");
@@ -76,8 +84,12 @@ int tpu_init(void) {
     }
     g_initialized = 1;
     /* Flush-on-exit for every C host, including ones that dlopen the
-     * ABI directly and never call tpu_shutdown themselves. */
-    atexit(tpu_shutdown);
+     * ABI directly and never call tpu_shutdown themselves. on_exit
+     * (not atexit) so the handler sees the host's exit status: the
+     * wedged-flush watchdog must _exit with the REAL status — a
+     * benchmark that exit(1)ed on a failed check must not be turned
+     * into rc=0 (nor a pass into a failure) by the flush bailout. */
+    on_exit(shutdown_on_exit, NULL);
     if (verbose()) fprintf(stderr, "tpu_shim: initialized (root=%s)\n", root);
     return 0;
 }
@@ -109,6 +121,105 @@ int tpu_run(const char *name, const char *params_json, void **bufs,
     return (int)rc;
 }
 
+/* Flush Python-side teardown state (the profiler trace). Caller must
+ * hold the GIL. */
+static void flush_python_side(void) {
+    PyObject *mod = PyImport_ImportModule("tpukernels.capi");
+    if (mod) {
+        PyObject *res = PyObject_CallMethod(mod, "shutdown_from_c", NULL);
+        if (!res) PyErr_Print();
+        Py_XDECREF(res);
+        Py_DECREF(mod);
+    } else {
+        PyErr_Print();
+    }
+}
+
+static struct {
+    pthread_mutex_t mu;
+    pthread_cond_t cv;
+    unsigned gen;  /* bumps per tpu_shutdown attempt: a worker from a
+                    * PRIOR (timed-out, detached) attempt that finally
+                    * unparks must neither flush during teardown nor
+                    * satisfy the current attempt's wait */
+    int done;
+    int flushing;  /* worker holds the GIL and is running the flush */
+    int abandoned; /* timed out: process teardown is underway */
+} g_flush = {PTHREAD_MUTEX_INITIALIZER, PTHREAD_COND_INITIALIZER, 0, 0, 0, 0};
+
+/* Exit status the watchdog re-raises when it has to _exit: the real
+ * one when we're inside exit() (recorded by shutdown_on_exit), else a
+ * distinctive code for an explicit mid-program tpu_shutdown whose
+ * flush wedged (the host intended to continue; 86 marks the kill). */
+static int g_exit_status = 86;
+
+static void shutdown_on_exit(int status, void *arg) {
+    (void)arg;
+    g_exit_status = status;
+    tpu_shutdown();
+}
+
+/* The GIL timeout below bounds *acquiring* the GIL, but the flush
+ * itself (jax.profiler.stop_trace fetching trace data) can block
+ * forever through a wedged axon tunnel — on the inline path there is
+ * no other bound at all. A detached watchdog forces the exit if a
+ * flush attempt is still unfinished after 30 s: by then the host's
+ * results are printed and an incomplete trace beats a hung process. */
+static struct {
+    pthread_mutex_t mu;
+    pthread_cond_t cv;
+    unsigned armed_gen; /* bumped when a flush attempt starts */
+    unsigned done_gen;  /* advanced to armed_gen when it finishes */
+} g_wd = {PTHREAD_MUTEX_INITIALIZER, PTHREAD_COND_INITIALIZER, 0, 0};
+
+static void *flush_watchdog(void *arg) {
+    unsigned my_gen = (unsigned)(uintptr_t)arg;
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    ts.tv_sec += 30;
+    pthread_mutex_lock(&g_wd.mu);
+    int rc = 0;
+    while ((int)(g_wd.done_gen - my_gen) < 0 && rc == 0)
+        rc = pthread_cond_timedwait(&g_wd.cv, &g_wd.mu, &ts);
+    int done = (int)(g_wd.done_gen - my_gen) >= 0;
+    pthread_mutex_unlock(&g_wd.mu);
+    if (!done) {
+        fprintf(stderr, "tpu_shim: shutdown flush wedged for 30s "
+                        "(dead tunnel?); forcing exit\n");
+        fflush(NULL); /* don't lose the host's buffered results */
+        _exit(g_exit_status);
+    }
+    return NULL;
+}
+
+static void *flush_worker(void *arg) {
+    unsigned my_gen = (unsigned)(uintptr_t)arg;
+    PyGILState_STATE gil = PyGILState_Ensure();
+    /* If the main thread gave up waiting (or a later tpu_shutdown
+     * call superseded this attempt), exit() may already be running
+     * atexit handlers/static destructors — touching Python or JAX now
+     * could segfault a process whose results were already printed.
+     * Checked AFTER acquiring the GIL so the common race (GIL freed
+     * just past the timeout) skips the flush rather than crashing.
+     * Setting `flushing` under the same lock makes the states
+     * mutually exclusive: either the timeout abandons a worker still
+     * parked on the GIL, or the main thread sees `flushing` and
+     * waits for the (brief) flush to finish — never both. */
+    pthread_mutex_lock(&g_flush.mu);
+    int stale = g_flush.gen != my_gen || g_flush.abandoned;
+    if (!stale) g_flush.flushing = 1;
+    pthread_mutex_unlock(&g_flush.mu);
+    if (!stale) flush_python_side();
+    PyGILState_Release(gil);
+    pthread_mutex_lock(&g_flush.mu);
+    if (g_flush.gen == my_gen) {
+        g_flush.done = 1;
+        pthread_cond_signal(&g_flush.cv);
+    }
+    pthread_mutex_unlock(&g_flush.mu);
+    return NULL;
+}
+
 void tpu_shutdown(void) {
     /* Intentionally do NOT Py_FinalizeEx: PJRT/runtime threads may
      * still be alive and finalization ordering with the TPU plugin is
@@ -125,20 +236,70 @@ void tpu_shutdown(void) {
      * restarts the profiler trace — the atexit flush must still run
      * for it. */
     if (g_initialized && Py_IsInitialized()) {
-        /* The exiting thread may not hold the GIL (or any Python
-         * thread state at all) — acquire it properly. */
-        PyGILState_STATE gil = PyGILState_Ensure();
-        PyObject *mod = PyImport_ImportModule("tpukernels.capi");
-        if (mod) {
-            PyObject *res =
-                PyObject_CallMethod(mod, "shutdown_from_c", NULL);
-            if (!res) PyErr_Print();
-            Py_XDECREF(res);
-            Py_DECREF(mod);
+        pthread_t wd;
+        pthread_mutex_lock(&g_wd.mu);
+        unsigned wd_gen = ++g_wd.armed_gen;
+        pthread_mutex_unlock(&g_wd.mu);
+        if (pthread_create(&wd, NULL, flush_watchdog,
+                           (void *)(uintptr_t)wd_gen) == 0)
+            pthread_detach(wd);
+        if (PyGILState_Check()) {
+            /* Common C-host case: the main thread initialized Python,
+             * still holds the GIL, and runs atexit — flush inline. */
+            flush_python_side();
         } else {
-            PyErr_Print();
+            /* PyGILState_Ensure has no timeout, and a JAX/PJRT
+             * background thread holding the GIL at exit would park
+             * this exit handler forever. Bound the wait: acquire the
+             * GIL on a helper thread and abandon the flush (losing at
+             * worst an unflushed profiler trace) if it can't get the
+             * GIL in time — the process must exit. */
+            pthread_t t;
+            pthread_mutex_lock(&g_flush.mu);
+            unsigned my_gen = ++g_flush.gen;
+            g_flush.done = 0;
+            g_flush.flushing = 0;
+            g_flush.abandoned = 0;
+            pthread_mutex_unlock(&g_flush.mu);
+            if (pthread_create(&t, NULL, flush_worker,
+                               (void *)(uintptr_t)my_gen) != 0) {
+                fprintf(stderr,
+                        "tpu_shim: cannot spawn shutdown flush thread; "
+                        "exiting without flush\n");
+            } else {
+                struct timespec ts;
+                clock_gettime(CLOCK_REALTIME, &ts);
+                ts.tv_sec += 10;
+                pthread_mutex_lock(&g_flush.mu);
+                int rc = 0;
+                while (!g_flush.done && rc == 0)
+                    rc = pthread_cond_timedwait(&g_flush.cv, &g_flush.mu,
+                                                &ts);
+                /* The timeout only abandons a worker still parked on
+                 * PyGILState_Ensure. If the flush already started,
+                 * wait it out (it's brief) — returning into exit()'s
+                 * teardown mid-flush is the crash this code exists
+                 * to prevent. */
+                if (!g_flush.done && !g_flush.flushing)
+                    g_flush.abandoned = 1;
+                while (!g_flush.done && !g_flush.abandoned)
+                    pthread_cond_wait(&g_flush.cv, &g_flush.mu);
+                int done = g_flush.done;
+                pthread_mutex_unlock(&g_flush.mu);
+                if (done) {
+                    pthread_join(t, NULL);
+                } else {
+                    pthread_detach(t);
+                    fprintf(stderr,
+                            "tpu_shim: shutdown flush timed out (GIL "
+                            "held elsewhere); exiting without flush\n");
+                }
+            }
         }
-        PyGILState_Release(gil);
+        pthread_mutex_lock(&g_wd.mu);
+        g_wd.done_gen = wd_gen;
+        pthread_cond_broadcast(&g_wd.cv);
+        pthread_mutex_unlock(&g_wd.mu);
     }
     if (verbose()) fprintf(stderr, "tpu_shim: shutdown\n");
 }

@@ -31,7 +31,7 @@ _PROBE_SENTINEL = os.path.join(
     ".jax_cache",
     "tpu_probe_ok",
 )
-_PROBE_TTL_S = 600  # healthy probes are cached this long
+_PROBE_TTL_S = 600  # healthy probes are cached this long (single-use)
 
 
 def _tpu_hangs() -> bool:
@@ -47,6 +47,11 @@ def _tpu_hangs() -> bool:
             and time.time() - os.path.getmtime(_PROBE_SENTINEL)
             < _PROBE_TTL_S
         ):
+            # single-use: consume the sentinel so the NEXT run
+            # re-probes — a tunnel that wedges right after a healthy
+            # probe then costs at most one hung suite, not every run
+            # inside the TTL window
+            os.unlink(_PROBE_SENTINEL)
             return False  # recently proven alive; skip the slow probe
     except OSError:
         pass

@@ -25,6 +25,11 @@ def test_fresh_sentinel_skips_the_probe(tmp_path, monkeypatch):
     # the fake probe would report a hang; False proves the fresh
     # sentinel short-circuited before probing
     assert cft._tpu_hangs() is False
+    # the sentinel is single-use: consumed by the skip, so the next
+    # run re-probes — a wedge right after a healthy probe costs at
+    # most one hung suite
+    assert not sentinel.exists()
+    assert cft._tpu_hangs() is True
 
 
 def test_stale_sentinel_probes(tmp_path, monkeypatch):

@@ -23,13 +23,24 @@ def _scrubbed_env(fake_devices: int | None = 8) -> dict:
     set), force CPU, optionally request fake devices."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    # make_mesh joins the multi-host job when coordinator vars are
+    # present; these isolated fake-device subprocesses must not (the
+    # run_two_procs workers set their own coordinator deliberately)
+    for var in (
+        "JAX_COORDINATOR_ADDRESS",
+        "COORDINATOR_ADDRESS",
+        "JAX_NUM_PROCESSES",
+        "JAX_PROCESS_ID",
+    ):
+        env.pop(var, None)
     env["JAX_PLATFORMS"] = "cpu"
     if fake_devices:
         env["XLA_FLAGS"] = (
             env.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={fake_devices}"
         ).strip()
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    prev = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = REPO + (os.pathsep + prev if prev else "")
     return env
 
 
@@ -548,6 +559,41 @@ def test_capi_mesh_routing():
         print('OK')
     """)
     assert "OK" in out
+
+
+def test_capi_busbw_sweep_env():
+    """TPK_BUSBW_SWEEP=1 makes the allreduce adapter emit the swept
+    bus-bandwidth table (the pod metric of record) exactly once per
+    process — on the C driver's first, untimed call — leaving repeat
+    (timed) calls undisturbed. SURVEY.md §3(d), zero new C flags."""
+    out = run_cpu8("""
+        import os, json
+        os.environ["TPK_MESH"] = "8"
+        os.environ["TPK_BUSBW_SWEEP"] = "1"
+        os.environ["TPK_BUSBW_MIN"] = "1K"
+        os.environ["TPK_BUSBW_MAX"] = "16K"
+        os.environ["TPK_BUSBW_REPS"] = "2"
+        import numpy as np
+        from tpukernels import capi
+
+        s = 256
+        rng = np.random.default_rng(7)
+        xs = np.ascontiguousarray(rng.standard_normal(s), np.float32)
+        out_buf = np.zeros(s, np.float32)
+        params = json.dumps(
+            {"buffers": [{"shape": [s], "dtype": "f32"}] * 2})
+        for _ in range(3):  # check + warm-up + timed rep
+            assert capi.run_from_c(
+                "allreduce", params,
+                [xs.ctypes.data, out_buf.ctypes.data]) == 0
+        np.testing.assert_allclose(out_buf, 8 * xs, rtol=1e-5)
+        print('CALLS-DONE')
+    """)
+    assert "CALLS-DONE" in out
+    # sizes 1K, 4K, 16K — one table, printed once despite 3 calls
+    sweep_lines = [l for l in out.splitlines() if l.startswith("allreduce n=8")]
+    assert len(sweep_lines) == 3, out
+    assert "size=      1024B" in out and "size=     16384B" in out
 
 
 def test_capi_mesh_too_large_raises():

@@ -17,6 +17,12 @@ make -C c -s
 # 3. Headline metrics (median-of-slopes; see bench.py docstring)
 timeout 3000 python bench.py
 
+# 3b. C-path scan_histogram throughput (docs/NEXT.md item 2): the
+#     combined one-dispatch adapter halved per-rep dispatch cost;
+#     record this Melem/s in docs/PERF.md next to the kernel-level
+#     number.
+(cd c && timeout 600 ./bin/scan_histogram --device=tpu --n=4194304 --check)
+
 # 4. Knob sanity: histogram impls agree, sgemm precisions hold their
 #    error contracts (exercised by tests above; these are quick
 #    re-confirms on the chip)

@@ -92,6 +92,13 @@ def _mesh_size() -> int:
         return 1
     import jax
 
+    from tpukernels.parallel.mesh import maybe_distributed_init
+
+    # join the multi-host job BEFORE the first topology read —
+    # device_count() initializes the backend, and a pre-join backend
+    # sees only this host's chips (and poisons the later
+    # jax.distributed.initialize)
+    maybe_distributed_init()
     have = jax.device_count()
     if have < n:
         raise RuntimeError(
@@ -332,16 +339,45 @@ def _adapt_nbody(p, arrs):
             np.copyto(host, np.asarray(dev))
 
 
+_busbw_swept = False
+
+
+def _maybe_busbw_sweep(mesh):
+    """TPK_BUSBW_SWEEP=1 (SURVEY.md §3(d), zero new C flags): one
+    `allreduce_bench --device=tpu` invocation per host also emits the
+    swept message-size bus-bandwidth table — the metric of record on a
+    pod — without needing `python -m tpukernels.parallel.busbw`
+    alongside the C binary. Runs exactly once per process, on the
+    driver's FIRST allreduce call (the untimed --check pass), so the
+    timed reps that follow are undisturbed. TPK_BUSBW_MIN/MAX (sizes,
+    e.g. 1K/64M), TPK_BUSBW_REPS and TPK_BUSBW_OP
+    (allreduce|ppermute) tune the sweep."""
+    global _busbw_swept
+    if _busbw_swept or os.environ.get("TPK_BUSBW_SWEEP") != "1":
+        return
+    _busbw_swept = True
+    from tpukernels.parallel.busbw import sweep_from_env
+
+    sweep_from_env(mesh=mesh)
+
+
 def _adapt_allreduce(p, arrs):
     import jax
     from jax.sharding import PartitionSpec as P
 
     from tpukernels.parallel import make_mesh
     from tpukernels.parallel.collectives import allreduce_sum
+    from tpukernels.parallel.mesh import maybe_distributed_init
 
     x, out = arrs
+    # multi-host pod runs (one C invocation per host, coordinator env
+    # vars set) must join the job BEFORE device_count() reads the
+    # topology; make_mesh repeats the (idempotent) call for every
+    # other adapter. No-op without the coordinator env.
+    maybe_distributed_init()
     ndev = _mesh_size() if "TPK_MESH" in os.environ else jax.device_count()
     mesh = make_mesh(ndev)
+    _maybe_busbw_sweep(mesh)
     contrib = _to_global(
         np.broadcast_to(x, (ndev, x.shape[0])), mesh, P("x", None)
     )

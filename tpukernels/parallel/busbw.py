@@ -33,7 +33,6 @@ from tpukernels.parallel.collectives import allreduce_sum, ring_shift
 from tpukernels.parallel.mesh import (
     host_to_global,
     make_mesh,
-    maybe_distributed_init,
     row_sharding,
 )
 
@@ -54,8 +53,7 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
     if op not in ("allreduce", "ppermute"):
         raise ValueError(f"op={op!r}: expected allreduce or ppermute")
     if mesh is None:
-        maybe_distributed_init()
-        mesh = make_mesh()
+        mesh = make_mesh()  # joins the multi-host job when configured
     nranks = mesh.shape["x"]
     sharding = row_sharding(mesh)
     results = []
@@ -96,6 +94,26 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
             )
         size *= 4
     return results
+
+
+def sweep_from_env(mesh=None):
+    """sweep() configured by the TPK_BUSBW_* env knobs (SURVEY.md §5
+    config system: the C driver grows zero new flags, so
+    `allreduce_bench --device=tpu` under TPK_BUSBW_SWEEP=1 tunes the
+    table through TPK_BUSBW_MIN/MAX (sizes, e.g. 1K/64M),
+    TPK_BUSBW_REPS and TPK_BUSBW_OP (allreduce|ppermute))."""
+    import os
+
+    kw = {}
+    if "TPK_BUSBW_MIN" in os.environ:
+        kw["min_bytes"] = _parse_size(os.environ["TPK_BUSBW_MIN"])
+    if "TPK_BUSBW_MAX" in os.environ:
+        kw["max_bytes"] = _parse_size(os.environ["TPK_BUSBW_MAX"])
+    if "TPK_BUSBW_REPS" in os.environ:
+        kw["reps"] = int(os.environ["TPK_BUSBW_REPS"])
+    if "TPK_BUSBW_OP" in os.environ:
+        kw["op"] = os.environ["TPK_BUSBW_OP"]
+    return sweep(mesh=mesh, **kw)
 
 
 def _parse_size(s: str) -> int:

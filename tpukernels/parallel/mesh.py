@@ -22,8 +22,13 @@ def maybe_distributed_init() -> None:
     Single-process single-host (the common case, and always the case
     on this 1-chip dev box) needs nothing. Multi-host runs set the
     standard env vars; mirror mpirun's contract by only initializing
-    when they are present.
+    when they are present. Idempotent — jax.distributed.initialize
+    raises on a second call, and every make_mesh (one per adapter
+    call, so the C driver's warm-up + timed reps repeat it) funnels
+    through here.
     """
+    if jax.distributed.is_initialized():
+        return
     if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
     ):
@@ -37,7 +42,14 @@ def make_mesh(n_devices: int | None = None, axis: str = "x") -> Mesh:
     body rotation, allreduce) are 1-D ring patterns, so a 1-D mesh is
     the faithful topology; ICI ring ordering is what
     `jax.lax.ppermute` rides on.
+
+    Joins the multi-host job first when a coordinator is configured:
+    EVERY pod-capable path (all C-shim adapters, busbw, the dryrun)
+    builds its mesh here, and a mesh built before
+    jax.distributed.initialize would silently cover only this host's
+    chips.
     """
+    maybe_distributed_init()
     devs = jax.devices()
     if n_devices is None:
         n_devices = len(devs)

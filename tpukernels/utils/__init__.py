@@ -2,4 +2,3 @@ from tpukernels.utils.shapes import (  # noqa: F401
     cdiv,
     default_interpret,
 )
-from tpukernels.utils.timing import time_jitted  # noqa: F401
