@@ -48,6 +48,12 @@ int tpu_init(void) {
         }
     }
 
+    /* A Python host reaching here via ctypes does NOT hold the GIL
+     * (ctypes releases it around foreign calls) — every C-API touch
+     * below needs it. Recursion-safe for the C host, whose main
+     * thread still holds the GIL from Py_InitializeFromConfig. */
+    PyGILState_STATE gil = PyGILState_Ensure();
+
     /* Make the kernel package and the venv's site-packages importable.
      * Overridable at runtime; defaults baked in by the Makefile. */
     const char *root = getenv("TPU_KERNELS_ROOT");
@@ -62,9 +68,10 @@ int tpu_init(void) {
              "    if _p and _p not in sys.path:\n"
              "        sys.path.insert(0, _p)\n",
              site, root);
+    int rc = 1;
     if (PyRun_SimpleString(buf) != 0) {
         fprintf(stderr, "tpu_shim: failed to extend sys.path\n");
-        return 1;
+        goto out;
     }
 
     PyObject *mod = PyImport_ImportModule("tpukernels.capi");
@@ -73,15 +80,19 @@ int tpu_init(void) {
         fprintf(stderr, "tpu_shim: cannot import tpukernels.capi "
                         "(TPU_KERNELS_ROOT=%s)\n",
                 root);
-        return 1;
+        goto out;
     }
     g_run_from_c = PyObject_GetAttrString(mod, "run_from_c");
     Py_DECREF(mod);
     if (!g_run_from_c || !PyCallable_Check(g_run_from_c)) {
         PyErr_Print();
         fprintf(stderr, "tpu_shim: tpukernels.capi.run_from_c missing\n");
-        return 1;
+        goto out;
     }
+    rc = 0;
+out:
+    PyGILState_Release(gil);
+    if (rc != 0) return rc;
     g_initialized = 1;
     /* Flush-on-exit for every C host, including ones that dlopen the
      * ABI directly and never call tpu_shutdown themselves. on_exit
@@ -98,8 +109,11 @@ int tpu_run(const char *name, const char *params_json, void **bufs,
             int nbufs) {
     if (!g_initialized && tpu_init() != 0) return 1;
 
+    /* See tpu_init: a ctypes host calls in without the GIL. */
+    PyGILState_STATE gil = PyGILState_Ensure();
+    long rc = 1;
     PyObject *addrs = PyList_New(nbufs);
-    if (!addrs) return 1;
+    if (!addrs) goto out;
     for (int i = 0; i < nbufs; i++) {
         PyList_SET_ITEM(addrs, i,
                         PyLong_FromUnsignedLongLong((unsigned long long)(uintptr_t)bufs[i]));
@@ -110,14 +124,16 @@ int tpu_run(const char *name, const char *params_json, void **bufs,
     if (!res) {
         PyErr_Print();
         fprintf(stderr, "tpu_shim: kernel '%s' raised\n", name);
-        return 1;
+        goto out;
     }
-    long rc = PyLong_AsLong(res);
+    rc = PyLong_AsLong(res);
     Py_DECREF(res);
     if (rc == -1 && PyErr_Occurred()) {
         PyErr_Print();
-        return 1;
+        rc = 1;
     }
+out:
+    PyGILState_Release(gil);
     return (int)rc;
 }
 
@@ -163,8 +179,18 @@ static void shutdown_on_exit(int status, void *arg) {
  * itself (jax.profiler.stop_trace fetching trace data) can block
  * forever through a wedged axon tunnel — on the inline path there is
  * no other bound at all. A detached watchdog forces the exit if a
- * flush attempt is still unfinished after 30 s: by then the host's
- * results are printed and an incomplete trace beats a hung process. */
+ * flush attempt is still unfinished after the deadline: by then the
+ * host's results are printed and an incomplete trace beats a hung
+ * process. TPU_KERNELS_FLUSH_TIMEOUT (seconds, default 30) tunes it —
+ * primarily so the wedge path is testable without a 30 s wait. */
+static int flush_timeout_s(void) {
+    const char *v = getenv("TPU_KERNELS_FLUSH_TIMEOUT");
+    if (v && v[0]) {
+        int t = atoi(v);
+        if (t > 0) return t;
+    }
+    return 30;
+}
 static struct {
     pthread_mutex_t mu;
     pthread_cond_t cv;
@@ -176,7 +202,10 @@ static void *flush_watchdog(void *arg) {
     unsigned my_gen = (unsigned)(uintptr_t)arg;
     struct timespec ts;
     clock_gettime(CLOCK_REALTIME, &ts);
-    ts.tv_sec += 30;
+    /* Strictly later than the worker path's GIL bound (min(t,10)):
+     * the clean abandon-flush-and-keep-running outcome must win the
+     * race against this _exit, so give it a 5 s head start. */
+    ts.tv_sec += flush_timeout_s() + 5;
     pthread_mutex_lock(&g_wd.mu);
     int rc = 0;
     while ((int)(g_wd.done_gen - my_gen) < 0 && rc == 0)
@@ -184,8 +213,9 @@ static void *flush_watchdog(void *arg) {
     int done = (int)(g_wd.done_gen - my_gen) >= 0;
     pthread_mutex_unlock(&g_wd.mu);
     if (!done) {
-        fprintf(stderr, "tpu_shim: shutdown flush wedged for 30s "
-                        "(dead tunnel?); forcing exit\n");
+        fprintf(stderr, "tpu_shim: shutdown flush wedged for %ds "
+                        "(dead tunnel?); forcing exit\n",
+                flush_timeout_s() + 5);
         fflush(NULL); /* don't lose the host's buffered results */
         _exit(g_exit_status);
     }
@@ -269,7 +299,8 @@ void tpu_shutdown(void) {
             } else {
                 struct timespec ts;
                 clock_gettime(CLOCK_REALTIME, &ts);
-                ts.tv_sec += 10;
+                int gil_t = flush_timeout_s();
+                ts.tv_sec += gil_t < 10 ? gil_t : 10;
                 pthread_mutex_lock(&g_flush.mu);
                 int rc = 0;
                 while (!g_flush.done && rc == 0)

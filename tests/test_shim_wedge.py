@@ -1,0 +1,91 @@
+"""Wedged-flush watchdog tests (c/shim/tpu_shim.c).
+
+A dead axon tunnel can wedge jax.profiler.stop_trace forever inside
+the shim's exit-time flush; the shim's watchdog must force the exit
+after TPU_KERNELS_FLUSH_TIMEOUT seconds instead of hanging the host.
+Driven through the real libtpukernels.so with a stub tpukernels.capi
+whose shutdown_from_c sleeps past the deadline — no TPU (or jax)
+involved, so the wedge is deterministic and fast.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SHIM = os.path.join(REPO, "c", "bin", "libtpukernels.so")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(SHIM), reason="C shim not built (make -C c)"
+)
+
+
+def _write_stub(tmp_path, shutdown_body: str) -> str:
+    """A stand-in tpukernels package the shim imports instead of the
+    real one (TPU_KERNELS_ROOT wins the sys.path race)."""
+    pkg = tmp_path / "stub" / "tpukernels"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "capi.py").write_text(textwrap.dedent(f"""
+        def run_from_c(kernel, params_json, addrs):
+            return 0
+
+        def shutdown_from_c():
+            {shutdown_body}
+            return 0
+    """))
+    return str(tmp_path / "stub")
+
+
+def _run_host(tmp_path, stub_root: str, timeout_s: int):
+    """A Python host that dlopens the shim, inits, and calls
+    tpu_shutdown explicitly (ctypes releases the GIL around the call,
+    so the shim takes the worker-thread flush path)."""
+    host = textwrap.dedent(f"""
+        import ctypes
+        lib = ctypes.CDLL({SHIM!r})
+        assert lib.tpu_init() == 0
+        lib.tpu_shutdown()
+        print("after-shutdown", flush=True)
+    """)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PYTHONPATH", None)  # the stub must win the import
+    env["TPU_KERNELS_ROOT"] = stub_root
+    env["TPU_KERNELS_FLUSH_TIMEOUT"] = str(timeout_s)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-c", host],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=str(tmp_path),
+    )
+    return proc, time.monotonic() - t0
+
+
+def test_wedged_flush_forces_exit(tmp_path):
+    """shutdown_from_c never returns: the watchdog must kill the host
+    with the distinctive status 86 (explicit shutdown, real exit code
+    unknown) well before the 60s harness timeout."""
+    stub = _write_stub(tmp_path, "import time; time.sleep(120)")
+    proc, elapsed = _run_host(tmp_path, stub, timeout_s=3)
+    assert proc.returncode == 86, proc.stdout + proc.stderr
+    assert "wedged" in proc.stderr
+    assert "after-shutdown" not in proc.stdout
+    assert elapsed < 30
+
+
+def test_healthy_flush_exits_normally(tmp_path):
+    """Control: a prompt flush must not trip the watchdog — the host
+    runs to completion with rc=0."""
+    stub = _write_stub(tmp_path, "pass")
+    proc, _ = _run_host(tmp_path, stub, timeout_s=3)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "after-shutdown" in proc.stdout
+    assert "wedged" not in proc.stderr
