@@ -484,6 +484,50 @@ def test_multiprocess_capi_mesh():
     """)
 
 
+def test_multiprocess_env_driven_join():
+    """The pod workflow exactly as docs/NEXT.md prescribes it: each
+    host exports the coordinator env vars and runs the C driver — no
+    code calls jax.distributed.initialize explicitly; the shim's
+    adapter path must join the job itself (mesh.maybe_distributed_init
+    via make_mesh/_mesh_size) BEFORE reading the topology. Covers the
+    allreduce adapter plus the TPK_BUSBW_SWEEP table, and proves the
+    join is idempotent across the driver's repeated calls."""
+    run_two_procs("""
+        import os, sys
+        pid = int(sys.argv[1])
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        os.environ["JAX_COORDINATOR_ADDRESS"] = "127.0.0.1:{port}"
+        os.environ["JAX_NUM_PROCESSES"] = "2"
+        os.environ["JAX_PROCESS_ID"] = str(pid)
+        os.environ["TPK_MESH"] = "8"
+        os.environ["TPK_BUSBW_SWEEP"] = "1"
+        os.environ["TPK_BUSBW_MIN"] = "1K"
+        os.environ["TPK_BUSBW_MAX"] = "4K"
+        os.environ["TPK_BUSBW_REPS"] = "2"
+        import json
+        import numpy as np
+        from tpukernels import capi
+
+        s = 256
+        rng = np.random.default_rng(13)  # same seed on both hosts
+        xs = np.ascontiguousarray(rng.standard_normal(s), np.float32)
+        out_buf = np.zeros(s, np.float32)
+        params = json.dumps(
+            {{"buffers": [{{"shape": [s], "dtype": "f32"}}] * 2}})
+        for _ in range(3):  # check + warm-up + timed rep
+            assert capi.run_from_c(
+                "allreduce", params,
+                [xs.ctypes.data, out_buf.ctypes.data]) == 0
+        np.testing.assert_allclose(out_buf, 8 * xs, rtol=1e-5)
+
+        import jax
+        assert jax.process_count() == 2, jax.process_count()
+        assert jax.device_count() == 8
+        print(f"proc {{pid}}: OK")
+    """)
+
+
 def test_capi_mesh_routing():
     """TPK_MESH>1 routes the C-shim adapters through the shard_map
     collective variants (SURVEY.md §5 config system) — the C driver's

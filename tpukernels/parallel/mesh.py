@@ -29,10 +29,22 @@ def maybe_distributed_init() -> None:
     """
     if jax.distributed.is_initialized():
         return
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
-    ):
-        jax.distributed.initialize()
+    )
+    if not addr:
+        return
+    # num_processes/process_id: jax reads JAX_COORDINATOR_ADDRESS
+    # itself but fills the other two only from cluster auto-detection
+    # (Slurm/OMPI/TPU-metadata). Pass them from the env explicitly so
+    # the mpirun-style contract — export 3 vars, run the same command
+    # per host — also works outside auto-detected clusters.
+    kw = {}
+    if "JAX_NUM_PROCESSES" in os.environ:
+        kw["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+    if "JAX_PROCESS_ID" in os.environ:
+        kw["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(coordinator_address=addr, **kw)
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "x") -> Mesh:
