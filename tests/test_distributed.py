@@ -605,6 +605,35 @@ def test_capi_mesh_routing():
     assert "OK" in out
 
 
+def test_busbw_env_knob_parsing(monkeypatch):
+    """sweep_from_env forwards exactly the TPK_BUSBW_* knobs (shared
+    by `python -m ...busbw` users and the C driver's TPK_BUSBW_SWEEP
+    path) — sizes accept the 1K/64M suffix forms."""
+    from tpukernels.parallel import busbw
+
+    captured = {}
+    monkeypatch.setattr(
+        busbw, "sweep", lambda mesh=None, **kw: captured.update(kw)
+    )
+    monkeypatch.setenv("TPK_BUSBW_MIN", "1K")
+    monkeypatch.setenv("TPK_BUSBW_MAX", "2M")
+    monkeypatch.setenv("TPK_BUSBW_REPS", "3")
+    monkeypatch.setenv("TPK_BUSBW_OP", "ppermute")
+    busbw.sweep_from_env()
+    assert captured == {
+        "min_bytes": 1024,
+        "max_bytes": 2 << 20,
+        "reps": 3,
+        "op": "ppermute",
+    }
+    captured.clear()
+    for var in ("TPK_BUSBW_MIN", "TPK_BUSBW_MAX", "TPK_BUSBW_REPS",
+                "TPK_BUSBW_OP"):
+        monkeypatch.delenv(var)
+    busbw.sweep_from_env()
+    assert captured == {}  # unset knobs: sweep defaults untouched
+
+
 def test_capi_busbw_sweep_env():
     """TPK_BUSBW_SWEEP=1 makes the allreduce adapter emit the swept
     bus-bandwidth table (the pod metric of record) exactly once per

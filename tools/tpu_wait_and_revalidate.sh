@@ -1,0 +1,28 @@
+#!/bin/bash
+# Poll the axon tunnel and run the revalidation queue the moment it
+# answers (companion to tools/tpu_revalidate.sh; see docs/NEXT.md).
+#   tools/tpu_wait_and_revalidate.sh [max_hours]   (default 10)
+# Probes every 5 minutes in a killable subprocess (a wedged tunnel
+# HANGS, it never errors). On the first healthy probe, runs
+# tpu_revalidate.sh and exits with its status; logs to stdout.
+set -o pipefail
+cd /root/repo
+
+max_hours="${1:-10}"
+deadline=$(( $(date +%s) + max_hours * 3600 ))
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  # the backend assert matters: with the tunnel down in a fail-FAST
+  # mode jax silently falls back to CPU, and a bare matmul probe
+  # would declare the dead tunnel ALIVE
+  if timeout 90 python -c \
+      "import jax; assert jax.default_backend() != 'cpu', jax.default_backend(); import jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready()" \
+      >/dev/null 2>&1; then
+    echo "tpu_wait: tunnel ALIVE at $(date -Is); starting revalidation"
+    exec bash tools/tpu_revalidate.sh
+  fi
+  echo "tpu_wait: tunnel still dead at $(date -Is); retry in 5m"
+  sleep 300
+done
+echo "tpu_wait: gave up after ${max_hours}h"
+exit 1
