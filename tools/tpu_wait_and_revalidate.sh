@@ -14,14 +14,20 @@ deadline=$(( $(date +%s) + max_hours * 3600 ))
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # the backend assert matters: with the tunnel down in a fail-FAST
   # mode jax silently falls back to CPU, and a bare matmul probe
-  # would declare the dead tunnel ALIVE
-  if timeout 90 python -c \
+  # would declare the dead tunnel ALIVE. -k: a wedged tunnel read can
+  # ignore SIGTERM — escalate to SIGKILL so the watcher itself can't
+  # hang on the exact failure it exists to survive.
+  probe_err=$(timeout -k 10 90 python -c \
       "import jax; assert jax.default_backend() != 'cpu', jax.default_backend(); import jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready()" \
-      >/dev/null 2>&1; then
+      2>&1 >/dev/null)
+  if [ $? -eq 0 ]; then
     echo "tpu_wait: tunnel ALIVE at $(date -Is); starting revalidation"
     exec bash tools/tpu_revalidate.sh
   fi
+  # keep the probe's own error visible: a broken probe (jax missing,
+  # snippet bug) must be distinguishable from a dead tunnel
   echo "tpu_wait: tunnel still dead at $(date -Is); retry in 5m"
+  [ -n "$probe_err" ] && printf '%s\n' "$probe_err" | tail -3
   sleep 300
 done
 echo "tpu_wait: gave up after ${max_hours}h"
