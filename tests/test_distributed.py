@@ -689,11 +689,13 @@ def test_capi_mesh_too_large_raises():
 
 def test_fuzz_dist_shapes():
     """Seeded shape-fuzz of every distributed variant across mesh
-    sizes 2/4/8 (the single-chip analog lives in test_fuzz_shapes.py):
-    divisible-but-awkward extents — one row per rank, prime multiples,
-    halo depths past the shard size — are where sharding/clamp logic
-    silently corrupts. One subprocess runs the whole deterministic
-    sweep."""
+    sizes 2/3/4/5/8 (the single-chip analog lives in
+    test_fuzz_shapes.py): divisible-but-awkward extents — one row per
+    rank, prime multiples, halo depths past the shard size — are where
+    sharding/clamp logic silently corrupts, and the odd/prime mesh
+    sizes catch any hidden power-of-2 assumption in the ring perms,
+    scan offsets or halo wrap. One subprocess runs the whole
+    deterministic sweep."""
     out = run_cpu8("""
         import numpy as np, jax.numpy as jnp
         from tpukernels.parallel import make_mesh
@@ -705,7 +707,7 @@ def test_fuzz_dist_shapes():
         from tpukernels.kernels.nbody import nbody_reference
         rng = np.random.default_rng(42)
 
-        for P_ in (2, 4, 8):
+        for P_ in (2, 3, 4, 5, 8):
             mesh = make_mesh(P_)
 
             for n in (P_, 37 * P_, 128 * P_ + P_):
