@@ -1,0 +1,52 @@
+"""CPU smoke of every headline bench function (bench.py).
+
+The metric functions normally run only on a live chip (bench.py exits
+early when the tunnel is dead), so Python-level bitrot in them — a
+renamed kernel, a signature drift — would otherwise surface for the
+first time during UNATTENDED revalidation (tools/tpu_wait_and_
+revalidate.sh fires bench.py the moment the tunnel answers).
+TPK_BENCH_SMOKE=1 collapses the slope repeat counts; tiny shapes keep
+interpret-mode Pallas fast. Values returned are meaningless and only
+checked for being positive numbers.
+"""
+
+import os
+import subprocess
+import sys
+
+from test_distributed import _scrubbed_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_functions_cpu_smoke():
+    body = """
+import os
+os.environ["TPK_BENCH_SMOKE"] = "1"
+import bench
+
+for fn, kw in [
+    (bench.bench_sgemm, {"m": 128}),
+    (bench.bench_stencil, {"n": 128}),
+    (bench.bench_stencil3d, {"n": 32}),
+    (bench.bench_saxpy, {"n": 1 << 12}),
+    (bench.bench_saxpy_stream, {"n": 1 << 12}),
+    (bench.bench_nbody, {"n": 256}),
+    (bench.bench_scan_hist, {"n": 1 << 12}),
+]:
+    v = fn(**kw)
+    assert isinstance(v, float) and v > 0, (fn.__name__, v)
+    print(f"smoke {fn.__name__}: ok")
+print("SMOKE-OK")
+"""
+    env = _scrubbed_env(fake_devices=None)  # CPU, never the tunnel
+    proc = subprocess.run(
+        [sys.executable, "-c", body],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "SMOKE-OK" in proc.stdout

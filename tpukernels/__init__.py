@@ -16,7 +16,8 @@ time, so component numbers C1–C12 refer to SURVEY.md §2's inventory):
 - ``tpukernels.registry`` — name -> jitted callable (the TPU column of
   the C dispatch table, C3)
 - ``tpukernels.capi``     — marshalling layer the C shim (C10) imports
-- ``tpukernels.utils``    — tiling / timing helpers (C12 analog)
+- ``tpukernels.utils``    — shape/tiling helpers (slope timing for the
+  metrics lives in ``bench.py``; C timers are C12)
 """
 
 __version__ = "0.1.0"
