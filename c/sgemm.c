@@ -39,31 +39,74 @@ static int sgemm_serial(const bench_params_t *p, void **bufs) {
     return 0;
 }
 
-#define TILE 64
+/* Register-blocked tiled GEMM: MR x NR accumulator tiles held in
+ * locals (vector registers once the j-loop vectorizes), K stripped at
+ * KC so the B strip stays cache-resident. The remainder path (any
+ * M/N/K) falls back to the plain axpy loop. */
+#define KC 256
+#define MR 4
+#define NR 64
+static void sgemm_omp_edge(long i0, long i1, long j0, long j1, long kk,
+                           long kend, long N, long K, float alpha,
+                           const float *A, const float *B, float *C) {
+    for (long i = i0; i < i1; i++) {
+        for (long k = kk; k < kend; k++) {
+            float a = alpha * A[i * K + k];
+#pragma omp simd
+            for (long j = j0; j < j1; j++)
+                C[i * N + j] += a * B[k * N + j];
+        }
+    }
+}
+
 static int sgemm_omp(const bench_params_t *p, void **bufs) {
     long M, N, K;
     dims(p, &M, &N, &K);
     const float *A = bufs[0], *B = bufs[1];
     float *C = bufs[2];
     const float alpha = (float)p->alpha, beta = (float)p->beta;
-#pragma omp parallel for collapse(2) schedule(static)
-    for (long ii = 0; ii < M; ii += TILE) {
-        for (long jj = 0; jj < N; jj += TILE) {
-            long iend = ii + TILE < M ? ii + TILE : M;
-            long jend = jj + TILE < N ? jj + TILE : N;
-            for (long i = ii; i < iend; i++)
-                for (long j = jj; j < jend; j++)
-                    C[i * N + j] *= beta;
-            for (long kk = 0; kk < K; kk += TILE) {
-                long kend = kk + TILE < K ? kk + TILE : K;
-                for (long i = ii; i < iend; i++) {
+    long Mr = M - M % MR, Nr = N - N % NR;
+#pragma omp parallel
+    {
+#pragma omp for schedule(static)
+        for (long i = 0; i < M; i++) {
+#pragma omp simd
+            for (long j = 0; j < N; j++) C[i * N + j] *= beta;
+        }
+        for (long kk = 0; kk < K; kk += KC) {
+            long kend = kk + KC < K ? kk + KC : K;
+#pragma omp for schedule(static) nowait
+            for (long ii = 0; ii < Mr; ii += MR) {
+                for (long jj = 0; jj < Nr; jj += NR) {
+                    float acc[MR][NR];
+                    for (int r = 0; r < MR; r++)
+#pragma omp simd
+                        for (int j = 0; j < NR; j++)
+                            acc[r][j] = C[(ii + r) * N + jj + j];
                     for (long k = kk; k < kend; k++) {
-                        float a = alpha * A[i * K + k];
-                        for (long j = jj; j < jend; j++)
-                            C[i * N + j] += a * B[k * N + j];
+                        const float *brow = &B[k * N + jj];
+                        for (int r = 0; r < MR; r++) {
+                            float a = alpha * A[(ii + r) * K + k];
+#pragma omp simd
+                            for (int j = 0; j < NR; j++)
+                                acc[r][j] += a * brow[j];
+                        }
                     }
+                    for (int r = 0; r < MR; r++)
+#pragma omp simd
+                        for (int j = 0; j < NR; j++)
+                            C[(ii + r) * N + jj + j] = acc[r][j];
                 }
+                /* N remainder for this row block */
+                if (Nr < N)
+                    sgemm_omp_edge(ii, ii + MR, Nr, N, kk, kend, N, K,
+                                   alpha, A, B, C);
             }
+            /* M remainder (single thread; at most MR-1 rows) */
+#pragma omp single
+            if (Mr < M)
+                sgemm_omp_edge(Mr, M, 0, N, kk, kend, N, K, alpha, A, B,
+                               C);
         }
     }
     return 0;

@@ -328,7 +328,11 @@ void tpu_shutdown(void) {
             }
         }
         pthread_mutex_lock(&g_wd.mu);
-        g_wd.done_gen = wd_gen;
+        /* Advance monotonically: two overlapping tpu_shutdown calls
+         * (explicit shutdown racing the on_exit handler) must never
+         * move done_gen backwards, or the newer attempt's watchdog
+         * would keep waiting and _exit a healthy process. */
+        if ((int)(g_wd.done_gen - wd_gen) < 0) g_wd.done_gen = wd_gen;
         pthread_cond_broadcast(&g_wd.cv);
         pthread_mutex_unlock(&g_wd.mu);
     }

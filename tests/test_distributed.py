@@ -57,10 +57,10 @@ def run_cpu8(body: str) -> str:
     return proc.stdout
 
 
-def run_two_procs(worker_body: str) -> None:
-    """Launch a 2-process jax.distributed job (4 fake CPU devices per
-    process, 8 global). `worker_body` is formatted with {port} and run
-    with the process id as argv[1]; each worker must print
+def run_procs(worker_body: str, nprocs: int = 2) -> None:
+    """Launch an nprocs-process jax.distributed job. `worker_body` is
+    formatted with {port} and run with the process id as argv[1] (the
+    worker sets its own fake-device count); each worker must print
     'proc <pid>: OK'."""
     import socket
 
@@ -78,7 +78,7 @@ def run_two_procs(worker_body: str) -> None:
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for i in range(2)
+        for i in range(nprocs)
     ]
     try:
         outs = [p.communicate(timeout=240)[0] for p in procs]
@@ -89,6 +89,12 @@ def run_two_procs(worker_body: str) -> None:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def run_two_procs(worker_body: str) -> None:
+    """2-process jax.distributed job (4 fake CPU devices per process,
+    8 global) — see run_procs."""
+    run_procs(worker_body, nprocs=2)
 
 
 def test_allreduce_sum_matches_mpi_semantics():
@@ -334,6 +340,63 @@ def test_multiprocess_allreduce():
             local, np.tile(full.sum(axis=0), (4, 1)), rtol=1e-5)
         print(f"proc {{pid}}: OK")
     """)
+
+
+def test_multiprocess_4x2_collectives():
+    """4 processes × 2 fake devices each (8 global): wider than the
+    2-process jobs everywhere else (VERDICT r2 item 2). Every ring
+    step now crosses a process boundary at 4 distinct host seams, and
+    the two-level scan's carry crosses 3 of them — shapes of failure
+    a 2-process job can't produce."""
+    run_procs("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        import jax
+        pid = int(sys.argv[1])
+        jax.distributed.initialize(
+            "127.0.0.1:{port}", num_processes=4, process_id=pid)
+        import numpy as np, jax.numpy as jnp
+        assert jax.device_count() == 8
+        assert jax.local_device_count() == 2
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.mesh import (
+            host_to_global, global_to_host, row_sharding)
+        from tpukernels.parallel.collectives import (
+            allreduce_sum, ring_shift, scan_dist, nbody_dist_ring)
+        from tpukernels.kernels.nbody import nbody_reference
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(21)  # same seed on all hosts
+        full = rng.standard_normal((8, 128)).astype(np.float32)
+        x = host_to_global(full, row_sharding(mesh))
+        out = global_to_host(allreduce_sum(x, mesh))
+        np.testing.assert_allclose(
+            out, np.tile(full.sum(axis=0), (8, 1)), rtol=1e-5)
+        np.testing.assert_array_equal(
+            global_to_host(ring_shift(x, mesh, shift=1)),
+            np.roll(full, 1, axis=0))
+        vals = rng.integers(-2**30, 2**30, 64 * 8).astype(np.int32)
+        sv = host_to_global(vals, row_sharding(mesh))
+        np.testing.assert_array_equal(
+            global_to_host(scan_dist(sv, mesh)),
+            np.cumsum(vals.astype(np.int64)).astype(np.int32))
+        # the ring N-body rotates j-blocks through all 4 processes
+        nb = 64
+        state_np = [rng.standard_normal(nb).astype(np.float32)
+                    for _ in range(6)]
+        m_np = rng.uniform(0.5, 1.5, nb).astype(np.float32)
+        sh = row_sharding(mesh)
+        state = tuple(host_to_global(a, sh) for a in state_np) + (
+            host_to_global(m_np, sh),)
+        ref = nbody_reference(
+            *(jnp.asarray(a) for a in state_np), jnp.asarray(m_np),
+            steps=2)
+        for got, want in zip(nbody_dist_ring(state, 2, mesh), ref):
+            np.testing.assert_allclose(
+                global_to_host(got), np.asarray(want),
+                rtol=5e-4, atol=5e-5)
+        print(f"proc {{pid}}: OK")
+    """, nprocs=4)
 
 
 def test_multiprocess_small_collectives():

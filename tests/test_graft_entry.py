@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENTRY = os.path.join(REPO, "__graft_entry__.py")
 
@@ -48,6 +50,57 @@ def test_dryrun_multichip_survives_wedged_axon_env():
     assert "dryrun_multichip(8): OK" in proc.stdout
     # progress lines: one per program, so the driver sees liveness
     assert proc.stdout.count("[dryrun +") >= 8
+
+
+@pytest.mark.parametrize("n", [16, 64])
+def test_dryrun_multichip_wide_mesh(n):
+    """The contract sweeps 8→64 chips (SURVEY.md §3(d)); the ring
+    perms, two-level scan offsets and halo wraps must hold on meshes
+    wider than the 8 every other test uses — one cheap smoke per
+    program via the same dryrun the driver runs. n=64 is the
+    envelope's far edge (~100 s on CPU fake devices, mostly XLA
+    compiles of 64-way collectives)."""
+    # inner bound < outer bound: TPK_DRYRUN_TIMEOUT must fire first so
+    # a slow run dies attributably (and reaps its dryrun-inner child)
+    # instead of subprocess.run orphaning the grandchild
+    proc = subprocess.run(
+        [sys.executable, ENTRY, "dryrun", str(n)],
+        env=_driver_like_env(TPK_DRYRUN_TIMEOUT="360"),
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert f"dryrun_multichip({n}): OK" in proc.stdout
+    assert proc.stdout.count("[dryrun +") >= 8
+
+
+def test_dryrun_multichip_timeout_names_last_progress():
+    """A genuinely stuck inner run must not hang the driver:
+    TPK_DRYRUN_TIMEOUT bounds it, and the error names the last
+    program that printed progress so the stall is attributable
+    (ADVICE r2)."""
+    body = (
+        "import __graft_entry__ as g\n"
+        "try:\n"
+        "    g.dryrun_multichip(8)\n"
+        "except RuntimeError as e:\n"
+        "    print('GOT:', e)\n"
+        "else:\n"
+        "    raise SystemExit('expected a timeout RuntimeError')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", body],
+        env=_driver_like_env(PYTHONPATH=REPO, TPK_DRYRUN_TIMEOUT="1"),
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "timed out after 1s" in proc.stdout
+    assert "last progress:" in proc.stdout
 
 
 def test_dryrun_multichip_overrides_preexisting_device_count():

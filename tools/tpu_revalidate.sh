@@ -2,8 +2,10 @@
 # Post-recovery TPU validation queue (run from /root/repo).
 # Use after the axon tunnel has been down or wedged: re-proves the
 # compiled path end to end, then re-measures every headline metric.
-set -x -o pipefail
-cd /root/repo
+# -e: this is a gate — a failed suite, gate row, or sanitizer abort
+# must fail the whole queue, not fall through to the next step.
+set -e -x -o pipefail
+cd "$(dirname "$0")/.."
 
 # 1. Compiled-path test suite (axon backend, kernels compile on chip).
 # TPK_REQUIRE_TPU=1: a still-wedged tunnel must FAIL here, not slip
@@ -22,6 +24,16 @@ timeout 3000 python bench.py
 #     record this Melem/s in docs/PERF.md next to the kernel-level
 #     number.
 (cd c && timeout 600 ./bin/scan_histogram --device=tpu --n=4194304 --check)
+
+# 3c. Sanitizer gate (SURVEY.md §5): ASan rebuild, full gate incl.
+#     the embedded-CPython shim rows on a scrubbed CPU env (kernels
+#     auto-interpret there), then restore the normal build. First
+#     recorded PASS: docs/logs/asan_gate_2026-07-30.log.
+make -C c asan
+(cd c && timeout 1800 env ASAN_OPTIONS=detect_leaks=0 \
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_TEST_TPU=1 \
+    ./run_all.sh | tail -3)
+make -C c -s clean && make -C c -s
 
 # 4. Knob sanity: histogram impls agree, sgemm precisions hold their
 #    error contracts (exercised by tests above; these are quick

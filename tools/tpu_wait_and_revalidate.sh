@@ -6,7 +6,7 @@
 # HANGS, it never errors). On the first healthy probe, runs
 # tpu_revalidate.sh and exits with its status; logs to stdout.
 set -o pipefail
-cd /root/repo
+cd "$(dirname "$0")/.."
 
 max_hours="${1:-10}"
 deadline=$(( $(date +%s) + max_hours * 3600 ))
