@@ -39,6 +39,7 @@ fi
 run vector_add --n=100000
 run sgemm --n=256
 run sgemm --m=64 --n=192 --k=320   # rectangular + off-tile extents
+run sgemm --m=61 --n=67 --k=129    # odd extents: every remainder path
 run stencil --n=256 --iters=10
 run stencil --n=128 --m=320 --iters=5   # rectangular H x W
 run stencil --n=64 --z=64 --iters=5

@@ -25,14 +25,16 @@ timeout 3000 python bench.py
 #     number.
 (cd c && timeout 600 ./bin/scan_histogram --device=tpu --n=4194304 --check)
 
-# 3c. Sanitizer gate (SURVEY.md §5): ASan rebuild, full gate incl.
-#     the embedded-CPython shim rows on a scrubbed CPU env (kernels
-#     auto-interpret there), then restore the normal build. First
-#     recorded PASS: docs/logs/asan_gate_2026-07-30.log.
-make -C c asan
-(cd c && timeout 1800 env ASAN_OPTIONS=detect_leaks=0 \
-    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_TEST_TPU=1 \
-    ./run_all.sh | tail -3)
+# 3c. Sanitizer gates (SURVEY.md §5): ASan then UBSan rebuilds, full
+#     gate incl. the embedded-CPython shim rows on a scrubbed CPU env
+#     (kernels auto-interpret there), then restore the normal build.
+#     First recorded PASS logs: docs/logs/{asan,ubsan}_gate_2026-07-30.log.
+for san in asan ubsan; do
+  make -C c "$san"
+  (cd c && timeout 1800 env ASAN_OPTIONS=detect_leaks=0 \
+      PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_TEST_TPU=1 \
+      ./run_all.sh | tail -3)
+done
 make -C c -s clean && make -C c -s
 
 # 4. Knob sanity: histogram impls agree, sgemm precisions hold their
