@@ -107,6 +107,15 @@ def test_unknown_kernel_raises():
         capi.run_from_c("not_a_kernel", params, [])
 
 
+def test_unknown_dtype_raises():
+    # the ABI carries exactly the dtypes the C drivers emit (f32/i32);
+    # anything else must fail loudly naming the supported set
+    x = np.zeros(8, dtype=np.float64)
+    params = json.dumps({"buffers": [{"shape": [8], "dtype": "f64"}] * 2})
+    with pytest.raises(ValueError, match="unsupported buffer dtype"):
+        capi.run_from_c("vector_add", params, [_addr(x), _addr(x)])
+
+
 def test_profiler_trace_flushes_on_exit(tmp_path):
     """TPU_KERNELS_PROFILE traces only reach disk on stop_trace; a
     Python host flushes via the capi atexit hook; C hosts go through

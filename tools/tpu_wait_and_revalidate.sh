@@ -8,6 +8,19 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
+# single instance: two watchers (e.g. one left over from a previous
+# session) would both fire the revalidation queue on recovery and
+# interleave timed runs on the one chip. The lock dies with the
+# process; it is inherited by the exec'd revalidation, which keeps
+# the exclusion through the whole queue. Fixed path on purpose — a
+# TMPDIR-dependent one would let watchers from different sessions
+# miss each other.
+exec 9>/tmp/tpk_tpu_wait.lock
+if ! flock -n 9; then
+  echo "tpu_wait: another watcher already holds the lock; exiting"
+  exit 0
+fi
+
 max_hours="${1:-10}"
 deadline=$(( $(date +%s) + max_hours * 3600 ))
 

@@ -127,7 +127,13 @@ def _to_host(o) -> np.ndarray:
 
 
 def _wrap(addr: int, spec: dict) -> np.ndarray:
-    dt = np.dtype(_DTYPES[spec["dtype"]])
+    dtype_name = spec.get("dtype")
+    if dtype_name not in _DTYPES:
+        raise ValueError(
+            f"unsupported buffer dtype {dtype_name!r}; the C ABI "
+            f"carries {sorted(_DTYPES)}"
+        )
+    dt = np.dtype(_DTYPES[dtype_name])
     shape = tuple(spec["shape"])
     nbytes = dt.itemsize * math.prod(shape)
     raw = (ctypes.c_char * nbytes).from_address(addr)
