@@ -33,7 +33,7 @@ for san in asan ubsan; do
   make -C c "$san"
   (cd c && timeout 1800 env ASAN_OPTIONS=detect_leaks=0 \
       PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_TEST_TPU=1 \
-      ./run_all.sh | tail -3)
+      TPK_TEST_MESH=8 ./run_all.sh | tail -3)
 done
 make -C c -s clean && make -C c -s
 
