@@ -1,0 +1,57 @@
+#!/bin/bash
+# Randomized-size C acceptance fuzz: every driver at random extents,
+# the omp variant checked against the built-in serial oracle — the
+# C-side analog of tests/test_fuzz_shapes.py, aimed at the remainder/
+# edge paths fixed-size gate rows can't reach (off-tile M/N/K, tiny
+# grids, odd bin counts).
+#
+#   tools/fuzz_c.sh [rounds]     # default 10 (~1 min)
+#
+# Reproducible: TPK_FUZZ_SEED seeds bash's RANDOM (default 42); a
+# failure line prints the exact driver command to replay.
+set -o pipefail
+cd "$(dirname "$0")/../c"
+
+rounds="${1:-10}"
+if ! [ "$rounds" -ge 1 ] 2>/dev/null; then
+  echo "fuzz_c: rounds must be >= 1 (got '${rounds}')" >&2
+  exit 2
+fi
+if [ ! -x ./bin/vector_add ]; then
+  echo "fuzz_c: drivers not built - run 'make -C c' first" >&2
+  exit 2
+fi
+RANDOM=$((${TPK_FUZZ_SEED:-42}))
+
+# bash RANDOM is 15-bit (max 32767); compose two draws so ranges past
+# 32768 (vector_add, scan_histogram) are actually reachable
+rnd() { echo $(( ((RANDOM << 15) | RANDOM) % $1 + 1 )); }
+
+fail=0
+run_check() {
+  if ! "$@" --device=omp --check --reps=1 >/dev/null 2>&1; then
+    echo "FUZZ FAIL: $* --device=omp --check"
+    fail=1
+  fi
+}
+
+for _ in $(seq 1 "$rounds"); do
+  run_check ./bin/vector_add --n=$(rnd 200000)
+  run_check ./bin/sgemm --m=$(rnd 317) \
+      --n=$(rnd 317) --k=$(rnd 413)
+  run_check ./bin/stencil --n=$(($(rnd 200) + 2)) \
+      --m=$(($(rnd 200) + 2)) --iters=$(rnd 8)
+  run_check ./bin/stencil --n=$(($(rnd 40) + 2)) \
+      --m=$(($(rnd 60) + 2)) --z=$(($(rnd 40) + 2)) \
+      --iters=$(rnd 5)
+  run_check ./bin/scan_histogram --n=$(rnd 100000) \
+      --nbins=$(rnd 300)
+  run_check ./bin/nbody --n=$(rnd 400) \
+      --iters=$(rnd 3)
+done
+
+if [ "$fail" = "1" ]; then
+  echo "FUZZ: FAIL"
+  exit 1
+fi
+echo "FUZZ: PASS ($rounds rounds x 6 drivers, seed ${TPK_FUZZ_SEED:-42})"
