@@ -399,6 +399,46 @@ def test_multiprocess_4x2_collectives():
     """, nprocs=4)
 
 
+def test_multiprocess_8x1_collectives():
+    """8 processes × 1 device each — the fully-distributed extreme
+    where EVERY ring hop and every scan-carry crossing is a process
+    boundary (a pod of single-chip hosts). Complements 2×4 (mostly
+    local) and 4×2 (mixed)."""
+    run_procs("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+        pid = int(sys.argv[1])
+        jax.distributed.initialize(
+            "127.0.0.1:{port}", num_processes=8, process_id=pid)
+        import numpy as np
+        assert jax.device_count() == 8
+        assert jax.local_device_count() == 1
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.mesh import (
+            host_to_global, global_to_host, row_sharding)
+        from tpukernels.parallel.collectives import (
+            allreduce_sum, ring_shift, scan_dist)
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(33)  # same seed on all hosts
+        full = rng.standard_normal((8, 64)).astype(np.float32)
+        x = host_to_global(full, row_sharding(mesh))
+        np.testing.assert_allclose(
+            global_to_host(allreduce_sum(x, mesh)),
+            np.tile(full.sum(axis=0), (8, 1)), rtol=1e-5)
+        np.testing.assert_array_equal(
+            global_to_host(ring_shift(x, mesh)),
+            np.roll(full, 1, axis=0))
+        vals = rng.integers(-2**30, 2**30, 16 * 8).astype(np.int32)
+        sv = host_to_global(vals, row_sharding(mesh))
+        np.testing.assert_array_equal(
+            global_to_host(scan_dist(sv, mesh)),
+            np.cumsum(vals.astype(np.int64)).astype(np.int32))
+        print(f"proc {{pid}}: OK")
+    """, nprocs=8)
+
+
 def test_multiprocess_small_collectives():
     """bcast, ring_shift and the stencil residual under real
     2-process jax.distributed — the masked-psum, ppermute and
