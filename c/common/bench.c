@@ -79,6 +79,16 @@ void bench_parse_args(bench_params_t *p, int argc, char **argv,
             exit(2);
         }
     }
+    bench_require_pos(p->reps, "--reps");
+    /* no driver treats n==0 as a sentinel (unlike m/k/z) */
+    bench_require_pos(p->n, "--n");
+}
+
+void bench_require_pos(long v, const char *what) {
+    if (v < 1) {
+        fprintf(stderr, "%s must be >= 1 (got %ld)\n", what, v);
+        exit(2);
+    }
 }
 
 double bench_now_sec(void) {

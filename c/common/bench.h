@@ -37,9 +37,16 @@ void bench_params_default(bench_params_t *p);
 
 /* Parse the common flags (--device=, --n=, --m=, --k=, --z=, --iters=,
  * --reps=, --check, --alpha=, --beta=, --nbins=, --dt=, --seed=,
- * --verbose). Unknown flags abort with usage. */
+ * --verbose). Unknown flags abort with usage. Always enforces
+ * reps >= 1 and n >= 1 (a 0-rep timing loop reports garbage; no
+ * driver treats n==0 as a sentinel). */
 void bench_parse_args(bench_params_t *p, int argc, char **argv,
                       const char *kernel_name);
+
+/* Exit(2) with a clear message unless v >= 1 — drivers call this on
+ * the extents whose zero/negative forms would otherwise SIGFPE
+ * (histogram bound) or print a garbage metric. */
+void bench_require_pos(long v, const char *what);
 
 /* ---- timing (C12): monotonic wall clock ---- */
 double bench_now_sec(void);

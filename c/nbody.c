@@ -116,6 +116,7 @@ int main(int argc, char **argv) {
     p.n = 65536;
     p.iters = 10;
     bench_parse_args(&p, argc, argv, "nbody");
+    bench_require_pos(p.iters, "--iters");
 
     tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "nbody");
     if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();

@@ -99,6 +99,9 @@ int main(int argc, char **argv) {
     bench_params_default(&p);
     bench_parse_args(&p, argc, argv, "scan_histogram");
 
+    /* before dispatch: a bad flag must never spin up the TPU runtime */
+    bench_require_pos(p.nbins, "--nbins"); /* 0 would SIGFPE the fill */
+
     tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "scan_histogram");
     if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();
 

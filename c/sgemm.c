@@ -158,6 +158,12 @@ int main(int argc, char **argv) {
     p.n = 1024;
     bench_parse_args(&p, argc, argv, "sgemm");
 
+    /* 0 means "default to n" for --m/--k; negatives are typos, not
+     * sentinels. Validate BEFORE dispatch so a bad flag never spins
+     * up the TPU runtime. */
+    if (p.m != 0) bench_require_pos(p.m, "--m");
+    if (p.k != 0) bench_require_pos(p.k, "--k");
+
     tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "sgemm");
     if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();
 

@@ -172,6 +172,9 @@ int main(int argc, char **argv) {
     p.n = 4096;
     p.iters = 1000;
     bench_parse_args(&p, argc, argv, "stencil");
+    if (p.m != 0) bench_require_pos(p.m, "--m"); /* 0 = "use n" */
+    if (p.z != 0) bench_require_pos(p.z, "--z"); /* 0 = 2D sentinel */
+    bench_require_pos(p.iters, "--iters");
 
     tpk_kern_fn fn = tpk_dispatch_lookup(TABLE, p.device, "stencil");
     if (strcmp(p.device, "tpu") == 0) tpk_tpu_ensure();
