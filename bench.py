@@ -315,6 +315,21 @@ def _tpu_alive(timeout_s=180, attempts=6, retry_wait_s=120):
     return False
 
 
+# The full metric surface, single source of truth: main() runs it and
+# tests assert BASELINE.json's "measured" block covers it — a new
+# bench_* added here without a measured median fails the suite instead
+# of silently escaping the regression gate.
+BENCH_METRICS = (
+    ("sgemm_gflops", bench_sgemm),
+    ("stencil2d_mcells_s", bench_stencil),
+    ("stencil3d_mcells_s", bench_stencil3d),
+    ("nbody_ginter_s", bench_nbody),
+    ("scan_hist_melem_s", bench_scan_hist),
+    ("saxpy_gb_s", bench_saxpy),
+    ("saxpy_stream_gb_s", bench_saxpy_stream),
+)
+
+
 def main():
     results = {}
     if not _tpu_alive():
@@ -330,15 +345,7 @@ def main():
             )
         )
         return
-    for name, fn in [
-        ("sgemm_gflops", bench_sgemm),
-        ("stencil2d_mcells_s", bench_stencil),
-        ("stencil3d_mcells_s", bench_stencil3d),
-        ("nbody_ginter_s", bench_nbody),
-        ("scan_hist_melem_s", bench_scan_hist),
-        ("saxpy_gb_s", bench_saxpy),
-        ("saxpy_stream_gb_s", bench_saxpy_stream),
-    ]:
+    for name, fn in BENCH_METRICS:
         try:
             results[name] = round(_with_timeout(fn), 2)
             print(f"# {name}: {results[name]}", file=sys.stderr)
@@ -349,15 +356,8 @@ def main():
             sys.stderr.flush()
 
     headline = results.get("sgemm_gflops")
-    try:
-        with open(
-            __file__.replace("bench.py", "BASELINE.json"), "r"
-        ) as f:
-            published = json.load(f).get("published", {})
-    except Exception:
-        published = {}
-    base = published.get("sgemm_gflops")
-    vs = round(headline / base, 3) if (headline and base) else 1.0
+    ratios = _ratios_vs_baseline(results, _load_baseline())
+    vs = ratios.get("sgemm_gflops")
 
     print(
         json.dumps(
@@ -365,12 +365,81 @@ def main():
                 "metric": "sgemm_gflops_per_chip",
                 "value": headline,
                 "unit": "GFLOPS",
-                "vs_baseline": vs,
+                "vs_baseline": vs if vs is not None else 1.0,
                 "details": results,
+                "vs_measured": ratios,
             }
         )
     )
 
 
+def _ratios_vs_baseline(results: dict, baseline: dict) -> dict:
+    """Per-metric measured/baseline ratios for the vs_measured block.
+
+    Per-metric precedence: a reference-published number (none exist
+    today — BASELINE.json "published" is {}) overrides this repo's
+    measured-on-chip median for THAT metric only, so one published
+    entry can't silently strip the regression gate from every other
+    metric. `is not None`, not truthiness, on the result: a metric
+    that measured 0.0 must enter the table (as ratio 0.0) so
+    check_regression flags it instead of it vanishing from the gate.
+    """
+    base_tbl = {
+        **(baseline.get("measured") or {}),
+        **(baseline.get("published") or {}),
+    }
+    return {
+        name: round(results[name] / base_tbl[name], 3)
+        for name in results
+        if results.get(name) is not None
+        and isinstance(base_tbl.get(name), (int, float))
+        and not isinstance(base_tbl.get(name), bool)
+        and base_tbl.get(name)
+    }
+
+
+def _load_baseline() -> dict:
+    try:
+        with open(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "BASELINE.json"
+            )
+        ) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def check_regression(json_line: str, tolerance: float = 0.15) -> int:
+    """Gate helper for tools/tpu_revalidate.sh: given bench.py's JSON
+    output line, fail (return 1) if any metric dropped more than
+    `tolerance` below the BASELINE.json "measured" medians, or if the
+    headline is null. Metrics the baseline lacks pass through."""
+    rec = json.loads(json_line)
+    if rec.get("value") is None:
+        print("REGRESSION: headline value is null (bench did not run)")
+        return 1
+    bad = []
+    for name, ratio in (rec.get("vs_measured") or {}).items():
+        if ratio < 1.0 - tolerance:
+            bad.append(f"{name}: {ratio:.3f}x of measured baseline")
+    failed = [
+        name for name, v in (rec.get("details") or {}).items() if v is None
+    ]
+    for name in failed:
+        bad.append(f"{name}: FAILED (no value)")
+    if bad:
+        print("REGRESSION vs BASELINE.json measured (tolerance "
+              f"{tolerance:.0%}):")
+        for b in bad:
+            print("  " + b)
+        return 1
+    print(f"regression check OK: {rec.get('vs_measured')}")
+    return 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-regression":
+        # stdin: the JSON line a prior `python bench.py` run printed
+        sys.exit(check_regression(sys.stdin.read().strip()))
     main()

@@ -46,9 +46,14 @@ static void step_host(long n, long steps, float dt, float **b, int omp) {
             }
         } else {
             /* f32 force loop with simd reduction: the double path
-             * above can't vectorize (convert+divide per lane); f32
-             * random-walk error over n partials is ~sqrt(n)*2^-24,
-             * far inside the driver's 2e-3 rtol at n=65536 */
+             * above can't vectorize (convert+divide per lane). The
+             * f32 random-walk bound (~sqrt(n)*2^-24 over n partials)
+             * is relative to the sum of |term| magnitudes, NOT the
+             * net force — with near-cancelling forces the relative
+             * error of the result is unbounded, so correctness rests
+             * on the checker's 2e-4 atol (absolute slack sized to the
+             * typical |term| scale) plus the fuzz sweep's coverage of
+             * random configurations, not on the rtol alone */
 #pragma omp parallel for schedule(static)
             for (long i = 0; i < n; i++) {
                 float xi = px[i], yi = py[i], zi = pz[i];

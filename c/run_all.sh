@@ -70,6 +70,22 @@ if [ -n "${TPK_TEST_MESH:-}" ] && [ "${TPK_TEST_MESH}" != "0" ]; then
   done
   # both N-body formulations (default row above is psum)
   run_row "$mesh_env TPK_NBODY_DIST=ring" nbody tpu --n=1024 --iters=2
+  # the stencil loop's periodic residual MPI_Allreduce analog
+  # (SURVEY.md §3(b)): the full C -> shim -> residual-psum path must
+  # pass the golden check AND report the global norm on stderr
+  for res_args in "--n=128 --iters=5" "--n=64 --z=64 --iters=5"; do
+    echo "== $mesh_env TPK_STENCIL_RESIDUAL=1 bin/stencil --device=tpu $res_args"
+    # shellcheck disable=SC2086
+    res_err=$(env $mesh_env TPK_STENCIL_RESIDUAL=1 \
+        bin/stencil --device=tpu --check --reps=1 $res_args 2>&1 >/dev/null) \
+      || { echo "FAILED: residual stencil row $res_args"; fail=1; }
+    case "$res_err" in
+      *"residual ||x_k+1 - x_k||^2 ="*) ;;
+      *) echo "FAILED: residual line missing on stderr ($res_args)"
+         printf '%s\n' "$res_err"
+         fail=1 ;;
+    esac
+  done
   # the shim-side bus-bw sweep (SURVEY.md §3(d)): the C binary itself
   # must be able to emit the metric-of-record table
   run_row "$mesh_env TPK_BUSBW_SWEEP=1 TPK_BUSBW_MIN=1K TPK_BUSBW_MAX=16K TPK_BUSBW_REPS=2" \

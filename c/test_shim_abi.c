@@ -95,6 +95,38 @@ int main(void) {
         if (hist[b] != NS / NB) bad++;
     CHECK(bad == 0, "i32 scan + histogram values exact");
 
+    /* 6b. f32 scan round-trip (SURVEY.md §4 item 4): the ABI's other
+     * dtype lane. The benchmark drivers only ever send i32 to scan;
+     * _DTYPES (tpukernels/capi.py) also carries f32 — prove the
+     * full C -> shim -> kernel f32 path, with the float tolerance a
+     * blocked f32 prefix sum needs: |err_i| <= sqrt(n)*eps*sum|x| +
+     * atol (the random-walk rounding bound; the kernel's matmul
+     * formulation re-associates, so exact equality is not the
+     * contract -- see tpukernels/kernels/scan.py). */
+    enum { NF = 4096 };
+    static float xf[NF], scanf_out[NF];
+    for (int i = 0; i < NF; i++) {
+        xf[i] = 0.5f * sinf((float)i * 0.7f);
+        scanf_out[i] = 0.0f;
+    }
+    void *bufs_f[2] = {xf, scanf_out};
+    snprintf(json, sizeof(json),
+             "{\"buffers\":[{\"shape\":[%d],\"dtype\":\"f32\"},"
+             "{\"shape\":[%d],\"dtype\":\"f32\"}]}",
+             NF, NF);
+    rc = tpk_tpu_run("scan", json, bufs_f, 2);
+    CHECK(rc == 0, "scan (f32) returns 0");
+    bad = 0;
+    double acc = 0.0, sum_abs = 0.0;
+    const double tol_scale = sqrt((double)NF) * 1.1920929e-7; /* eps_f32 */
+    for (int i = 0; i < NF; i++) {
+        acc += (double)xf[i];
+        sum_abs += fabs((double)xf[i]);
+        if (fabs((double)scanf_out[i] - acc) > tol_scale * sum_abs + 1e-6)
+            bad++;
+    }
+    CHECK(bad == 0, "f32 scan values within sqrt(n)*eps bound");
+
     /* 7. explicit tpu_shutdown is safe, idempotent, and does not
      * break later calls (the interpreter stays alive; only the
      * teardown flush runs, once) */

@@ -50,3 +50,68 @@ def test_slope_cancels_fixed_cost():
 
     est = bench._slope(make_fn, 2, 10, samples=3)
     assert est == pytest.approx(per_iter, rel=0.3)
+
+
+def test_check_regression_gates_on_measured_baseline():
+    """VERDICT r3 item 3: vs_baseline must be a real ratio against the
+    BASELINE.json "measured" medians, and the revalidation queue must
+    fail loudly on >15% drops. check_regression is that gate."""
+    import json
+
+    ok = json.dumps({
+        "value": 60000,
+        "vs_measured": {"sgemm_gflops": 0.99, "saxpy_gb_s": 1.02},
+        "details": {"sgemm_gflops": 60000, "saxpy_gb_s": 9300},
+    })
+    assert bench.check_regression(ok) == 0
+
+    slow = json.dumps({
+        "value": 48000,
+        "vs_measured": {"sgemm_gflops": 0.79},
+        "details": {"sgemm_gflops": 48000},
+    })
+    assert bench.check_regression(slow) == 1
+    # inside tolerance passes
+    assert bench.check_regression(slow, tolerance=0.25) == 0
+
+    nulled = json.dumps({"value": None, "vs_measured": {}, "details": {}})
+    assert bench.check_regression(nulled) == 1
+
+    # a metric that errored out (details value None) must fail even if
+    # every surviving ratio is healthy
+    partial = json.dumps({
+        "value": 60000,
+        "vs_measured": {"sgemm_gflops": 1.0},
+        "details": {"sgemm_gflops": 60000, "nbody_ginter_s": None},
+    })
+    assert bench.check_regression(partial) == 1
+
+
+def test_baseline_measured_block_covers_all_bench_metrics():
+    """Every metric bench.py reports must have a measured median to
+    regress against — a new bench_* without a BASELINE.json row would
+    silently escape the gate. Iterates bench.BENCH_METRICS itself (the
+    list main() runs) so adding a metric there without a baseline row
+    fails here."""
+    measured = bench._load_baseline().get("measured", {})
+    assert len(bench.BENCH_METRICS) >= 7
+    for name, _fn in bench.BENCH_METRICS:
+        assert isinstance(measured.get(name), (int, float)), name
+
+
+def test_ratios_vs_baseline_merge_and_zero():
+    """Per-metric published-over-measured precedence (one published
+    entry must not strip other metrics' gates) and the measured-0.0
+    case (must surface as ratio 0.0, not vanish)."""
+    baseline = {
+        "measured": {"a": 100.0, "b": 50.0, "c": 10.0},
+        "published": {"a": 200.0},
+    }
+    results = {"a": 100.0, "b": 0.0, "c": None, "d": 5.0}
+    r = bench._ratios_vs_baseline(results, baseline)
+    assert r == {"a": 0.5, "b": 0.0}  # a vs published, b vs measured
+    # and check_regression flags the 0.0 ratio
+    import json
+    line = json.dumps({"value": 100.0, "vs_measured": r,
+                       "details": {"a": 100.0, "b": 0.0}})
+    assert bench.check_regression(line) == 1

@@ -305,6 +305,50 @@ def test_nbody_dist_matches_single_device(variant):
     assert "OK" in out
 
 
+def test_nbody_ring_skip_last_bitwise_identical():
+    """TPK_NBODY_RING_SKIP_LAST=1 peels the ring's final pass so the
+    last (result-unused) ppermute is never emitted — 1/P of ring comm
+    volume (docs/NEXT.md item 5, pre-staged for a pod A/B). The accel
+    accumulation order is unchanged, so trajectories must be BITWISE
+    identical to the default formulation."""
+    out = run_cpu8("""
+        import os
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import nbody_dist_ring
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(7)
+        n = 512
+        state = tuple(jnp.asarray(rng.standard_normal(n), jnp.float32)
+                      for _ in range(6)) + (
+            jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32),)
+        base = nbody_dist_ring(state, 3, mesh)
+        os.environ["TPK_NBODY_RING_SKIP_LAST"] = "1"
+        skip = nbody_dist_ring(state, 3, mesh)
+        del os.environ["TPK_NBODY_RING_SKIP_LAST"]
+        for got, want in zip(skip, base):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # the knob must actually remove ring hops. The ppermutes sit
+        # inside the ring fori_loop's BODY, so their static op count is
+        # identical either way — what the peel changes is the loop's
+        # trip count: nranks passes default, nranks-1 skipped. Read it
+        # from the jaxpr's scan lengths (fori_loop with static bounds
+        # lowers to scan).
+        import re
+        from tpukernels.parallel.collectives import _nbody_ring_build
+        lens = []
+        for flag in (False, True):
+            fn = _nbody_ring_build(3, mesh, "x", 1e-3, 1e-2, flag)
+            jaxpr = str(jax.make_jaxpr(fn)(*state))
+            lens.append({int(m) for m in re.findall(r"length=(\\d+)", jaxpr)})
+        n_def, n_skip = lens
+        assert 8 in n_def and 7 not in n_def, n_def
+        assert 7 in n_skip and 8 not in n_skip, n_skip
+        print('OK')
+    """)
+    assert "OK" in out
+
+
 def test_multiprocess_allreduce():
     """Real jax.distributed across 2 processes (4 fake CPU devices
     each, 8 global): the multi-host path the 8→64-chip bus-bw run
@@ -895,6 +939,50 @@ def test_busbw_sweep_runs():
         # accounting formula spot-checks
         assert abs(bus_bandwidth(1.0, 1e9, 8) - 2*7/8) < 1e-9
         assert abs(bus_bandwidth(1.0, 1e9, 1) - 1.0) < 1e-9
+        print('OK')
+    """)
+    assert "OK" in out
+
+
+def test_busbw_collective_not_narrowed():
+    """The sweep's metric-of-record program must move the FULL message
+    through the collective. Lower the exact timed program
+    (busbw.timed_program) through XLA's optimization pipeline and
+    assert the all-reduce / collective-permute operand in the
+    optimized HLO carries every element — if a future probe (or a
+    future XLA) narrows the collective to the live slice of a partial
+    probe, this fails."""
+    out = run_cpu8("""
+        import re
+        import numpy as np
+        import jax
+        from tpukernels.parallel.busbw import timed_program
+        from tpukernels.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        nranks = mesh.shape["x"]
+        assert nranks == 8
+        elems = 2048  # 8 KiB message per rank row
+        x = np.ones((nranks, elems), np.float32)
+
+        for op, hlo_op in (("allreduce", "all-reduce"),
+                           ("ppermute", "collective-permute")):
+            fn = timed_program(op, mesh)
+            hlo = fn.lower(x).compile().as_text()
+            # optimized HLO is SPMD-partitioned: the per-shard operand
+            # is (1, elems); collect every <op>(...) result shape
+            pat = "f32\\\\[([0-9,]+)\\\\][^=\\\\n]*? " + hlo_op + "\\\\("
+            shapes = [
+                tuple(int(d) for d in m.group(1).split(","))
+                for m in re.finditer(pat, hlo)
+            ]
+            assert shapes, f"no {hlo_op} op in optimized HLO for {op}"
+            full = max(int(np.prod(s)) for s in shapes)
+            assert full >= elems, (
+                f"{op}: collective narrowed to {shapes} "
+                f"(expected >= {elems} elements)"
+            )
+            print(op, "shapes", shapes)
         print('OK')
     """)
     assert "OK" in out

@@ -29,10 +29,15 @@ rnd() { echo $(( ((RANDOM << 15) | RANDOM) % $1 + 1 )); }
 
 fail=0
 run_check() {
-  if ! "$@" --device=omp --check --reps=1 >/dev/null 2>&1; then
+  # keep the failing driver's own diagnostics (mismatch indices, max
+  # err) — a replay command alone forces a second reproduce-run
+  out=$(mktemp)
+  if ! "$@" --device=omp --check --reps=1 >"$out" 2>&1; then
     echo "FUZZ FAIL: $* --device=omp --check"
+    cat "$out"
     fail=1
   fi
+  rm -f "$out"
 }
 
 for _ in $(seq 1 "$rounds"); do

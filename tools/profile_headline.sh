@@ -1,0 +1,70 @@
+#!/bin/bash
+# Capture profiler evidence for the two headline kernels (VERDICT r3
+# item 5 / SURVEY.md §5 tracing): one XProf trace each for SGEMM
+# 1024^3 and 2D stencil 4096^2 on the live chip, then summarize busy %
+# and top ops into docs/logs/ so the roofline claims in BASELINE.md
+# ("bf16_3x ceiling", "VPU-bound at k=8") rest on captured numbers,
+# not slope arithmetic alone. Run on a healthy tunnel; wired into
+# tools/tpu_revalidate.sh.
+#   tools/profile_headline.sh [outdir]   (default docs/logs)
+set -e -o pipefail
+cd "$(dirname "$0")/.."
+
+outdir="${1:-docs/logs}"
+mkdir -p "$outdir"
+stamp=$(date +%Y-%m-%d)
+
+profile_one() {
+  # $1 label, $2 python body that runs the warmed kernel a few times
+  label="$1"; body="$2"
+  tdir=$(mktemp -d "/tmp/tpk_prof_${label}.XXXX")
+  echo "== profiling $label -> $tdir"
+  TPU_KERNELS_PROFILE="$tdir" timeout 900 python -c "
+import os
+import numpy as np
+import jax, jax.numpy as jnp
+from tpukernels import capi
+$body
+"
+  out="$outdir/profile_${label}_${stamp}.log"
+  timeout 300 python tools/profile_summary.py "$tdir" | tee "$out"
+  echo "== summary saved: $out"
+}
+
+# SGEMM 1024^3: warm (compile outside the trace window), then trace a
+# handful of dispatches of the R=50 chained loop from bench.py's
+# methodology — enough MXU work to dominate the trace.
+profile_one sgemm "
+from bench import bench_sgemm  # reuse the exact bench construction
+import bench as B
+rng = np.random.default_rng(0)
+m = 1024
+a = jnp.asarray(rng.standard_normal((m, m)), jnp.float32)
+b = jnp.asarray(rng.standard_normal((m, m)), jnp.float32)
+c = jnp.asarray(rng.standard_normal((m, m)), jnp.float32)
+from tpukernels.kernels.sgemm import sgemm
+from jax import lax
+f = jax.jit(lambda a, b, c: jnp.sum(
+    lax.fori_loop(0, 50, lambda i, cc: sgemm(1.0, a, b, 0.5, cc), c)))
+np.asarray(f(a, b, c))  # compile + warm BEFORE the trace
+capi._maybe_start_profiler()
+for _ in range(3):
+    np.asarray(f(a, b, c))
+capi.stop_profiler()
+"
+
+# 2D stencil 4096^2, k=8 temporal blocking (the config of record)
+profile_one stencil "
+from tpukernels.kernels.stencil import jacobi2d
+rng = np.random.default_rng(1)
+x = jnp.asarray(rng.standard_normal((4096, 4096)), jnp.float32)
+f = jax.jit(lambda x: jnp.sum(jacobi2d(x, 64)))
+np.asarray(f(x))  # compile + warm BEFORE the trace
+capi._maybe_start_profiler()
+for _ in range(3):
+    np.asarray(f(x))
+capi.stop_profiler()
+"
+
+echo "profile_headline: done — paste the busy % / top-op lines into"
+echo "docs/PERF.md next to the roofline claims."

@@ -1,0 +1,144 @@
+"""Summarize a jax.profiler (XProf) trace into the numbers the
+roofline claims in docs/PERF.md / BASELINE.md need: per-plane busy %,
+and the top ops by self time (SURVEY.md §5 tracing/profiling;
+VERDICT r3 item 5 — "profiler evidence for the roofline claims").
+
+Usage:
+    python tools/profile_summary.py <trace_dir> [--top=10]
+
+<trace_dir> is the directory passed as TPU_KERNELS_PROFILE (the
+summarizer finds the newest plugins/profile/<run>/*.xplane.pb under
+it, or accepts a direct path to an .xplane.pb file).
+
+Parsing is protobuf-only via tensorflow.tsl's bundled xplane schema —
+the tensorboard_plugin_profile converter in this image is broken
+(pywrap xspace_to_tools_data missing), so this reads the raw planes
+directly. On a TPU trace the interesting planes are
+"/device:TPU:N" (one per chip; XLA op events with self duration) and
+the host plane; "busy %" is the union of event intervals on a line
+divided by the plane's observed span — for the device plane that is
+compute occupancy, the number behind "VPU/MXU-bound" claims.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+
+def _load_xspace(path: str):
+    # deferred + env-guarded: tf's C++ protobuf descriptors for this
+    # schema are stale in this image; pure-python parsing always works
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def find_xplane(trace_dir: str) -> str:
+    if trace_dir.endswith(".xplane.pb"):
+        return trace_dir
+    hits = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+        ),
+        key=os.path.getmtime,
+    )
+    if not hits:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    return hits[-1]
+
+
+def _union_busy_ps(intervals) -> int:
+    """Total covered picoseconds of [start, end) intervals (events on
+    one line can nest — XLA modules contain ops — so a plain sum
+    double-counts)."""
+    busy = 0
+    last_end = None
+    for s, e in sorted(intervals):
+        if last_end is None or s >= last_end:
+            busy += e - s
+            last_end = e
+        elif e > last_end:
+            busy += e - last_end
+            last_end = e
+    return busy
+
+
+def summarize_plane(plane) -> dict:
+    names = {m.id: m.name for m in plane.event_metadata.values()}
+    op_ps: dict[str, int] = {}
+    intervals = []
+    t_min, t_max = None, 0
+    for line in plane.lines:
+        line_iv = []
+        for ev in line.events:
+            s = line.timestamp_ns * 1000 + ev.offset_ps
+            e = s + ev.duration_ps
+            line_iv.append((s, e))
+            name = names.get(ev.metadata_id, f"id{ev.metadata_id}")
+            op_ps[name] = op_ps.get(name, 0) + ev.duration_ps
+            t_min = s if t_min is None else min(t_min, s)
+            t_max = max(t_max, e)
+        # busy union is per line (parallel lines measure different
+        # engines; merging them would understate concurrency)
+        intervals.append(_union_busy_ps(line_iv))
+    span_ps = (t_max - t_min) if t_min is not None else 0
+    return {
+        "name": plane.name,
+        "span_ms": span_ps / 1e9,
+        "busiest_line_ms": max(intervals) / 1e9 if intervals else 0.0,
+        "busy_pct": 100.0 * max(intervals) / span_ps if span_ps else 0.0,
+        "ops": op_ps,
+    }
+
+
+def main(argv) -> int:
+    top = 10
+    args = []
+    for a in argv[1:]:
+        if a.startswith("--top="):
+            top = int(a[6:])
+        else:
+            args.append(a)
+    if len(args) != 1:
+        print(__doc__)
+        return 2
+    path = find_xplane(args[0])
+    print(f"# trace: {path}")
+    xs = _load_xspace(path)
+    device_seen = False
+    for plane in xs.planes:
+        is_device = "/device:" in plane.name and "CPU" not in plane.name
+        device_seen = device_seen or is_device
+        # host planes are noise for roofline claims; list device and
+        # TensorCore planes in full, others one-line
+        s = summarize_plane(plane)
+        if not s["ops"]:
+            continue
+        print(
+            f"plane {s['name']!r}: span={s['span_ms']:.3f}ms "
+            f"busiest-line busy={s['busiest_line_ms']:.3f}ms "
+            f"({s['busy_pct']:.1f}%)"
+        )
+        if is_device or "TensorCore" in plane.name or "XLA" in plane.name:
+            ranked = sorted(
+                s["ops"].items(), key=lambda kv: -kv[1]
+            )[:top]
+            width = max((len(n) for n, _ in ranked), default=0)
+            for name, ps in ranked:
+                print(f"    {name:<{width}}  {ps / 1e9:10.3f} ms")
+    if not device_seen:
+        print(
+            "# WARNING: no device plane found — host-only trace "
+            "(was the kernel actually dispatched to a TPU?)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -16,8 +16,12 @@ timeout 1800 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
 make -C c -s
 (cd c && timeout 900 env TPK_TEST_TPU=1 TPK_TEST_MESH=8 ./run_all.sh | tail -3)
 
-# 3. Headline metrics (median-of-slopes; see bench.py docstring)
-timeout 3000 python bench.py
+# 3. Headline metrics (median-of-slopes; see bench.py docstring),
+#    then gate on the self-regression compare: any metric >15% below
+#    the BASELINE.json "measured" medians fails the queue loudly.
+bench_out=$(timeout 3000 python bench.py)
+printf '%s\n' "$bench_out"
+printf '%s\n' "$bench_out" | tail -1 | python bench.py --check-regression
 
 # 3b. C-path scan_histogram throughput (docs/NEXT.md item 2): the
 #     combined one-dispatch adapter halved per-rep dispatch cost;
@@ -36,6 +40,12 @@ for san in asan ubsan; do
       TPK_TEST_MESH=8 ./run_all.sh | tail -3)
 done
 make -C c -s clean && make -C c -s
+
+# 3d. Profiler evidence for the roofline claims (VERDICT r3 item 5):
+#     XProf traces of the two headline kernels, summarized into
+#     docs/logs/profile_{sgemm,stencil}_<date>.log — commit these and
+#     lift the busy %/top-op numbers into docs/PERF.md.
+bash tools/profile_headline.sh
 
 # 4. Knob sanity: histogram impls agree, sgemm precisions hold their
 #    error contracts (exercised by tests above; these are quick

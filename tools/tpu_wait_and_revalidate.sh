@@ -12,13 +12,15 @@ cd "$(dirname "$0")/.."
 # session) would both fire the revalidation queue on recovery and
 # interleave timed runs on the one chip. The lock dies with the
 # process; it is inherited by the exec'd revalidation, which keeps
-# the exclusion through the whole queue. Fixed path on purpose — a
-# TMPDIR-dependent one would let watchers from different sessions
-# miss each other.
-exec 9>/tmp/tpk_tpu_wait.lock
+# the exclusion through the whole queue. Repo-local path on purpose:
+# every session cd's here first, so cross-session exclusion holds,
+# and (unlike a world-writable /tmp path) no other local user can
+# pre-hold it to silently disable the watcher. Exit 3 is distinct so
+# a chaining caller can tell "already covered" from "revalidated OK".
+exec 9>.tpk_tpu_wait.lock
 if ! flock -n 9; then
-  echo "tpu_wait: another watcher already holds the lock; exiting"
-  exit 0
+  echo "tpu_wait: another watcher already holds the lock; exiting 3"
+  exit 3
 fi
 
 max_hours="${1:-10}"

@@ -44,6 +44,22 @@ def bus_bandwidth(seconds: float, nbytes: int, nranks: int) -> float:
     return 2.0 * (nranks - 1) / nranks * nbytes / seconds / 1e9
 
 
+def timed_program(op: str, mesh):
+    """The exact jitted program the sweep times: collective + probe.
+
+    The probe must (a) be fetchable on every host — so it reduces to a
+    fully-replicated scalar — and (b) keep the WHOLE collective output
+    live. A partial probe (one column was the old design) leaves the
+    rest dead inside the jit, and XLA is then free to narrow the
+    all-reduce to the live slice, silently turning the bandwidth sweep
+    into a latency benchmark. The full-array sum pins the operand
+    shape (tests/test_distributed.py lowers this very function and
+    asserts it in the optimized HLO); the VPU reduction it adds reads
+    S bytes at HBM bandwidth, negligible vs moving S bytes over ICI."""
+    coll = allreduce_sum if op == "allreduce" else ring_shift
+    return jax.jit(lambda v: jnp.sum(coll(v, mesh)))
+
+
 def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
           reps: int = 10, mesh=None, verbose: bool = True,
           op: str = "allreduce"):
@@ -66,12 +82,7 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
             np.ones((nranks, elems), np.float32), sharding
         )
 
-        # the timing probe must be fetchable on every host, so reduce
-        # to a fully-replicated scalar: one column summed across the
-        # rank axis — P extra scalars of traffic, negligible vs the
-        # message itself
-        coll = allreduce_sum if op == "allreduce" else ring_shift
-        fn = jax.jit(lambda v: jnp.sum(coll(v, mesh)[:, :1]))
+        fn = timed_program(op, mesh)  # see timed_program: un-DCE-able
         # warm-up (compile) then per-call timing with a 4-byte
         # materialization to force real completion (device-side
         # block_until_ready is unreliable through the axon tunnel)

@@ -34,11 +34,12 @@ on a real v5e pod the same code rides ICI.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from tpukernels.utils import cdiv
 
@@ -432,7 +433,7 @@ def _nbody_psum_build(steps: int, mesh: Mesh, axis: str,
             mesh=mesh,
             in_specs=(rep, rep, rep, rep, rep, rep, shard),
             out_specs=(rep, rep, rep, rep, rep, rep),
-            check_rep=False,  # psum of replicated inputs is intentional
+            check_vma=False,  # psum of replicated inputs is intentional
         )
     )
 
@@ -444,14 +445,23 @@ def nbody_dist_ring(state, steps: int, mesh: Mesh, axis: str = "x",
     Sendrecv body-rotation pipeline (SURVEY.md §2 C8, §5 'ring
     communication'). state arrays (N,), N % P == 0."""
     _nbody_check_divisible(state, mesh, axis)
+    # TPK_NBODY_RING_SKIP_LAST=1 (docs/NEXT.md item 5): the plain ring
+    # rotates the j-blocks on its LAST pass too — they arrive back at
+    # their origin rank and are never read, 1/P of the ring's total
+    # comm volume. The knob peels that pass out of the loop so the
+    # final ppermute never exists in the compiled program. Output is
+    # bitwise identical (tests/test_distributed.py); default stays the
+    # uniform-loop formulation until a pod A/B shows XLA wasn't
+    # already overlapping the dead hop.
+    skip_last = os.environ.get("TPK_NBODY_RING_SKIP_LAST") == "1"
     return _nbody_ring_build(
-        int(steps), mesh, axis, float(dt), float(eps)
+        int(steps), mesh, axis, float(dt), float(eps), skip_last
     )(*state)
 
 
 @functools.lru_cache(maxsize=None)
 def _nbody_ring_build(steps: int, mesh: Mesh, axis: str,
-                      dt: float, eps: float):
+                      dt: float, eps: float, skip_last: bool = False):
     dt = jnp.float32(dt)
     eps2 = jnp.float32(eps * eps)
     nranks = mesh.shape[axis]
@@ -473,9 +483,19 @@ def _nbody_ring_build(steps: int, mesh: Mesh, axis: str,
                 return (ax + dax, ay + day, az + daz, jx, jy, jz, jm)
 
             zero = jnp.zeros_like(pxl)
-            ax, ay, az, *_ = jax.lax.fori_loop(
-                0, nranks, ring, (zero, zero, zero, pxl, pyl, pzl, ml)
+            nloops = nranks - 1 if skip_last else nranks
+            ax, ay, az, jx, jy, jz, jm = jax.lax.fori_loop(
+                0, nloops, ring, (zero, zero, zero, pxl, pyl, pzl, ml)
             )
+            if skip_last:
+                # the peeled final pass: accumulate the last j-block's
+                # contribution without rotating it onward. Same accel
+                # op sequence as the uniform loop -> bitwise-identical
+                # trajectories.
+                dax, day, daz = _pairwise_accel(
+                    pxl, pyl, pzl, jx, jy, jz, jm, eps2
+                )
+                ax, ay, az = ax + dax, ay + day, az + daz
             vxl = vxl + ax * dt
             vyl = vyl + ay * dt
             vzl = vzl + az * dt
