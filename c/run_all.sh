@@ -68,8 +68,12 @@ if [ -n "${TPK_TEST_MESH:-}" ] && [ "${TPK_TEST_MESH}" != "0" ]; then
     shift
     run_row "$mesh_env" "$b" tpu "$@"
   done
-  # both N-body formulations (default row above is psum)
+  # both N-body formulations (default row above is psum), plus the
+  # pre-staged ring tuning knobs (odd per-rank block on the bidir row
+  # exercises the uneven half-split)
   run_row "$mesh_env TPK_NBODY_DIST=ring" nbody tpu --n=1024 --iters=2
+  run_row "$mesh_env TPK_NBODY_DIST=ring TPK_NBODY_RING_BIDIR=1 TPK_NBODY_RING_SKIP_LAST=1" \
+    nbody tpu --n=1000 --iters=2
   # the stencil loop's periodic residual MPI_Allreduce analog
   # (SURVEY.md §3(b)): the full C -> shim -> residual-psum path must
   # pass the golden check AND report the global norm on stderr

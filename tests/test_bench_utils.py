@@ -115,3 +115,32 @@ def test_ratios_vs_baseline_merge_and_zero():
     line = json.dumps({"value": 100.0, "vs_measured": r,
                        "details": {"a": 100.0, "b": 0.0}})
     assert bench.check_regression(line) == 1
+
+
+def test_check_regression_cli():
+    """The tools/tpu_revalidate.sh invocation path: JSON line on
+    stdin, verdict as exit status."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no backend init needed,
+    env["JAX_PLATFORMS"] = "cpu"           # but keep imports cheap/safe
+
+    def run(line):
+        return subprocess.run(
+            [sys.executable, "bench.py", "--check-regression"],
+            input=line, capture_output=True, text=True, cwd=repo, env=env,
+            timeout=120,
+        )
+
+    ok = run(json.dumps({"value": 1.0, "vs_measured": {"m": 1.0},
+                         "details": {"m": 1.0}}))
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = run(json.dumps({"value": None, "vs_measured": {},
+                          "details": {}}))
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "REGRESSION" in bad.stdout

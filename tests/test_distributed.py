@@ -349,6 +349,54 @@ def test_nbody_ring_skip_last_bitwise_identical():
     assert "OK" in out
 
 
+def test_nbody_ring_bidir():
+    """TPK_NBODY_RING_BIDIR=1 rotates j-block halves in opposite ring
+    directions so both full-duplex ICI link directions carry bytes
+    every pass (half the per-pass comm time when bandwidth-bound;
+    docs/NEXT.md pod A/B). Must match the single-device oracle within
+    the distributed-nbody tolerance, compose bitwise with SKIP_LAST,
+    and actually emit collective-permutes in BOTH directions."""
+    out = run_cpu8("""
+        import os
+        import jax, numpy as np, jax.numpy as jnp
+        from tpukernels.parallel import make_mesh
+        from tpukernels.parallel.collectives import nbody_dist_ring
+        from tpukernels.kernels.nbody import nbody_reference
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(11)
+        n = 512
+        state = tuple(jnp.asarray(rng.standard_normal(n), jnp.float32)
+                      for _ in range(6)) + (
+            jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32),)
+        os.environ["TPK_NBODY_RING_BIDIR"] = "1"
+        got = nbody_dist_ring(state, 3, mesh)
+        ref = nbody_reference(*state, steps=3)
+        for g, w in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=5e-4, atol=5e-5)
+        # composes with the last-hop peel, bitwise
+        os.environ["TPK_NBODY_RING_SKIP_LAST"] = "1"
+        got_skip = nbody_dist_ring(state, 3, mesh)
+        del os.environ["TPK_NBODY_RING_SKIP_LAST"]
+        del os.environ["TPK_NBODY_RING_BIDIR"]
+        for g, w in zip(got_skip, got):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+        # structural: the bidir program must carry BOTH ring
+        # directions — 8 collective-permutes in the loop body (4
+        # arrays x 2 directions) vs the unidirectional 4
+        from tpukernels.parallel.collectives import _nbody_ring_build
+        def n_perms(bidir):
+            fn = _nbody_ring_build(3, mesh, "x", 1e-3, 1e-2, False, bidir)
+            txt = fn.lower(*state).compile().as_text()
+            k = txt.count("collective-permute-start")
+            return k if k else txt.count("collective-permute(")
+        assert n_perms(False) == 4, n_perms(False)
+        assert n_perms(True) == 8, n_perms(True)
+        print('OK')
+    """)
+    assert "OK" in out
+
+
 def test_multiprocess_allreduce():
     """Real jax.distributed across 2 processes (4 fake CPU devices
     each, 8 global): the multi-host path the 8→64-chip bus-bw run

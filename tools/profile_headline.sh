@@ -32,11 +32,11 @@ $body
 }
 
 # SGEMM 1024^3: warm (compile outside the trace window), then trace a
-# handful of dispatches of the R=50 chained loop from bench.py's
-# methodology — enough MXU work to dominate the trace.
+# handful of dispatches of an R=50 chained-matmul loop — the same
+# shape/chaining SCHEME as bench_sgemm's slope loop (bench.py),
+# rebuilt here because the trace needs one fixed R, not the two-R
+# slope pair. If bench_sgemm's construction changes, mirror it here.
 profile_one sgemm "
-from bench import bench_sgemm  # reuse the exact bench construction
-import bench as B
 rng = np.random.default_rng(0)
 m = 1024
 a = jnp.asarray(rng.standard_normal((m, m)), jnp.float32)

@@ -19,8 +19,12 @@ make -C c -s
 # 3. Headline metrics (median-of-slopes; see bench.py docstring),
 #    then gate on the self-regression compare: any metric >15% below
 #    the BASELINE.json "measured" medians fails the queue loudly.
+#    The JSON line is also persisted to docs/logs/ so an unattended
+#    recovery (watcher-fired queue) leaves a committable artifact even
+#    if the session that started it is gone.
 bench_out=$(timeout 3000 python bench.py)
 printf '%s\n' "$bench_out"
+printf '%s\n' "$bench_out" | tail -1 > "docs/logs/bench_$(date +%Y-%m-%d).json"
 printf '%s\n' "$bench_out" | tail -1 | python bench.py --check-regression
 
 # 3b. C-path scan_histogram throughput (docs/NEXT.md item 2): the
@@ -44,8 +48,11 @@ make -C c -s clean && make -C c -s
 # 3d. Profiler evidence for the roofline claims (VERDICT r3 item 5):
 #     XProf traces of the two headline kernels, summarized into
 #     docs/logs/profile_{sgemm,stencil}_<date>.log — commit these and
-#     lift the busy %/top-op numbers into docs/PERF.md.
-bash tools/profile_headline.sh
+#     lift the busy %/top-op numbers into docs/PERF.md. Evidence
+#     capture, not a correctness gate: a profiling-only failure (tf
+#     schema drift, empty trace) must not abort a queue whose real
+#     gates all passed, so it is warn-only.
+bash tools/profile_headline.sh || echo "WARN: profile capture failed (non-gating)"
 
 # 4. Knob sanity: histogram impls agree, sgemm precisions hold their
 #    error contracts (exercised by tests above; these are quick

@@ -9,15 +9,17 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 
 # single instance: two watchers (e.g. one left over from a previous
-# session) would both fire the revalidation queue on recovery and
-# interleave timed runs on the one chip. The lock dies with the
-# process; it is inherited by the exec'd revalidation, which keeps
-# the exclusion through the whole queue. Repo-local path on purpose:
-# every session cd's here first, so cross-session exclusion holds,
-# and (unlike a world-writable /tmp path) no other local user can
-# pre-hold it to silently disable the watcher. Exit 3 is distinct so
-# a chaining caller can tell "already covered" from "revalidated OK".
-exec 9>.tpk_tpu_wait.lock
+# session, or one per checkout/worktree) would both fire the
+# revalidation queue on recovery and interleave timed runs on the one
+# chip. The lock dies with the process; it is inherited by the exec'd
+# revalidation, which keeps the exclusion through the whole queue.
+# $HOME-scoped fixed path on purpose: machine-wide exclusion across
+# checkouts (a repo-local lock would let two worktrees fire
+# concurrently) without the world-writable-/tmp hazard of any local
+# user pre-holding it to silently disable the watcher. Exit 3 is
+# distinct so a chaining caller can tell "already covered" from
+# "revalidated OK".
+exec 9>"${HOME:-/tmp}/.tpk_tpu_wait.lock"
 if ! flock -n 9; then
   echo "tpu_wait: another watcher already holds the lock; exiting 3"
   exit 3

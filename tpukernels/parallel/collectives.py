@@ -454,47 +454,88 @@ def nbody_dist_ring(state, steps: int, mesh: Mesh, axis: str = "x",
     # uniform-loop formulation until a pod A/B shows XLA wasn't
     # already overlapping the dead hop.
     skip_last = os.environ.get("TPK_NBODY_RING_SKIP_LAST") == "1"
+    # TPK_NBODY_RING_BIDIR=1: ICI links are full-duplex, but the plain
+    # ring only ever pushes bytes one way around — half the available
+    # link bandwidth sits idle. The bidirectional variant splits each
+    # rank's j-block into two halves that rotate in OPPOSITE
+    # directions, so every pass moves half the bytes over each link
+    # direction concurrently: same total volume, ~half the per-pass
+    # comm time when bandwidth-bound. Accumulation order differs from
+    # the unidirectional ring (tolerance-tested vs the single-device
+    # oracle, not bitwise); composes with SKIP_LAST (the peeled final
+    # pass drops BOTH directions' dead rotations). Default stays off
+    # until the pod A/B (docs/NEXT.md) measures it.
+    bidir = os.environ.get("TPK_NBODY_RING_BIDIR") == "1"
     return _nbody_ring_build(
-        int(steps), mesh, axis, float(dt), float(eps), skip_last
+        int(steps), mesh, axis, float(dt), float(eps), skip_last, bidir
     )(*state)
 
 
 @functools.lru_cache(maxsize=None)
 def _nbody_ring_build(steps: int, mesh: Mesh, axis: str,
-                      dt: float, eps: float, skip_last: bool = False):
+                      dt: float, eps: float, skip_last: bool = False,
+                      bidir: bool = False):
     dt = jnp.float32(dt)
     eps2 = jnp.float32(eps * eps)
     nranks = mesh.shape[axis]
-    perm = _ring_perm(nranks, 1)
+    fwd = _ring_perm(nranks, 1)
+    bwd = _ring_perm(nranks, -1)
 
     def local_fn(pxl, pyl, pzl, vxl, vyl, vzl, ml):
+        lsz = pxl.shape[0]
+        h = lsz // 2  # bidir split point (static); h may be 0 at lsz=1
+
         def step(_, s):
             pxl, pyl, pzl, vxl, vyl, vzl = s
 
-            def ring(k, carry):
-                ax, ay, az, jx, jy, jz, jm = carry
-                dax, day, daz = _pairwise_accel(
-                    pxl, pyl, pzl, jx, jy, jz, jm, eps2
+            def accel_pair(carry_blocks):
+                """Accel on the local i-bodies from the currently-held
+                j-data: one block (uni) or fwd+bwd halves concatenated
+                (bidir — one fused kernel, same flops as one block)."""
+                if not bidir:
+                    jx, jy, jz, jm = carry_blocks
+                else:
+                    jx, jy, jz, jm = (
+                        jnp.concatenate([a, b])
+                        for a, b in zip(carry_blocks[:4], carry_blocks[4:])
+                    )
+                return _pairwise_accel(pxl, pyl, pzl, jx, jy, jz, jm, eps2)
+
+            def rotate(carry_blocks):
+                if not bidir:
+                    return tuple(
+                        jax.lax.ppermute(a, axis, fwd) for a in carry_blocks
+                    )
+                return tuple(
+                    jax.lax.ppermute(a, axis, fwd) for a in carry_blocks[:4]
+                ) + tuple(
+                    jax.lax.ppermute(b, axis, bwd) for b in carry_blocks[4:]
                 )
-                jx = jax.lax.ppermute(jx, axis, perm)
-                jy = jax.lax.ppermute(jy, axis, perm)
-                jz = jax.lax.ppermute(jz, axis, perm)
-                jm = jax.lax.ppermute(jm, axis, perm)
-                return (ax + dax, ay + day, az + daz, jx, jy, jz, jm)
+
+            def ring(k, carry):
+                ax, ay, az = carry[:3]
+                blocks = carry[3:]
+                dax, day, daz = accel_pair(blocks)
+                blocks = rotate(blocks)
+                return (ax + dax, ay + day, az + daz) + blocks
 
             zero = jnp.zeros_like(pxl)
+            if not bidir:
+                init_blocks = (pxl, pyl, pzl, ml)
+            else:
+                init_blocks = tuple(a[:h] for a in (pxl, pyl, pzl, ml)) + \
+                    tuple(a[h:] for a in (pxl, pyl, pzl, ml))
             nloops = nranks - 1 if skip_last else nranks
-            ax, ay, az, jx, jy, jz, jm = jax.lax.fori_loop(
-                0, nloops, ring, (zero, zero, zero, pxl, pyl, pzl, ml)
+            out = jax.lax.fori_loop(
+                0, nloops, ring, (zero, zero, zero) + init_blocks
             )
+            ax, ay, az = out[:3]
             if skip_last:
-                # the peeled final pass: accumulate the last j-block's
+                # the peeled final pass: accumulate the last j-data's
                 # contribution without rotating it onward. Same accel
                 # op sequence as the uniform loop -> bitwise-identical
-                # trajectories.
-                dax, day, daz = _pairwise_accel(
-                    pxl, pyl, pzl, jx, jy, jz, jm, eps2
-                )
+                # trajectories (per formulation).
+                dax, day, daz = accel_pair(out[3:])
                 ax, ay, az = ax + dax, ay + day, az + daz
             vxl = vxl + ax * dt
             vyl = vyl + ay * dt
