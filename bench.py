@@ -25,14 +25,13 @@ import statistics
 import sys
 import time
 
-# Persist compiled executables across bench invocations (same knob the
-# C shim sets in capi.py): each metric compiles two jitted repeat-count
-# variants at 20-40 s per remote compile, which otherwise dominates the
-# run's wall clock. Must be set before jax initializes a backend.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-)
+# Persist compiled executables across bench invocations: each metric
+# compiles two jitted repeat-count variants at 20-40 s per remote
+# compile, which otherwise dominates the run's wall clock. Must run
+# before the jax import below (see tpukernels/_cachedir.py).
+from tpukernels._cachedir import ensure_compilation_cache
+
+ensure_compilation_cache()
 
 import jax
 import jax.numpy as jnp
@@ -330,7 +329,42 @@ BENCH_METRICS = (
 )
 
 
+def _run_one_subprocess(name: str, timeout_s: float):
+    """Run one metric via `bench.py --one <name>` in a killable child.
+
+    The in-process SIGALRM watchdog (_with_timeout) cannot interrupt a
+    hung C-level PJRT call — observed 2026-07-31: the tunnel answered a
+    liveness probe, then wedged ~2 min later mid-suite, and SIGALRM
+    never fired. A subprocess is killable from outside regardless of
+    where it hangs. Returns (value_or_None, status) with status in
+    {"ok", "timeout", "error", "parse"}; stderr passes through so the
+    child's progress lines land in the caller's log."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", name],
+            timeout=timeout_s,
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    if r.returncode != 0:
+        return None, "error"
+    try:
+        last = r.stdout.strip().splitlines()[-1]
+        return json.loads(last)["value"], "ok"
+    except Exception:
+        return None, "parse"
+
+
 def main():
+    # clock starts BEFORE the liveness probe: the probe's recovery
+    # patience (~28 min worst case) must come out of the same budget
+    # the caller's outer timeout covers, or probe + metrics together
+    # can outlast the caller and get killed mid-run after all
+    t0 = time.monotonic()
     results = {}
     if not _tpu_alive():
         print(
@@ -345,15 +379,58 @@ def main():
             )
         )
         return
-    for name, fn in BENCH_METRICS:
-        try:
-            results[name] = round(_with_timeout(fn), 2)
-            print(f"# {name}: {results[name]}", file=sys.stderr)
-            sys.stderr.flush()
-        except Exception as e:  # keep the headline alive if one fails
+    # One killable subprocess per metric (order = BENCH_METRICS, so the
+    # headline sgemm number is captured FIRST): if the tunnel wedges
+    # mid-run we emit every metric captured so far instead of hanging
+    # until some outer timeout discards the whole run — that failure
+    # mode produced three consecutive null BENCH artifacts. After a
+    # timeout, one quick liveness re-probe decides "slow" vs "wedged";
+    # wedged skips the remaining metrics immediately rather than
+    # burning a full watchdog window on each.
+    #
+    # Whole-run deadline, measured from main() entry (t0 above, so it
+    # absorbs however long the startup _tpu_alive probe took):
+    # worst-case per-metric deadlines alone sum past any sane caller
+    # timeout (7 x 720 s), and an OUTER kill (tools/tpu_revalidate.sh's
+    # `timeout`, the driver's bound) discards the whole run with no
+    # JSON line — the exact failure the per-metric isolation exists to
+    # prevent — while orphaning the in-flight --one child on the TPU.
+    # Enforcing the budget HERE means the JSON line always gets out
+    # and children are always reaped; metrics past the deadline report
+    # None. Callers must allow > TPK_BENCH_DEADLINE_S end to end.
+    deadline = t0 + float(os.environ.get("TPK_BENCH_DEADLINE_S", "4800"))
+    # 120 s of each child's window is held back for the post-timeout
+    # wedge probe (90 s) + JSON emission, so main() cannot overrun the
+    # deadline by more than that reserve. Callers' outer timeouts must
+    # still allow TPK_BENCH_DEADLINE_S plus ~2 min of margin.
+    wedged = False
+    for name, _fn in BENCH_METRICS:
+        remaining = deadline - time.monotonic()
+        if wedged or remaining < 180:
+            if not wedged and remaining < 180:
+                print(
+                    f"# whole-run deadline reached before {name} - "
+                    "emitting partial results",
+                    file=sys.stderr,
+                )
+                wedged = True  # skip the rest, same as the wedge path
             results[name] = None
-            print(f"# {name} FAILED: {e}", file=sys.stderr)
-            sys.stderr.flush()
+            continue
+        value, status = _run_one_subprocess(
+            name, min(_BENCH_TIMEOUT_S + 120, remaining - 120)
+        )
+        results[name] = value
+        if value is not None:
+            print(f"# {name}: {value}", file=sys.stderr)
+        else:
+            print(f"# {name} FAILED ({status})", file=sys.stderr)
+        sys.stderr.flush()
+        if status == "timeout" and not _tpu_alive(timeout_s=90, attempts=1):
+            print(
+                "# tunnel wedged mid-bench - emitting partial results",
+                file=sys.stderr,
+            )
+            wedged = True
 
     headline = results.get("sgemm_gflops")
     ratios = _ratios_vs_baseline(results, _load_baseline())
@@ -442,4 +519,33 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--check-regression":
         # stdin: the JSON line a prior `python bench.py` run printed
         sys.exit(check_regression(sys.stdin.read().strip()))
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        # child mode for main()'s per-metric subprocess isolation; the
+        # SIGALRM guard stays as a soft second layer for pure-Python
+        # slowness (it cannot catch a wedged PJRT call — the parent's
+        # kill does that)
+        fn = dict(BENCH_METRICS)[sys.argv[2]]
+        if (
+            os.environ.get("PALLAS_AXON_POOL_IPS")
+            or os.environ.get("TPK_BENCH_EXPECT_TPU") == "1"
+        ):
+            # this child re-initializes JAX from scratch: a fail-fast
+            # tunnel outage between metrics makes jax fall back to CPU
+            # SILENTLY, and a CPU number must never be reported as a
+            # TPU metric (parent's wedge probe only covers the hang
+            # mode). Exit nonzero -> parent records None ("error").
+            # TPK_BENCH_EXPECT_TPU drives this guard in tests: with
+            # the pool var set, sitecustomize dials the real tunnel,
+            # which a test must never depend on.
+            platform = jax.devices()[0].platform
+            if platform not in ("tpu", "axon"):
+                print(
+                    f"--one {sys.argv[2]}: backend is {platform!r}, "
+                    "not TPU - refusing to measure",
+                    file=sys.stderr,
+                )
+                sys.exit(2)
+        print(json.dumps({"name": sys.argv[2],
+                          "value": round(_with_timeout(fn), 2)}))
+        sys.exit(0)
     main()

@@ -122,6 +122,18 @@ def pytest_configure(config):
         env,
     )
 
+# Persist compiled executables across suite runs (the shared knob —
+# tpukernels/_cachedir.py; `import tpukernels` is deliberately
+# jax-free, so this respects the env-before-jax-import rule below).
+# Irrelevant on the CPU path (sub-second compiles), decisive on the
+# compiled-on-chip path: remote compiles cost 20-40 s each and the
+# 2026-07-31 on-chip run burned its entire 1800 s budget recompiling —
+# with the cache warm, a revalidation re-run spends that budget
+# actually executing tests.
+from tpukernels._cachedir import ensure_compilation_cache
+
+ensure_compilation_cache()
+
 # Explicit assignment, not setdefault: the dev/CI shell may have
 # JAX_PLATFORMS pre-set to a TPU plugin (e.g. axon), and the contract
 # here is that the unit suite runs on CPU.

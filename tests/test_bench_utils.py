@@ -144,3 +144,90 @@ def test_check_regression_cli():
                           "details": {}}))
     assert bad.returncode == 1, bad.stdout + bad.stderr
     assert "REGRESSION" in bad.stdout
+
+
+def test_one_metric_child_protocol():
+    """`bench.py --one <name>` is the killable-child half of main()'s
+    per-metric isolation (a wedged PJRT call ignores SIGALRM, so each
+    metric runs in a subprocess the parent can kill): last stdout line
+    must be JSON with the metric's value. TPK_BENCH_SMOKE collapses
+    the slope loop so this runs on CPU in seconds."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from test_distributed import _scrubbed_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _scrubbed_env(fake_devices=None)  # CPU, never the tunnel
+    env["TPK_BENCH_SMOKE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--one", "saxpy_gb_s"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["name"] == "saxpy_gb_s"
+    assert isinstance(rec["value"], float) and rec["value"] > 0
+
+
+def test_run_one_subprocess_classifies_failures():
+    """The parent half: an unknown metric exits nonzero -> "error"
+    (fast KeyError, no backend touched); an impossible deadline kills
+    the child mid-startup -> "timeout" (the wedge signature main()'s
+    fast-fail probe keys on)."""
+    value, status = bench._run_one_subprocess("no_such_metric", 120)
+    assert (value, status) == (None, "error")
+    value, status = bench._run_one_subprocess("saxpy_gb_s", 0.5)
+    assert (value, status) == (None, "timeout")
+
+
+def test_one_metric_child_refuses_cpu_fallback():
+    """A --one child re-initializes JAX; a fail-fast tunnel outage
+    between metrics silently lands it on CPU, and a CPU number must
+    never be persisted as a TPU metric. TPK_BENCH_EXPECT_TPU drives
+    the guard without the axon plugin (with the real pool var set,
+    sitecustomize would dial the tunnel)."""
+    import os
+    import subprocess
+    import sys
+
+    from test_distributed import _scrubbed_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _scrubbed_env(fake_devices=None)  # CPU backend
+    env["TPK_BENCH_SMOKE"] = "1"
+    env["TPK_BENCH_EXPECT_TPU"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--one", "saxpy_gb_s"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=repo,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "refusing to measure" in proc.stderr
+    assert not proc.stdout.strip()  # no JSON line a parent could parse
+
+
+def test_main_deadline_emits_json_line(monkeypatch, capsys):
+    """The whole-run deadline exists so bench.py ALWAYS emits its JSON
+    line itself rather than being killed mid-run by a caller's outer
+    timeout (which would discard every captured metric and orphan the
+    in-flight child). Deadline 0 -> every metric skipped, line still
+    printed, with nulls."""
+    import json
+
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench,
+        "_run_one_subprocess",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("no child may be spawned past the deadline")
+        ),
+    )
+    monkeypatch.setenv("TPK_BENCH_DEADLINE_S", "0")
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["value"] is None
+    assert set(rec["details"]) == {n for n, _ in bench.BENCH_METRICS}
+    assert all(v is None for v in rec["details"].values())

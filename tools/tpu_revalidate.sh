@@ -1,51 +1,46 @@
 #!/bin/bash
 # Post-recovery TPU validation queue (run from /root/repo).
-# Use after the axon tunnel has been down or wedged: re-proves the
-# compiled path end to end, then re-measures every headline metric.
-# -e: this is a gate — a failed suite, gate row, or sanitizer abort
-# must fail the whole queue, not fall through to the next step.
+# Use after the axon tunnel has been down or wedged: re-measures every
+# headline metric, then re-proves the compiled path end to end.
+#
+# ORDERING (2026-07-31): highest value per chip-minute FIRST. The
+# tunnel has been observed to flap — answer a probe, serve traffic for
+# ~2 minutes, then wedge (hang, not error) — so a healthy window must
+# produce the round's headline numbers before anything long-running
+# gets a chance to eat it. bench.py is itself wedge-tolerant (one
+# killable subprocess per metric, partial results on wedge).
+#
+# -e: this is a gate — a failed bench, suite, gate row, or sanitizer
+# abort must fail the whole queue, not fall through to the next step.
 set -e -x -o pipefail
 cd "$(dirname "$0")/.."
 
-# 1. Compiled-path test suite (axon backend, kernels compile on chip).
-# TPK_REQUIRE_TPU=1: a still-wedged tunnel must FAIL here, not slip
-# into conftest's silent CPU fallback.
-timeout 1800 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
-
-# 2. C acceptance gate: serial/omp + real TPU rows + fake-device mesh
-make -C c -s
-(cd c && timeout 900 env TPK_TEST_TPU=1 TPK_TEST_MESH=8 ./run_all.sh | tail -3)
-
-# 3. Headline metrics (median-of-slopes; see bench.py docstring),
+# 1. Headline metrics (median-of-slopes; see bench.py docstring),
 #    then gate on the self-regression compare: any metric >15% below
 #    the BASELINE.json "measured" medians fails the queue loudly.
 #    The JSON line is also persisted to docs/logs/ so an unattended
 #    recovery (watcher-fired queue) leaves a committable artifact even
 #    if the session that started it is gone.
-bench_out=$(timeout 3000 python bench.py)
+#    Artifact name carries the full timestamp: a same-day re-run (the
+#    watcher can fire the queue more than once across tunnel flaps)
+#    must not clobber an earlier good run's numbers with a worse or
+#    partial line.
+bench_out=$(timeout 5400 python bench.py)
 printf '%s\n' "$bench_out"
-printf '%s\n' "$bench_out" | tail -1 > "docs/logs/bench_$(date +%Y-%m-%d).json"
+printf '%s\n' "$bench_out" | tail -1 > "docs/logs/bench_$(date +%Y-%m-%d_%H%M%S).json"
 printf '%s\n' "$bench_out" | tail -1 | python bench.py --check-regression
 
-# 3b. C-path scan_histogram throughput (docs/NEXT.md item 2): the
+# 2. C acceptance gate: serial/omp + real TPU rows + fake-device mesh
+make -C c -s
+(cd c && timeout 900 env TPK_TEST_TPU=1 TPK_TEST_MESH=8 ./run_all.sh | tail -3)
+
+# 2b. C-path scan_histogram throughput (docs/NEXT.md item 2): the
 #     combined one-dispatch adapter halved per-rep dispatch cost;
 #     record this Melem/s in docs/PERF.md next to the kernel-level
 #     number.
 (cd c && timeout 600 ./bin/scan_histogram --device=tpu --n=4194304 --check)
 
-# 3c. Sanitizer gates (SURVEY.md §5): ASan then UBSan rebuilds, full
-#     gate incl. the embedded-CPython shim rows on a scrubbed CPU env
-#     (kernels auto-interpret there), then restore the normal build.
-#     First recorded PASS logs: docs/logs/{asan,ubsan}_gate_2026-07-30.log.
-for san in asan ubsan; do
-  make -C c "$san"
-  (cd c && timeout 1800 env ASAN_OPTIONS=detect_leaks=0 \
-      PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_TEST_TPU=1 \
-      TPK_TEST_MESH=8 ./run_all.sh | tail -3)
-done
-make -C c -s clean && make -C c -s
-
-# 3d. Profiler evidence for the roofline claims (VERDICT r3 item 5):
+# 2c. Profiler evidence for the roofline claims (VERDICT r3 item 5):
 #     XProf traces of the two headline kernels, summarized into
 #     docs/logs/profile_{sgemm,stencil}_<date>.log — commit these and
 #     lift the busy %/top-op numbers into docs/PERF.md. Evidence
@@ -54,9 +49,9 @@ make -C c -s clean && make -C c -s
 #     gates all passed, so it is warn-only.
 bash tools/profile_headline.sh || echo "WARN: profile capture failed (non-gating)"
 
-# 4. Knob sanity: histogram impls agree, sgemm precisions hold their
-#    error contracts (exercised by tests above; these are quick
-#    re-confirms on the chip)
+# 2d. Knob sanity: histogram impls agree, sgemm precisions hold their
+#     error contracts (exercised by the suite below too; these are
+#     quick re-confirms on the chip while the tunnel is warm)
 for impl in mxu vpu; do
   timeout 600 env TPK_HIST_IMPL=$impl python -c "
 from bench import bench_scan_hist
@@ -65,3 +60,22 @@ done
 timeout 600 env TPK_SGEMM_PRECISION=float32 python -c "
 from bench import bench_sgemm
 print('sgemm f32 (bf16_6x):', round(bench_sgemm(), 1))"
+
+# 3. Compiled-path test suite (axon backend, kernels compile on chip).
+# TPK_REQUIRE_TPU=1: a still-wedged tunnel must FAIL here, not slip
+# into conftest's silent CPU fallback. Longest step — deliberately
+# after every metric capture.
+timeout 1800 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
+
+# 4. Sanitizer gates (SURVEY.md §5): ASan then UBSan rebuilds, full
+#    gate incl. the embedded-CPython shim rows on a scrubbed CPU env
+#    (kernels auto-interpret there), then restore the normal build.
+#    CPU-only — needs no tunnel; last on purpose.
+#    First recorded PASS logs: docs/logs/{asan,ubsan}_gate_2026-07-30.log.
+for san in asan ubsan; do
+  make -C c "$san"
+  (cd c && timeout 1800 env ASAN_OPTIONS=detect_leaks=0 \
+      PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_TEST_TPU=1 \
+      TPK_TEST_MESH=8 ./run_all.sh | tail -3)
+done
+make -C c -s clean && make -C c -s

@@ -16,13 +16,28 @@ cd "$(dirname "$0")/.."
 # $HOME-scoped fixed path on purpose: machine-wide exclusion across
 # checkouts (a repo-local lock would let two worktrees fire
 # concurrently) without the world-writable-/tmp hazard of any local
-# user pre-holding it to silently disable the watcher. Exit 3 is
-# distinct so a chaining caller can tell "already covered" from
-# "revalidated OK".
-exec 9>"${HOME:-/tmp}/.tpk_tpu_wait.lock"
+# user pre-holding it to silently disable the watcher. No /tmp
+# fallback for the same reason — an env without HOME (cron, systemd)
+# must fail loudly here, not silently downgrade to a pre-holdable
+# lock. Exit 3 is distinct so a chaining caller can tell "already
+# covered" from "revalidated OK".
+: "${HOME:?tpu_wait: HOME unset - refusing a world-writable /tmp lock}"
+exec 9>"$HOME/.tpk_tpu_wait.lock"
 if ! flock -n 9; then
   echo "tpu_wait: another watcher already holds the lock; exiting 3"
   exit 3
+fi
+# transition guard: a watcher from a pre-relocation checkout may still
+# hold the LEGACY /tmp lock and would not contend with ours — warn so
+# the operator kills it rather than risking two interleaved
+# revalidations on the one chip (warn-only: the legacy path is
+# world-writable, so a held lock there must not be able to disable us)
+if [ -e /tmp/tpk_tpu_wait.lock ] && command -v flock >/dev/null; then
+  if ! flock -n -E 99 /tmp/tpk_tpu_wait.lock true 2>/dev/null; then
+    echo "tpu_wait: WARNING: legacy /tmp/tpk_tpu_wait.lock is held -" \
+         "a pre-relocation watcher may still be running (pgrep" \
+         "tpu_wait_and_revalidate)"
+  fi
 fi
 
 max_hours="${1:-10}"

@@ -1,0 +1,32 @@
+"""Single definition of the persistent compilation-cache knob.
+
+Every entry path into JAX in this repo (bench.py, tpukernels.capi for
+the C shim's embedded CPython, __graft_entry__'s driver subprocesses,
+tests/conftest.py) wants the same thing: compiled executables persisted
+in the repo-shared ``.jax_cache`` so no timing loop or suite re-run
+ever eats a 20-40 s remote recompile. One helper instead of one copy
+per entry path — a drifted copy silently splits the cache.
+
+Import-order contract: JAX captures env-derived config defaults when
+``jax`` itself is imported, so this must run BEFORE the caller imports
+jax — which is why this module imports nothing beyond ``os`` and why
+``import tpukernels`` stays jax-free (registry loads kernels lazily).
+"""
+
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ensure_compilation_cache(env: dict | None = None) -> str:
+    """Point JAX_COMPILATION_CACHE_DIR at the repo ``.jax_cache``
+    unless the caller's environment already chose one.
+
+    env: a subprocess environment dict to update, or None for
+    ``os.environ``. Returns the effective cache dir either way.
+    """
+    target = os.environ if env is None else env
+    target.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
+    )
+    return target["JAX_COMPILATION_CACHE_DIR"]

@@ -21,11 +21,11 @@ import numpy as np
 
 # Compilation-cache (SURVEY.md §5 "checkpoint/resume"): persist
 # compiled executables across C-driver processes so the timing loop
-# never eats a recompile. Must be set before jax initializes a backend.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
-)
+# never eats a recompile. Must run before the jax import below (see
+# tpukernels/_cachedir.py).
+from tpukernels._cachedir import ensure_compilation_cache
+
+ensure_compilation_cache()
 
 _PROFILE_DIR = os.environ.get("TPU_KERNELS_PROFILE")
 _profiling = False
