@@ -64,8 +64,11 @@ print('sgemm f32 (bf16_6x):', round(bench_sgemm(), 1))"
 # 3. Compiled-path test suite (axon backend, kernels compile on chip).
 # TPK_REQUIRE_TPU=1: a still-wedged tunnel must FAIL here, not slip
 # into conftest's silent CPU fallback. Longest step — deliberately
-# after every metric capture.
-timeout 1800 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
+# after every metric capture. 2700 s: the 2026-07-31 cold-cache run
+# needed >1800 s of remote compiles; conftest now persists the
+# compilation cache, but the FIRST post-recovery run still compiles
+# whatever the bench steps above didn't.
+timeout 2700 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
 
 # 4. Sanitizer gates (SURVEY.md §5): ASan then UBSan rebuilds, full
 #    gate incl. the embedded-CPython shim rows on a scrubbed CPU env
