@@ -329,6 +329,34 @@ BENCH_METRICS = (
 )
 
 
+def _latest_persisted_artifact(root=None):
+    """Newest docs/logs/bench_*.json with a non-null headline, as
+    {"path": ..., "line": {...}} — or None. Only consulted on the
+    tunnel-unreachable path, where it is reported as a POINTER to
+    earlier evidence, never as the run's own measurement."""
+    import glob
+
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__))
+    # newest by FILENAME, not mtime: the writer embeds a sortable
+    # timestamp (bench_%Y-%m-%d_%H%M%S.json, tools/tpu_revalidate.sh)
+    # and these files are committed — git does not preserve mtimes, so
+    # after a clone/checkout mtime order is arbitrary
+    for p in sorted(
+        glob.glob(os.path.join(root, "docs", "logs", "bench_*.json")),
+        key=os.path.basename,
+        reverse=True,
+    ):
+        try:
+            with open(p) as f:
+                rec = json.loads(f.read().strip() or "null")
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and rec.get("value") is not None:
+            return {"path": os.path.relpath(p, root), "line": rec}
+    return None
+
+
 def _run_one_subprocess(name: str, timeout_s: float):
     """Run one metric via `bench.py --one <name>` in a killable child.
 
@@ -367,6 +395,16 @@ def main():
     t0 = time.monotonic()
     results = {}
     if not _tpu_alive():
+        details = {"error": "TPU backend unreachable (tunnel down)"}
+        prior = _latest_persisted_artifact()
+        if prior is not None:
+            # honesty note, not a substitute measurement: the headline
+            # stays null (nothing was measured NOW), but if a
+            # watcher-fired queue captured numbers earlier in this
+            # flap cycle, point the reader at that committed artifact
+            # instead of leaving "null" to read as "no evidence
+            # exists" (see tools/tpu_revalidate.sh step 1)
+            details["last_persisted_artifact"] = prior
         print(
             json.dumps(
                 {
@@ -374,7 +412,7 @@ def main():
                     "value": None,
                     "unit": "GFLOPS",
                     "vs_baseline": None,
-                    "details": {"error": "TPU backend unreachable (tunnel down)"},
+                    "details": details,
                 }
             )
         )
