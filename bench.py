@@ -39,6 +39,7 @@ import numpy as np
 from jax import lax
 
 _BENCH_TIMEOUT_S = 600  # per-benchmark watchdog (tunnel can wedge)
+_REGRESSION_TOL = 0.15  # shared by check_regression and skip-captured
 
 
 class _Timeout(Exception):
@@ -329,19 +330,26 @@ BENCH_METRICS = (
 )
 
 
-def _latest_persisted_artifact(root=None):
-    """Newest docs/logs/bench_*.json with a non-null headline, as
-    {"path": ..., "line": {...}} — or None. Only consulted on the
-    tunnel-unreachable path, where it is reported as a POINTER to
-    earlier evidence, never as the run's own measurement."""
+def _is_measurement(v):
+    """A detail entry that is a real measured number — not None, not a
+    bool, and not the string payloads of the tunnel-down error line
+    (details = {"error": ..., "last_persisted_artifact": ...}), which
+    must never count as evidence."""
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _iter_bench_artifacts(root=None):
+    """Yield (abspath, parsed_record) for docs/logs/bench_*.json,
+    newest first by FILENAME timestamp — the writer embeds a sortable
+    stamp (bench_%Y-%m-%d_%H%M%S.json, tools/tpu_revalidate.sh) and
+    these files are committed; git does not preserve mtimes, so after
+    a clone/checkout mtime order is arbitrary. Unparseable files are
+    skipped. Single scanner shared by the pointer path and the union
+    gate so they cannot disagree about what evidence exists."""
     import glob
 
     if root is None:
         root = os.path.dirname(os.path.abspath(__file__))
-    # newest by FILENAME, not mtime: the writer embeds a sortable
-    # timestamp (bench_%Y-%m-%d_%H%M%S.json, tools/tpu_revalidate.sh)
-    # and these files are committed — git does not preserve mtimes, so
-    # after a clone/checkout mtime order is arbitrary
     for p in sorted(
         glob.glob(os.path.join(root, "docs", "logs", "bench_*.json")),
         key=os.path.basename,
@@ -352,9 +360,71 @@ def _latest_persisted_artifact(root=None):
                 rec = json.loads(f.read().strip() or "null")
         except (OSError, ValueError):
             continue
-        if isinstance(rec, dict) and rec.get("value") is not None:
+        if isinstance(rec, dict):
+            yield p, rec
+
+
+def _latest_persisted_artifact(root=None):
+    """Newest docs/logs/bench_*.json holding at least one real
+    measurement, as {"path": ..., "line": {...}} — or None. Only
+    consulted on the tunnel-unreachable path, where it is reported as
+    a POINTER to earlier evidence, never as the run's own
+    measurement."""
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__))
+    for p, rec in _iter_bench_artifacts(root):
+        # a wedged run with a null headline but captured detail
+        # metrics (e.g. sgemm wedged, stencil survived) is still
+        # evidence worth pointing at; a tunnel-down error line
+        # (string-valued details, no numbers) is not
+        if _is_measurement(rec.get("value")) or any(
+            _is_measurement(v) for v in (rec.get("details") or {}).values()
+        ):
             return {"path": os.path.relpath(p, root), "line": rec}
     return None
+
+
+def _recent_captured_metrics(root=None, max_age_h=24.0):
+    """Union of measured per-metric values from docs/logs/bench_*.json
+    artifacts whose FILENAME timestamp is within `max_age_h` of now
+    (newest artifact wins per metric). Returns {metric: (value,
+    relpath)}.
+
+    Powers two flap-cycle accumulators (the tunnel has been observed
+    to serve ~2-25 healthy minutes between wedges, so one window
+    rarely fits all seven metrics):
+      - TPK_BENCH_SKIP_CAPTURED=1: spend a short healthy window only
+        on metrics with no persisted evidence yet;
+      - --check-regression --union-persisted: let evidence accumulated
+        across several windows satisfy the gate together.
+    Caveat both callers accept: the window is wall-clock, not
+    git-aware — evidence predating a same-day kernel change still
+    counts. The watcher mitigates by always re-measuring the headline
+    fresh (see main's skip-captured branch)."""
+    import datetime
+
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__))
+    now = datetime.datetime.now()
+    out = {}
+    # _iter_bench_artifacts yields newest first; first writer wins =
+    # newest value per metric
+    for p, rec in _iter_bench_artifacts(root):
+        try:
+            stamp = datetime.datetime.strptime(
+                os.path.basename(p), "bench_%Y-%m-%d_%H%M%S.json"
+            )
+        except ValueError:
+            continue
+        age_h = (now - stamp).total_seconds() / 3600.0
+        if not (0 <= age_h <= max_age_h):
+            # future-stamped files are clock skew/testing noise, not
+            # evidence
+            continue
+        for name, value in (rec.get("details") or {}).items():
+            if _is_measurement(value) and name not in out:
+                out[name] = (value, os.path.relpath(p, root))
+    return out
 
 
 def _run_one_subprocess(name: str, timeout_s: float):
@@ -441,8 +511,43 @@ def main():
     # wedge probe (90 s) + JSON emission, so main() cannot overrun the
     # deadline by more than that reserve. Callers' outer timeouts must
     # still allow TPK_BENCH_DEADLINE_S plus ~2 min of margin.
+    metrics = list(BENCH_METRICS)
+    carried = {}
+    if os.environ.get("TPK_BENCH_SKIP_CAPTURED") == "1":
+        # watcher-fired queues set this: a flap window too short for
+        # all seven metrics should be spent on the ones with no
+        # persisted evidence yet. Skipped metrics are ABSENT from
+        # "details" (this run did not measure them) and listed under
+        # "carried" with the artifact each value came from; the
+        # queue's gate runs --union-persisted to judge the union.
+        # Two metrics are never skipped:
+        #   - the headline (sgemm): a fresh canary every attempt, so a
+        #     same-day code change can't ride entirely on pre-change
+        #     artifacts;
+        #   - anything whose carried value is already below tolerance:
+        #     freezing a degraded measurement would make every retry
+        #     fail on the one metric it refuses to re-run.
+        prior = _recent_captured_metrics()
+        known = dict(BENCH_METRICS)
+        prior_ratios = _ratios_vs_baseline(
+            {n: v for n, (v, _p) in prior.items()}, _load_baseline()
+        )
+        for n, (v, p) in prior.items():
+            if n not in known or n == "sgemm_gflops":
+                continue
+            if prior_ratios.get(n, 1.0) < 1.0 - _REGRESSION_TOL:
+                continue
+            carried[n] = (v, p)
+        if carried:
+            metrics = [(n, f) for n, f in metrics if n not in carried]
+            print(
+                "# skip-captured: "
+                f"{sorted(carried)} have persisted evidence <24h old; "
+                f"measuring {[n for n, _ in metrics]}",
+                file=sys.stderr,
+            )
     wedged = False
-    for name, _fn in BENCH_METRICS:
+    for name, _fn in metrics:
         remaining = deadline - time.monotonic()
         if wedged or remaining < 180:
             if not wedged and remaining < 180:
@@ -474,18 +579,19 @@ def main():
     ratios = _ratios_vs_baseline(results, _load_baseline())
     vs = ratios.get("sgemm_gflops")
 
-    print(
-        json.dumps(
-            {
-                "metric": "sgemm_gflops_per_chip",
-                "value": headline,
-                "unit": "GFLOPS",
-                "vs_baseline": vs if vs is not None else 1.0,
-                "details": results,
-                "vs_measured": ratios,
-            }
-        )
-    )
+    line = {
+        "metric": "sgemm_gflops_per_chip",
+        "value": headline,
+        "unit": "GFLOPS",
+        "vs_baseline": vs if vs is not None else 1.0,
+        "details": results,
+        "vs_measured": ratios,
+    }
+    if carried:
+        # prior-window evidence (value, source artifact) — NOT this
+        # run's measurements; details/value above are fresh-only
+        line["carried"] = {n: list(v) for n, v in carried.items()}
+    print(json.dumps(line))
 
 
 def _ratios_vs_baseline(results: dict, baseline: dict) -> dict:
@@ -525,30 +631,112 @@ def _load_baseline() -> dict:
         return {}
 
 
-def check_regression(json_line: str, tolerance: float = 0.15) -> int:
+def check_regression(
+    json_line: str,
+    tolerance: float = _REGRESSION_TOL,
+    union_persisted: bool = False,
+    root=None,
+) -> int:
     """Gate helper for tools/tpu_revalidate.sh: given bench.py's JSON
-    output line, fail (return 1) if any metric dropped more than
-    `tolerance` below the BASELINE.json "measured" medians, or if the
-    headline is null. Metrics the baseline lacks pass through."""
+    output line, judge it against the BASELINE.json "measured"
+    medians. Metrics the baseline lacks pass through.
+
+    Exit codes (the watcher's retry loop keys on them):
+      0 — every required metric covered and within `tolerance`;
+      1 — DETERMINISTIC failure: a metric measured more than
+          `tolerance` below baseline, or the line was judged with the
+          wrong gate mode. Retrying without a code change is useless.
+      2 — INSUFFICIENT COVERAGE: a metric has no value (wedged child,
+          null headline, evidence aged out). Nothing regressed —
+          another healthy window can fix it, so it is retryable.
+    A run with both kinds of failure returns 1 (the regression is the
+    more actionable fact).
+
+    union_persisted: judge the UNION of this line's fresh details,
+    the line's own carried block (decision-time evidence, immune to
+    artifacts aging past the window between skip decision and gate),
+    and every persisted artifact <24h old (newest wins per metric) —
+    the watcher-fired queue's mode, where evidence accumulates across
+    several short flap windows and no single run holds all seven
+    metrics. Every BENCH_METRICS name must be covered and within
+    tolerance for the union to pass, and the sgemm headline must be
+    fresh (measured by THIS run)."""
     rec = json.loads(json_line)
+    if rec.get("carried") and not union_persisted:
+        # a skip-captured line's details hold only the freshly
+        # measured subset; judging it without the union would quietly
+        # shrink the gate to 1-2 metrics (pre-skip, details always
+        # carried all seven names, so full coverage was implicit)
+        print(
+            "REGRESSION: line has carried metrics - judge it with "
+            "--union-persisted, not the single-run gate"
+        )
+        return 1
+    regressed = []  # rc 1: measured and too slow
+    missing = []    # rc 2: not measured at all
+    if union_persisted:
+        fresh = {
+            n: v
+            for n, v in (rec.get("details") or {}).items()
+            if _is_measurement(v)
+        }
+        merged = {
+            n: v for n, (v, _p) in _recent_captured_metrics(root).items()
+        }
+        for n, vp in (rec.get("carried") or {}).items():
+            # ["value", "path"] pairs captured at the skip DECISION —
+            # counting them here pins the evidence window to that
+            # moment, so a 23.5h-old artifact can't age out during
+            # the 40-80 min the fresh metrics take to measure
+            v = vp[0] if isinstance(vp, (list, tuple)) and vp else None
+            if _is_measurement(v):
+                merged.setdefault(n, v)
+        merged.update(fresh)
+        ratios = _ratios_vs_baseline(merged, _load_baseline())
+        # the headline must be FRESH — main()'s skip-captured branch
+        # always re-measures sgemm as a canary, and the gate has to
+        # enforce that: a union where sgemm rides on a pre-change
+        # artifact would pass a same-day kernel regression whose
+        # fresh canary wedged or errored
+        if "sgemm_gflops" not in fresh:
+            missing.append(
+                "sgemm_gflops: FAILED (headline not measured by THIS "
+                "run; the union may not carry the canary)"
+            )
+        for name, _fn in BENCH_METRICS:
+            if merged.get(name) is None:
+                missing.append(
+                    f"{name}: FAILED (no value in any artifact <24h)"
+                )
+            elif name in ratios and ratios[name] < 1.0 - tolerance:
+                regressed.append(
+                    f"{name}: {ratios[name]:.3f}x of measured baseline"
+                )
+        if regressed or missing:
+            print(
+                "REGRESSION over persisted union (tolerance "
+                f"{tolerance:.0%}):"
+            )
+            for b in regressed + missing:
+                print("  " + b)
+            return 1 if regressed else 2
+        print(f"regression check OK over persisted union: {ratios}")
+        return 0
     if rec.get("value") is None:
         print("REGRESSION: headline value is null (bench did not run)")
-        return 1
-    bad = []
+        return 2
     for name, ratio in (rec.get("vs_measured") or {}).items():
         if ratio < 1.0 - tolerance:
-            bad.append(f"{name}: {ratio:.3f}x of measured baseline")
-    failed = [
-        name for name, v in (rec.get("details") or {}).items() if v is None
-    ]
-    for name in failed:
-        bad.append(f"{name}: FAILED (no value)")
-    if bad:
+            regressed.append(f"{name}: {ratio:.3f}x of measured baseline")
+    for name, v in (rec.get("details") or {}).items():
+        if v is None:
+            missing.append(f"{name}: FAILED (no value)")
+    if regressed or missing:
         print("REGRESSION vs BASELINE.json measured (tolerance "
               f"{tolerance:.0%}):")
-        for b in bad:
+        for b in regressed + missing:
             print("  " + b)
-        return 1
+        return 1 if regressed else 2
     print(f"regression check OK: {rec.get('vs_measured')}")
     return 0
 
@@ -556,7 +744,12 @@ def check_regression(json_line: str, tolerance: float = 0.15) -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--check-regression":
         # stdin: the JSON line a prior `python bench.py` run printed
-        sys.exit(check_regression(sys.stdin.read().strip()))
+        sys.exit(
+            check_regression(
+                sys.stdin.read().strip(),
+                union_persisted="--union-persisted" in sys.argv[2:],
+            )
+        )
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
         # child mode for main()'s per-metric subprocess isolation; the
         # SIGALRM guard stays as a soft second layer for pure-Python

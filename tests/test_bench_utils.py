@@ -74,17 +74,29 @@ def test_check_regression_gates_on_measured_baseline():
     # inside tolerance passes
     assert bench.check_regression(slow, tolerance=0.25) == 0
 
+    # coverage failures are rc 2 (retryable: nothing measured slow),
+    # distinct from rc 1 (deterministic regression) — the watcher's
+    # retry loop keys on this split
     nulled = json.dumps({"value": None, "vs_measured": {}, "details": {}})
-    assert bench.check_regression(nulled) == 1
+    assert bench.check_regression(nulled) == 2
 
     # a metric that errored out (details value None) must fail even if
-    # every surviving ratio is healthy
+    # every surviving ratio is healthy — but as retryable coverage
     partial = json.dumps({
         "value": 60000,
         "vs_measured": {"sgemm_gflops": 1.0},
         "details": {"sgemm_gflops": 60000, "nbody_ginter_s": None},
     })
-    assert bench.check_regression(partial) == 1
+    assert bench.check_regression(partial) == 2
+
+    # regression + missing together -> 1 (the regression is the more
+    # actionable fact; retrying won't fix it)
+    both = json.dumps({
+        "value": 48000,
+        "vs_measured": {"sgemm_gflops": 0.79},
+        "details": {"sgemm_gflops": 48000, "nbody_ginter_s": None},
+    })
+    assert bench.check_regression(both) == 1
 
 
 def test_baseline_measured_block_covers_all_bench_metrics():
@@ -142,8 +154,11 @@ def test_check_regression_cli():
     assert ok.returncode == 0, ok.stdout + ok.stderr
     bad = run(json.dumps({"value": None, "vs_measured": {},
                           "details": {}}))
-    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert bad.returncode == 2, bad.stdout + bad.stderr  # retryable
     assert "REGRESSION" in bad.stdout
+    slow = run(json.dumps({"value": 1.0, "vs_measured": {"m": 0.5},
+                           "details": {"m": 1.0}}))
+    assert slow.returncode == 1, slow.stdout + slow.stderr  # deterministic
 
 
 def test_one_metric_child_protocol():
@@ -274,3 +289,185 @@ def test_unreachable_line_points_at_persisted_artifact(monkeypatch, capsys):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["value"] is None
     assert rec["details"]["last_persisted_artifact"] == sentinel
+
+
+def _write_artifact(logs, stamp, details, value=None):
+    import json
+
+    rec = {"metric": "sgemm_gflops_per_chip", "value": value,
+           "details": details}
+    (logs / f"bench_{stamp}.json").write_text(json.dumps(rec))
+
+
+def test_recent_captured_metrics_unions_newest_wins(tmp_path):
+    """The flap-cycle accumulator: non-null details union across
+    artifacts <24h old (by FILENAME timestamp), newest value winning
+    per metric; stale and future-stamped files are excluded."""
+    import datetime
+
+    logs = tmp_path / "docs" / "logs"
+    logs.mkdir(parents=True)
+    now = datetime.datetime.now()
+    fmt = "%Y-%m-%d_%H%M%S"
+    old = (now - datetime.timedelta(hours=30)).strftime(fmt)
+    recent1 = (now - datetime.timedelta(hours=3)).strftime(fmt)
+    recent2 = (now - datetime.timedelta(hours=1)).strftime(fmt)
+    future = (now + datetime.timedelta(hours=2)).strftime(fmt)
+    _write_artifact(logs, old, {"a": 1.0, "b": 1.0})       # too old
+    _write_artifact(logs, recent1, {"a": 2.0, "b": None, "c": 5.0})
+    _write_artifact(logs, recent2, {"a": 3.0})             # newest a
+    _write_artifact(logs, future, {"d": 9.0})              # clock skew
+    (logs / "bench_garbagename.json").write_text("{}")     # no stamp
+
+    got = bench._recent_captured_metrics(root=str(tmp_path))
+    assert {n: v for n, (v, _p) in got.items()} == {"a": 3.0, "c": 5.0}
+    # provenance points at the artifact each value came from
+    assert got["a"][1].endswith(f"bench_{recent2}.json")
+    assert got["c"][1].endswith(f"bench_{recent1}.json")
+
+
+def test_check_regression_union_persisted(tmp_path, monkeypatch):
+    """Watcher-mode gate: the union of persisted artifacts plus the
+    fresh line must cover every BENCH_METRICS name within tolerance —
+    evidence accumulated across flap windows passes together, a
+    missing or slow metric still fails."""
+    import datetime
+    import json
+
+    logs = tmp_path / "docs" / "logs"
+    logs.mkdir(parents=True)
+    measured = bench._load_baseline()["measured"]
+    names = [n for n, _ in bench.BENCH_METRICS]
+    assert names[0] == "sgemm_gflops"
+    stamp = (datetime.datetime.now()
+             - datetime.timedelta(hours=2)).strftime("%Y-%m-%d_%H%M%S")
+    # persisted artifact covers everything except the headline
+    _write_artifact(logs, stamp, {n: float(measured[n])
+                                  for n in names[1:]})
+    fresh_line = json.dumps({
+        "value": float(measured[names[0]]),
+        "details": {names[0]: float(measured[names[0]])},
+        "vs_measured": {},
+    })
+    assert bench.check_regression(
+        fresh_line, union_persisted=True, root=str(tmp_path)) == 0
+
+    # the headline must be fresh: a union where sgemm rides on a
+    # persisted artifact (this run measured only saxpy) must fail —
+    # as rc 2 (coverage): nothing measured slow, another window can
+    # supply the fresh canary
+    _write_artifact(logs, stamp, {n: float(measured[n]) for n in names})
+    carried_headline = json.dumps({
+        "value": None,
+        "details": {names[-1]: float(measured[names[-1]])},
+        "vs_measured": {},
+    })
+    assert bench.check_regression(
+        carried_headline, union_persisted=True, root=str(tmp_path)) == 2
+
+    # a >15% drop inside the union is rc 1 (deterministic) even when
+    # coverage is full
+    slow_line = json.dumps({
+        "value": 0.5 * float(measured[names[0]]),
+        "details": {names[0]: 0.5 * float(measured[names[0]])},
+        "vs_measured": {},
+    })
+    assert bench.check_regression(
+        slow_line, union_persisted=True, root=str(tmp_path)) == 1
+
+    # the carried block counts toward the union AT DECISION-TIME
+    # values: with no artifacts on disk at gate time, a line whose
+    # carried block covers the non-headline metrics still passes —
+    # evidence can't age out between the skip decision and the gate
+    for f in logs.iterdir():
+        f.unlink()
+    carried_line = json.dumps({
+        "value": float(measured[names[0]]),
+        "details": {names[0]: float(measured[names[0]])},
+        "vs_measured": {},
+        "carried": {n: [float(measured[n]), "docs/logs/gone.json"]
+                    for n in names[1:]},
+    })
+    assert bench.check_regression(
+        carried_line, union_persisted=True, root=str(tmp_path)) == 0
+
+
+def test_main_skip_captured_measures_only_missing(monkeypatch, capsys):
+    """TPK_BENCH_SKIP_CAPTURED=1: metrics with healthy persisted
+    evidence <24h old are not re-measured (short flap windows go to
+    missing ones); they appear under "carried" with provenance, NOT in
+    details. Two exceptions always re-measure: the sgemm headline (a
+    fresh canary each attempt, so same-day code changes can't ride
+    entirely on pre-change artifacts) and any carried value already
+    below tolerance (freezing a degraded number would fail every
+    retry on the metric it refuses to re-run)."""
+    import json
+
+    measured = bench._load_baseline()["measured"]
+    ran = []
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench, "_run_one_subprocess",
+        lambda name, t: (ran.append(name) or (1.0, "ok")))
+    monkeypatch.setattr(
+        bench, "_recent_captured_metrics",
+        lambda root=None: {
+            # healthy -> skipped
+            "stencil2d_mcells_s": (float(measured["stencil2d_mcells_s"]),
+                                   "docs/logs/x.json"),
+            # headline -> canary, re-measured despite healthy evidence
+            "sgemm_gflops": (float(measured["sgemm_gflops"]),
+                             "docs/logs/x.json"),
+            # below tolerance -> re-measured, not frozen
+            "nbody_ginter_s": (0.5 * float(measured["nbody_ginter_s"]),
+                               "docs/logs/x.json"),
+        })
+    monkeypatch.setenv("TPK_BENCH_SKIP_CAPTURED", "1")
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["carried"] == {
+        "stencil2d_mcells_s": [float(measured["stencil2d_mcells_s"]),
+                               "docs/logs/x.json"]}
+    assert set(ran) == {n for n, _ in bench.BENCH_METRICS} - {
+        "stencil2d_mcells_s"}
+    # details are fresh-only: carried metrics must not masquerade as
+    # this run's measurements
+    assert "stencil2d_mcells_s" not in rec["details"]
+    assert rec["details"]["sgemm_gflops"] == 1.0  # fresh canary value
+
+
+def test_persisted_artifact_ignores_error_lines(tmp_path):
+    """A tunnel-down run's null line (string-valued details: "error",
+    "last_persisted_artifact") gets persisted by the queue before the
+    gate aborts; it must count as evidence for NEITHER the pointer
+    path NOR the union — else each down-run points at an artifact
+    with no measurements and nests them recursively."""
+    import datetime
+    import json
+
+    logs = tmp_path / "docs" / "logs"
+    logs.mkdir(parents=True)
+    stamp = (datetime.datetime.now()
+             - datetime.timedelta(hours=1)).strftime("%Y-%m-%d_%H%M%S")
+    (logs / f"bench_{stamp}.json").write_text(json.dumps({
+        "metric": "sgemm_gflops_per_chip", "value": None,
+        "details": {"error": "TPU backend unreachable (tunnel down)",
+                    "last_persisted_artifact": {"path": "x"}},
+    }))
+    assert bench._latest_persisted_artifact(root=str(tmp_path)) is None
+    assert bench._recent_captured_metrics(root=str(tmp_path)) == {}
+
+
+def test_check_regression_refuses_carried_line_without_union():
+    """A skip-captured line (carried metrics absent from details) must
+    not slip through the single-run gate with only 1-2 fresh metrics
+    checked; it requires --union-persisted."""
+    import json
+
+    line = json.dumps({
+        "value": 60000.0,
+        "details": {"sgemm_gflops": 60000.0},
+        "vs_measured": {"sgemm_gflops": 1.0},
+        "carried": {"saxpy_gb_s": [9000.0, "docs/logs/x.json"]},
+    })
+    assert bench.check_regression(line) == 1

@@ -25,10 +25,20 @@ cd "$(dirname "$0")/.."
 #    watcher can fire the queue more than once across tunnel flaps)
 #    must not clobber an earlier good run's numbers with a worse or
 #    partial line.
+#    TPK_BENCH_SKIP_CAPTURED=1 (set by the watcher's retry loop)
+#    spends a short flap window only on metrics with no persisted
+#    evidence yet; the gate then judges the union of the last 24h of
+#    artifacts instead of this run alone.
+union_flag=""
+if [ "${TPK_BENCH_SKIP_CAPTURED:-}" = "1" ]; then
+  # same "= 1" test bench.py uses — any other value (e.g. an intended
+  # "0") must neither skip metrics nor weaken the gate to union mode
+  union_flag="--union-persisted"
+fi
 bench_out=$(timeout 5400 python bench.py)
 printf '%s\n' "$bench_out"
 printf '%s\n' "$bench_out" | tail -1 > "docs/logs/bench_$(date +%Y-%m-%d_%H%M%S).json"
-printf '%s\n' "$bench_out" | tail -1 | python bench.py --check-regression
+printf '%s\n' "$bench_out" | tail -1 | python bench.py --check-regression $union_flag
 
 # 2. C acceptance gate: serial/omp + real TPU rows + fake-device mesh
 make -C c -s
