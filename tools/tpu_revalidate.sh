@@ -15,6 +15,25 @@
 set -e -x -o pipefail
 cd "$(dirname "$0")/.."
 
+# Per-day step stamps: the watcher retries the whole queue on every
+# healthy probe, and with 2-25 minute flap windows an attempt that
+# redoes already-green steps may never REACH the later ones. A step
+# that completed today is skipped on retry (set -e means a failed
+# step never stamps). Same accepted tradeoff as the bench evidence
+# window: stamps are wall-clock-scoped, not git-aware — force a full
+# re-run after a same-day code change with TPK_REVALIDATE_FORCE=1.
+# The bench step is never stamped: its own skip-captured logic keeps
+# it cheap, and the sgemm canary + union gate must run every attempt.
+stamp_dir="docs/logs/.revalidate_stamps"
+mkdir -p "$stamp_dir"
+step_done() {
+  [ "${TPK_REVALIDATE_FORCE:-}" = "1" ] && return 1
+  [ -e "$stamp_dir/$1_$(date +%Y-%m-%d).done" ]
+}
+stamp() {
+  touch "$stamp_dir/$1_$(date +%Y-%m-%d).done"
+}
+
 # 1. Headline metrics (median-of-slopes; see bench.py docstring),
 #    then gate on the self-regression compare: any metric >15% below
 #    the BASELINE.json "measured" medians fails the queue loudly.
@@ -41,14 +60,21 @@ printf '%s\n' "$bench_out" | tail -1 > "docs/logs/bench_$(date +%Y-%m-%d_%H%M%S)
 printf '%s\n' "$bench_out" | tail -1 | python bench.py --check-regression $union_flag
 
 # 2. C acceptance gate: serial/omp + real TPU rows + fake-device mesh
-make -C c -s
-(cd c && timeout 900 env TPK_TEST_TPU=1 TPK_TEST_MESH=8 ./run_all.sh | tail -3)
+if ! step_done c_gate; then
+  make -C c -s
+  (cd c && timeout 900 env TPK_TEST_TPU=1 TPK_TEST_MESH=8 ./run_all.sh | tail -3)
+  stamp c_gate
+fi
 
 # 2b. C-path scan_histogram throughput (docs/NEXT.md item 2): the
 #     combined one-dispatch adapter halved per-rep dispatch cost;
 #     record this Melem/s in docs/PERF.md next to the kernel-level
 #     number.
-(cd c && timeout 600 ./bin/scan_histogram --device=tpu --n=4194304 --check)
+if ! step_done c_scan_timing; then
+  make -C c -s
+  (cd c && timeout 600 ./bin/scan_histogram --device=tpu --n=4194304 --check)
+  stamp c_scan_timing
+fi
 
 # 2c. Profiler evidence for the roofline claims (VERDICT r3 item 5):
 #     XProf traces of the two headline kernels, summarized into
@@ -56,20 +82,30 @@ make -C c -s
 #     lift the busy %/top-op numbers into docs/PERF.md. Evidence
 #     capture, not a correctness gate: a profiling-only failure (tf
 #     schema drift, empty trace) must not abort a queue whose real
-#     gates all passed, so it is warn-only.
-bash tools/profile_headline.sh || echo "WARN: profile capture failed (non-gating)"
+#     gates all passed, so it is warn-only (and only stamped on
+#     success, so a flap mid-capture retries next window).
+if ! step_done profile; then
+  if bash tools/profile_headline.sh; then
+    stamp profile
+  else
+    echo "WARN: profile capture failed (non-gating)"
+  fi
+fi
 
 # 2d. Knob sanity: histogram impls agree, sgemm precisions hold their
 #     error contracts (exercised by the suite below too; these are
 #     quick re-confirms on the chip while the tunnel is warm)
-for impl in mxu vpu; do
-  timeout 600 env TPK_HIST_IMPL=$impl python -c "
+if ! step_done knob_sanity; then
+  for impl in mxu vpu; do
+    timeout 600 env TPK_HIST_IMPL=$impl python -c "
 from bench import bench_scan_hist
 print('scan_hist $impl:', round(bench_scan_hist(), 1))"
-done
-timeout 600 env TPK_SGEMM_PRECISION=float32 python -c "
+  done
+  timeout 600 env TPK_SGEMM_PRECISION=float32 python -c "
 from bench import bench_sgemm
 print('sgemm f32 (bf16_6x):', round(bench_sgemm(), 1))"
+  stamp knob_sanity
+fi
 
 # 3. Compiled-path test suite (axon backend, kernels compile on chip).
 # TPK_REQUIRE_TPU=1: a still-wedged tunnel must FAIL here, not slip
@@ -78,7 +114,10 @@ print('sgemm f32 (bf16_6x):', round(bench_sgemm(), 1))"
 # needed >1800 s of remote compiles; conftest now persists the
 # compilation cache, but the FIRST post-recovery run still compiles
 # whatever the bench steps above didn't.
-timeout 2700 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
+if ! step_done pytest_tpu; then
+  timeout 2700 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
+  stamp pytest_tpu
+fi
 
 # 4. Sanitizer gates (SURVEY.md §5): ASan then UBSan rebuilds, full
 #    gate incl. the embedded-CPython shim rows on a scrubbed CPU env
@@ -86,9 +125,12 @@ timeout 2700 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
 #    CPU-only — needs no tunnel; last on purpose.
 #    First recorded PASS logs: docs/logs/{asan,ubsan}_gate_2026-07-30.log.
 for san in asan ubsan; do
-  make -C c "$san"
-  (cd c && timeout 1800 env ASAN_OPTIONS=detect_leaks=0 \
-      PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_TEST_TPU=1 \
-      TPK_TEST_MESH=8 ./run_all.sh | tail -3)
+  if ! step_done "san_$san"; then
+    make -C c "$san"
+    (cd c && timeout 1800 env ASAN_OPTIONS=detect_leaks=0 \
+        PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_TEST_TPU=1 \
+        TPK_TEST_MESH=8 ./run_all.sh | tail -3)
+    stamp "san_$san"
+    make -C c -s clean && make -C c -s
+  fi
 done
-make -C c -s clean && make -C c -s
