@@ -65,3 +65,26 @@ def test_sgemm_beta_zero_ignores_c_nans(rng):
     # the same, so parity means NaN propagates. Check against reference.
     ref = sgemm_reference(1.0, a, b, 0.0, c)
     assert np.isnan(np.asarray(out)).all() == np.isnan(np.asarray(ref)).all()
+
+
+def test_tile_preference_knobs(rng, monkeypatch):
+    """TPK_SGEMM_{BM,BN,BK} override the tile PREFERENCES handed to
+    _pick_block (for tools/sgemm_tune.py sweeps): results must stay
+    correct under any knob value, alignment stays with the picker,
+    and garbage fails loudly like every other TPK_* knob."""
+    m, n, k = 96, 160, 130
+    a = rng.standard_normal((m, k), dtype=np.float32)
+    b = rng.standard_normal((k, n), dtype=np.float32)
+    c = rng.standard_normal((m, n), dtype=np.float32)
+    want = sgemm_reference(1.5, a, b, -0.5, c)
+
+    monkeypatch.setenv("TPK_SGEMM_BM", "32")
+    monkeypatch.setenv("TPK_SGEMM_BN", "128")
+    monkeypatch.setenv("TPK_SGEMM_BK", "128")
+    got = sgemm(1.5, a, b, -0.5, c)
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+
+    for bad in ("0", "-8", "abc"):
+        monkeypatch.setenv("TPK_SGEMM_BM", bad)
+        with pytest.raises(ValueError, match="TPK_SGEMM_BM"):
+            sgemm(1.0, a, b, 0.0, c)

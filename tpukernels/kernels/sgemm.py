@@ -38,6 +38,27 @@ from jax.experimental.pallas import tpu as pltpu
 from tpukernels.utils import cdiv, default_interpret
 
 
+def _env_pref(name: str, default: int) -> int:
+    """Tile-preference override (TPK_SGEMM_{BM,BN,BK}) for the on-chip
+    tuner (tools/sgemm_tune.py). Overrides the PREFERRED size handed
+    to _pick_block, not the raw block — alignment and padding safety
+    stay with the picker. Fail-loud on garbage, like every other TPK_*
+    knob. NOTE: larger bn/bk raise the double-buffered VMEM need past
+    the 32 MiB budget documented in _sgemm_padded; an infeasible
+    combo fails at (remote) compile time, which the tuner reports as
+    a FAIL row rather than a number."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        val = 0
+    if val <= 0:
+        raise ValueError(f"{name}={raw!r}: expected a positive integer")
+    return val
+
+
 def _pick_block(dim: int, preferred: int, align: int) -> int:
     """Aligned block size <= preferred balancing padding vs tile size.
 
@@ -210,9 +231,9 @@ def sgemm(
     # TFLOPS vs 52.7 with bn=1024); past 2048, B's double-buffered
     # hi+lo pair would blow the 32 MiB VMEM budget. Small bm keeps
     # A+C+acc in the remaining headroom.
-    bm = _pick_block(m, 256, 8)
-    bn = _pick_block(n, 2048, 128)
-    bk = _pick_block(k, 1024, 128)
+    bm = _pick_block(m, _env_pref("TPK_SGEMM_BM", 256), 8)
+    bn = _pick_block(n, _env_pref("TPK_SGEMM_BN", 2048), 128)
+    bk = _pick_block(k, _env_pref("TPK_SGEMM_BK", 1024), 128)
     pm, pn, pk = (cdiv(m, bm) * bm, cdiv(n, bn) * bn, cdiv(k, bk) * bk)
     if (pm, pk) != (m, k):
         a = jnp.pad(a, ((0, pm - m), (0, pk - k)))
