@@ -266,9 +266,26 @@ def _tpu_alive(timeout_s=180, attempts=6, retry_wait_s=120):
     in practice. A subprocess is killable from outside regardless.
     Patience is deliberately high (~30 min worst case): tunnel outages
     of 10+ minutes have been observed to recover, and the compilation
-    cache makes the bench itself cheap once the chip is back."""
+    cache makes the bench itself cheap once the chip is back.
+
+    TPK_BENCH_PROBE_ATTEMPTS caps the attempts: a watcher-fired queue
+    just probed the tunnel healthy moments ago, so a failing probe
+    HERE means it already re-wedged — burning the default ~30 min of
+    patience inside the queue would eat the next flap window from
+    under the watcher that is better placed to wait it out."""
     import subprocess
 
+    cap = os.environ.get("TPK_BENCH_PROBE_ATTEMPTS")
+    if cap is not None:
+        try:
+            attempts = int(cap)
+        except ValueError:
+            attempts = 0
+        if attempts <= 0:
+            raise ValueError(
+                f"TPK_BENCH_PROBE_ATTEMPTS={cap!r}: expected a positive "
+                "integer"
+            )
     for attempt in range(attempts):
         try:
             r = subprocess.run(

@@ -497,3 +497,36 @@ def test_main_points_wedge_nulls_at_prior_evidence(monkeypatch, capsys):
         "nbody_ginter_s": [192.0, "docs/logs/y.json"]}
     # measured metrics never get a prior_evidence entry
     assert "sgemm_gflops" not in rec["prior_evidence"]
+
+
+def test_probe_attempts_env_cap(monkeypatch):
+    """TPK_BENCH_PROBE_ATTEMPTS caps _tpu_alive's patience (the
+    watcher-fired queue sets 1: it just probed healthy, so a failure
+    here means re-wedged — don't burn ~30 min inside the queue).
+    Garbage fails loudly."""
+    calls = []
+
+    class FakeProc:
+        returncode = 1
+        stdout = ""
+
+    import subprocess
+
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **k: calls.append(1) or FakeProc())
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    monkeypatch.setenv("TPK_BENCH_PROBE_ATTEMPTS", "1")
+    assert bench._tpu_alive() is False
+    assert len(calls) == 1
+
+    monkeypatch.setenv("TPK_BENCH_PROBE_ATTEMPTS", "3")
+    calls.clear()
+    assert bench._tpu_alive() is False
+    assert len(calls) == 3
+
+    for bad in ("0", "-2", "abc"):
+        monkeypatch.setenv("TPK_BENCH_PROBE_ATTEMPTS", bad)
+        with pytest.raises(ValueError, match="TPK_BENCH_PROBE_ATTEMPTS"):
+            bench._tpu_alive()

@@ -71,7 +71,12 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     # the last 24h of artifacts (bench.py --union-persisted). The
     # flock fd is inherited by the child, so exclusion holds through
     # the queue.
-    env TPK_BENCH_SKIP_CAPTURED=1 bash tools/tpu_revalidate.sh
+    # PROBE_ATTEMPTS=1: we JUST probed healthy — if bench's own probe
+    # fails now the tunnel already re-wedged, and its default ~30 min
+    # of patience would burn the next flap window inside the queue
+    # instead of returning it to this loop.
+    env TPK_BENCH_SKIP_CAPTURED=1 TPK_BENCH_PROBE_ATTEMPTS=1 \
+        bash tools/tpu_revalidate.sh
     queue_rc=$?  # must be captured from the command itself, not an
                  # if/fi (whose status is 0 when no branch runs)
     if [ "$queue_rc" -eq 0 ]; then
