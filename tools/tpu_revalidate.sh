@@ -110,14 +110,34 @@ fi
 # 3. Compiled-path test suite (axon backend, kernels compile on chip).
 # TPK_REQUIRE_TPU=1: a still-wedged tunnel must FAIL here, not slip
 # into conftest's silent CPU fallback. Longest step — deliberately
-# after every metric capture. 2700 s: the 2026-07-31 cold-cache run
-# needed >1800 s of remote compiles; conftest now persists the
-# compilation cache, but the FIRST post-recovery run still compiles
-# whatever the bench steps above didn't.
-if ! step_done pytest_tpu; then
-  timeout 2700 env TPK_REQUIRE_TPU=1 python -m pytest tests/ -q | tail -2
-  stamp pytest_tpu
-fi
+# after every metric capture; the 2026-07-31 cold-cache run needed
+# >1800 s of remote compiles (conftest persists the compilation
+# cache, but the FIRST post-recovery run still compiles whatever the
+# bench steps above didn't). Run in stamped GROUPS, kernel files
+# first: pytest has no resume, and one 45-min monolith restarted from
+# scratch every retry may never fit inside a 2-25 min flap window —
+# groups let on-chip validation accrue across windows. Group borders
+# follow compile cost: each kernel file owns its kernel's variants;
+# "rest" is the capi/distributed/bench/host machinery, which mostly
+# spawns scrubbed-CPU subprocesses and reuses the kernels' cache.
+pytest_group() {  # $1 = group name, $2... = pytest file args
+  local grp="$1"; shift
+  if ! step_done "pytest_$grp"; then
+    timeout 1200 env TPK_REQUIRE_TPU=1 python -m pytest "$@" -q | tail -2
+    stamp "pytest_$grp"
+  fi
+}
+pytest_group vector_add tests/test_vector_add.py
+pytest_group sgemm      tests/test_sgemm.py
+pytest_group stencil    tests/test_stencil.py
+pytest_group scan_hist  tests/test_scan_histogram.py
+pytest_group nbody      tests/test_nbody.py
+pytest_group determinism tests/test_determinism.py tests/test_fuzz_shapes.py
+pytest_group rest tests/ \
+  --ignore=tests/test_vector_add.py --ignore=tests/test_sgemm.py \
+  --ignore=tests/test_stencil.py --ignore=tests/test_scan_histogram.py \
+  --ignore=tests/test_nbody.py --ignore=tests/test_determinism.py \
+  --ignore=tests/test_fuzz_shapes.py
 
 # 4. Sanitizer gates (SURVEY.md §5): ASan then UBSan rebuilds, full
 #    gate incl. the embedded-CPython shim rows on a scrubbed CPU env
