@@ -106,3 +106,30 @@ def test_fuzz_nbody(rng, n):
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-5
         )
+
+
+def test_fuzz_sgemm_tile_knobs(rng, monkeypatch):
+    """Random tile PREFERENCES (the tools/sgemm_tune.py surface) x
+    awkward shapes vs the f64 oracle: whatever TPK_SGEMM_{BM,BN,BK}
+    ask for, _pick_block's alignment/padding must keep results exact
+    (bf16_6x path, so tolerance is fp32-tight). Seeded and bounded
+    like the rest of the sweep."""
+    knob_rng = np.random.default_rng(7)
+    shapes = [(37, 129, 65), (128, 256, 130), (9, 1000, 17)]
+    for m, n, k in shapes:
+        a = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+        c = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+        want = 1.25 * (
+            np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+        ) - 0.5 * np.asarray(c, np.float64)
+        for _ in range(3):
+            monkeypatch.setenv(
+                "TPK_SGEMM_BM", str(int(knob_rng.integers(1, 512))))
+            monkeypatch.setenv(
+                "TPK_SGEMM_BN", str(int(knob_rng.integers(1, 2048))))
+            monkeypatch.setenv(
+                "TPK_SGEMM_BK", str(int(knob_rng.integers(1, 2048))))
+            out = np.asarray(sgemm(1.25, a, b, -0.5, c,
+                                   precision="float32"))
+            np.testing.assert_allclose(out, want, rtol=2e-5, atol=2e-5)

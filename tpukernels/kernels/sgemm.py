@@ -73,7 +73,10 @@ def _pick_block(dim: int, preferred: int, align: int) -> int:
     tiles; ties cost nothing."""
     if dim <= align:
         return dim
-    hi = min(preferred, cdiv(dim, align) * align)
+    # clamp below by one aligned block: a preference under `align`
+    # (e.g. TPK_SGEMM_BN=1 via the tuner knobs) must degrade to the
+    # smallest legal tile, not an empty candidate range
+    hi = max(align, min(preferred, cdiv(dim, align) * align))
     cands = range(align, hi + 1, align)
     padded = lambda b: cdiv(dim, b) * b  # noqa: E731
     pad_min = min(padded(b) for b in cands)
