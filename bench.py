@@ -147,11 +147,30 @@ def bench_sgemm(m=1024):
     return 2.0 * m**3 / t / 1e9
 
 
+def _device_normal(seed, shape):
+    """Standard-normal input generated ON DEVICE (jit'd jax.random).
+
+    The large-array benches used host RNG + jnp.asarray, which streams
+    the whole operand through the axon tunnel (stencil3d: 216 MB,
+    saxpy_stream: 512 MB). The flapping tunnel wedged mid-stencil3d in
+    two consecutive healthy windows (03:17 and 07:16 on 2026-07-31)
+    right at that H2D step, and a multi-hundred-MB transfer is also
+    minutes of setup wall-clock per metric. Device-side generation
+    makes operand setup a ~µs program launch; input VALUES don't
+    matter for slope timing (no golden check here), only shape/dtype.
+    """
+    # the key is a traced ARGUMENT, not a closed-over constant: x and
+    # y of the same shape share one executable (and one ~20-40 s
+    # remote compile on a cold cache) instead of one per seed
+    return jax.jit(
+        lambda k: jax.random.normal(k, shape, jnp.float32)
+    )(jax.random.PRNGKey(seed))
+
+
 def bench_stencil(n=4096):
     from tpukernels.kernels.stencil import jacobi2d
 
-    rng = np.random.default_rng(1)
-    x = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+    x = _device_normal(1, (n, n))
 
     def make(R):
         return jax.jit(lambda x: jnp.sum(jacobi2d(x, R))), (x,)
@@ -163,8 +182,7 @@ def bench_stencil(n=4096):
 def bench_stencil3d(n=384):
     from tpukernels.kernels.stencil import jacobi3d
 
-    rng = np.random.default_rng(6)
-    x = jnp.asarray(rng.standard_normal((n, n, n)), jnp.float32)
+    x = _device_normal(6, (n, n, n))
 
     def make(R):
         return jax.jit(lambda x: jnp.sum(jacobi3d(x, R))), (x,)
@@ -179,9 +197,8 @@ def bench_saxpy_stream(n=1 << 26):
     N=2^20 config of record."""
     from tpukernels.kernels.vector_add import saxpy
 
-    rng = np.random.default_rng(5)
-    x = jnp.asarray(rng.standard_normal(n), jnp.float32)
-    y = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    x = _device_normal(5, (n,))
+    y = _device_normal(50, (n,))
 
     def make(R):
         def f(x, y):
@@ -336,14 +353,21 @@ def _tpu_alive(timeout_s=180, attempts=6, retry_wait_s=120):
 # tests assert BASELINE.json's "measured" block covers it — a new
 # bench_* added here without a measured median fails the suite instead
 # of silently escaping the regression gate.
+#
+# ORDER = capture order under the flapping tunnel: headline canary
+# first (the gate requires it fresh), then cheapest-setup /
+# fastest-compiling metrics, so a 2-25 min healthy window banks the
+# most evidence before a wedge. stencil3d LAST: it wedged the tunnel
+# mid-metric in two consecutive windows (2026-07-31 03:17 and 07:16)
+# and must not eat the window from under the five metrics after it.
 BENCH_METRICS = (
     ("sgemm_gflops", bench_sgemm),
-    ("stencil2d_mcells_s", bench_stencil),
-    ("stencil3d_mcells_s", bench_stencil3d),
-    ("nbody_ginter_s", bench_nbody),
-    ("scan_hist_melem_s", bench_scan_hist),
     ("saxpy_gb_s", bench_saxpy),
+    ("scan_hist_melem_s", bench_scan_hist),
+    ("nbody_ginter_s", bench_nbody),
+    ("stencil2d_mcells_s", bench_stencil),
     ("saxpy_stream_gb_s", bench_saxpy_stream),
+    ("stencil3d_mcells_s", bench_stencil3d),
 )
 
 

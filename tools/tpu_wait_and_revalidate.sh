@@ -86,7 +86,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       # mid-sweep and that must not turn a PASSED queue into a
       # failure). Persisted to docs/logs for the session/driver to
       # commit.
-      python tools/sgemm_tune.py --quick 2>&1 \
+      python tools/sgemm_tune.py --quick 9>&- 2>&1 \
         | tee "docs/logs/sgemm_tune_$(date +%Y-%m-%d_%H%M%S).log" \
         9>&- || true
       exit 0
