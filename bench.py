@@ -91,6 +91,31 @@ def _slope(make_fn, r_small, r_big, samples=5):
     timings at both R values — cheap, compile is already done) and
     drop non-positive samples from stall-corrupted readings.
 
+    Drift cancellation (added 2026-07-31): the cancel-the-fixed-cost
+    argument assumes the fixed cost is STATIONARY across a sample. On
+    a freshly recovered tunnel it is not — latency drains downward
+    over the first minutes — and with R_small always timed before
+    R_big the drift subtracts from every sample's (t_big - t_small)
+    in the same direction, so the median inherits the bias instead of
+    rejecting it. Observed: post-recovery sgemm captures of 72.7 and
+    96.0 TFLOPS against a 61 TFLOPS physical ceiling for the 3-pass
+    bf16 kernel (184 TFLOPS measured single-pass peak / 3), while
+    stable-link sessions measure 60.8 at the ceiling.
+
+    Ordering tricks (palindrome windows) only cancel drift if the two
+    R values' measurement windows are the same length — they are not
+    (the big-R call is longer), and best-of-N min-picking pushes each
+    window's effective sample time to its END under monotone drift,
+    leaving a residual bias proportional to the window-length gap
+    with the SAME sign for either polarity. So each sample instead
+    times 8 single calls in an interleaved order (s,b,b,s,b,s,s,b),
+    records each call's wall-clock MIDPOINT, and least-squares fits
+        t = c0 + c1*midpoint + slope*R
+    — the time regressor absorbs any linear drift exactly, with no
+    symmetry assumptions about call durations. Jitter spikes enter
+    one fit at ~1/(4*(r_big-r_small)) weight and the median over
+    samples rejects the rest, as before.
+
     TPK_BENCH_SMOKE=1 collapses the repeat counts so every bench_*
     function can be exercised end-to-end on CPU tiny shapes (the
     returned "metric" is then meaningless) — the regression test that
@@ -107,15 +132,30 @@ def _slope(make_fn, r_small, r_big, samples=5):
         # both R variants built, compiled and executed — that is the
         # smoke coverage; timing µs-scale CPU runs would only flake
         return 1.0
+    calls = {r_small: (f_s, a_s), r_big: (f_b, a_b)}
+    octet = (r_small, r_big, r_big, r_small,
+             r_big, r_small, r_small, r_big)
     ests = []
     min_valid = min(3, samples)
     for attempt in range(3 * samples):
         if len(ests) >= samples:
             break
-        t_s = _timeit(f_s, *a_s, reps=3, warmup=0)
-        t_b = _timeit(f_b, *a_b, reps=3, warmup=0)
-        if t_b > t_s:
-            ests.append((t_b - t_s) / (r_big - r_small))
+        rows, durs = [], []
+        t_base = time.perf_counter()  # centered time regressor: raw
+        # perf_counter values are ~1e5 s and near-constant across the
+        # sample, which ill-conditions the fit against the intercept
+        for r in octet:
+            f, a = calls[r]
+            t0 = time.perf_counter()
+            np.asarray(f(*a))
+            t1 = time.perf_counter()
+            rows.append((1.0, (t0 + t1) / 2.0 - t_base, float(r)))
+            durs.append(t1 - t0)
+        coef, *_ = np.linalg.lstsq(
+            np.array(rows), np.array(durs), rcond=None
+        )
+        if coef[2] > 0:
+            ests.append(float(coef[2]))
     if len(ests) < min_valid:
         # a median of 1-2 surviving samples is just the single-slope
         # jitter problem again; refuse to report it as a median

@@ -52,6 +52,35 @@ def test_slope_cancels_fixed_cost():
     assert est == pytest.approx(per_iter, rel=0.3)
 
 
+def test_slope_cancels_linear_latency_drift():
+    # Post-recovery tunnel mode (2026-07-31): the fixed cost DRAINS
+    # linearly for minutes while the marginal signal is small — the
+    # regime where the old (s-then-b) sample order read sgemm 19-58%
+    # above its physical ceiling. Constants mirror that shape: the
+    # marginal signal is ~8 ms per R-delta against a fixed cost
+    # declining 20 ms/s, so the old ordering under-measured Δt by
+    # ~50% (reproduced 2026-07-31: 0.000515 for a true 0.001).
+    # per_iter=0.001 keeps big/small call durations asymmetric
+    # (~82 vs ~90 ms), which also broke palindrome-window schemes;
+    # the midpoint-regression estimator must recover PER_ITER with
+    # no symmetry assumptions.
+    per_iter = 0.001
+    t0 = time.monotonic()
+
+    def fixed_now():
+        return max(0.0, 0.08 - 0.02 * (time.monotonic() - t0))
+
+    def make_fn(r):
+        def fn():
+            time.sleep(fixed_now() + r * per_iter)
+            return np.zeros(1)
+
+        return fn, ()
+
+    est = bench._slope(make_fn, 2, 10, samples=3)
+    assert est == pytest.approx(per_iter, rel=0.3)
+
+
 def test_check_regression_gates_on_measured_baseline():
     """VERDICT r3 item 3: vs_baseline must be a real ratio against the
     BASELINE.json "measured" medians, and the revalidation queue must

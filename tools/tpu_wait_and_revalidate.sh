@@ -26,8 +26,17 @@ cd "$(dirname "$0")/.."
 : "${HOME:?tpu_wait: HOME unset - refusing a world-writable /tmp lock}"
 exec 9>"$HOME/.tpk_tpu_wait.lock"
 if ! flock -n 9; then
-  echo "tpu_wait: another watcher already holds the lock; exiting 3"
-  exit 3
+  # held — by a live watcher (hours) or by a child orphaned when a
+  # previous watcher died mid-queue/mid-sweep (bounded: the sweep's
+  # worst case is ~21 min). Wait long enough to outlive any orphan
+  # before concluding a live watcher owns it; exit 3 stays distinct
+  # so a chaining caller can tell "already covered" from "ran".
+  echo "tpu_wait: lock held (live watcher or orphaned child); waiting up to 30m"
+  if ! flock -w 1800 9; then
+    echo "tpu_wait: lock still held after 30m - a live watcher owns it; exiting 3"
+    exit 3
+  fi
+  echo "tpu_wait: lock acquired after wait (previous holder exited)"
 fi
 # transition guard: a watcher from a pre-relocation checkout may still
 # hold the LEGACY /tmp lock and would not contend with ours — warn so
@@ -86,9 +95,17 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       # mid-sweep and that must not turn a PASSED queue into a
       # failure). Persisted to docs/logs for the session/driver to
       # commit.
-      python tools/sgemm_tune.py --quick 9>&- 2>&1 \
+      # fd 9 (the machine-wide chip lock) is deliberately INHERITED
+      # here: if this watcher dies mid-sweep, the orphaned sweep is
+      # still running timed configs on the one chip, and a new
+      # watcher must not interleave its queue with it. The orphan's
+      # hold is bounded (~21 min worst case: 3 configs x 420 s), and
+      # the acquisition path above waits out a held lock rather than
+      # exiting immediately, so inheritance cannot dead-lock a
+      # replacement watcher.
+      python tools/sgemm_tune.py --quick 2>&1 \
         | tee "docs/logs/sgemm_tune_$(date +%Y-%m-%d_%H%M%S).log" \
-        9>&- || true
+        || true
       exit 0
     fi
     # wedge vs deterministic failure: if the tunnel still answers
