@@ -124,10 +124,19 @@ def _slope(make_fn, r_small, r_big, samples=5):
     smoke = os.environ.get("TPK_BENCH_SMOKE") == "1"
     if smoke:
         r_small, r_big = 1, 2
+    # stderr breadcrumbs bracket each phase so a tunnel wedge is
+    # attributable from the watch log. Operand generation/H2D — the
+    # prime wedge suspect for stencil3d — runs in the bench_* body
+    # BEFORE _slope is entered; the '--one <name> starting' line in
+    # __main__ opens that phase and this first line closes it.
+    print("# slope: entered (operands built)", file=sys.stderr, flush=True)
     f_s, a_s = make_fn(r_small)
     f_b, a_b = make_fn(r_big)
+    print(f"# slope: compiling R={r_small}", file=sys.stderr, flush=True)
     np.asarray(f_s(*a_s))  # compile + warm
+    print(f"# slope: compiling R={r_big}", file=sys.stderr, flush=True)
     np.asarray(f_b(*a_b))
+    print("# slope: timing", file=sys.stderr, flush=True)
     if smoke:
         # both R variants built, compiled and executed — that is the
         # smoke coverage; timing µs-scale CPU runs would only flake
@@ -870,6 +879,9 @@ if __name__ == "__main__":
                     file=sys.stderr,
                 )
                 sys.exit(2)
+        # opens the operand-setup phase for the wedge-attribution
+        # breadcrumbs (closed by _slope's 'entered' line)
+        print(f"# one: {sys.argv[2]} starting", file=sys.stderr, flush=True)
         print(json.dumps({"name": sys.argv[2],
                           "value": round(_with_timeout(fn), 2)}))
         sys.exit(0)

@@ -303,6 +303,37 @@ def test_latest_persisted_artifact_picks_newest_nonnull(tmp_path):
     assert bench._latest_persisted_artifact(root=str(tmp_path / "nope")) is None
 
 
+def test_invalidated_artifact_values_stay_dead(tmp_path):
+    """Invalidation convention (2026-07-31, the drift-inflated sgemm
+    captures): a superseded measurement is moved OUT of details/value
+    into an 'invalidated' key — [original_value, reason] — and nulled
+    where it stood. Both evidence scanners must treat such an
+    artifact by its nulls: the union accumulator must not count the
+    invalidated value and the unreachable-tunnel pointer must skip an
+    artifact with nothing valid left. No scanner may ever read values
+    back out of 'invalidated'."""
+    import datetime
+    import json
+
+    logs = tmp_path / "docs" / "logs"
+    logs.mkdir(parents=True)
+    stamp = datetime.datetime.now().strftime("bench_%Y-%m-%d_%H%M%S.json")
+    (logs / stamp).write_text(
+        json.dumps(
+            {
+                "metric": "sgemm_gflops_per_chip",
+                "value": None,
+                "details": {"sgemm_gflops": None},
+                "invalidated": {
+                    "sgemm_gflops": [95973.82, "drift-inflated"]
+                },
+            }
+        )
+    )
+    assert bench._recent_captured_metrics(root=str(tmp_path)) == {}
+    assert bench._latest_persisted_artifact(root=str(tmp_path)) is None
+
+
 def test_unreachable_line_points_at_persisted_artifact(monkeypatch, capsys):
     """When the tunnel is down at bench time, the null line carries a
     POINTER to the latest committed artifact — the headline itself
