@@ -1,0 +1,74 @@
+"""tools/promote_baseline.py — the deliberate promotion step that
+turns a captured <24h evidence union into new BASELINE.json medians.
+Guard rails matter more than the happy path: a partial or regressed
+promotion would quietly re-aim the self-regression gate."""
+
+import datetime
+import json
+
+import pytest
+
+import bench
+from tools import promote_baseline
+
+
+def _write_root(tmp_path, details, measured=None):
+    logs = tmp_path / "docs" / "logs"
+    logs.mkdir(parents=True)
+    stamp = datetime.datetime.now().strftime("bench_%Y-%m-%d_%H%M%S.json")
+    (logs / stamp).write_text(json.dumps({"details": details}))
+    base = {
+        "measured": {"measured_on": "2026-07-29", **(measured or {})},
+        "published": {},
+    }
+    (tmp_path / "BASELINE.json").write_text(json.dumps(base))
+    return tmp_path
+
+
+def _full_details(value=100.0):
+    return {name: value for name, _fn in bench.BENCH_METRICS}
+
+
+def test_promotes_full_union_and_stamps_date(tmp_path):
+    root = _write_root(
+        tmp_path, _full_details(123.456), measured={"sgemm_gflops": 120.0}
+    )
+    measured, lines = promote_baseline.promote(root=str(root))
+    on_disk = json.loads((root / "BASELINE.json").read_text())["measured"]
+    assert on_disk["sgemm_gflops"] == 123.46
+    assert on_disk["measured_on"] == datetime.date.today().isoformat()
+    assert all(
+        on_disk[n] == 123.46 for n, _fn in bench.BENCH_METRICS
+    )
+
+
+def test_refuses_partial_union_without_flag(tmp_path):
+    details = _full_details()
+    del details["stencil3d_mcells_s"]
+    root = _write_root(tmp_path, details)
+    with pytest.raises(SystemExit, match="stencil3d"):
+        promote_baseline.promote(root=str(root))
+    # with the flag: promotes what exists, keeps the hole's old value
+    measured, lines = promote_baseline.promote(
+        root=str(root), allow_partial=True
+    )
+    assert "stencil3d_mcells_s" not in measured or measured.get(
+        "stencil3d_mcells_s"
+    ) is None or isinstance(measured.get("stencil3d_mcells_s"), float)
+
+
+def test_refuses_regressed_promotion(tmp_path):
+    # captured 50% below the median of record: the gate should have
+    # caught this; promotion must refuse to lower the bar
+    root = _write_root(
+        tmp_path, _full_details(50.0), measured={"sgemm_gflops": 100.0}
+    )
+    with pytest.raises(SystemExit, match="regression"):
+        promote_baseline.promote(root=str(root))
+
+
+def test_dry_run_writes_nothing(tmp_path):
+    root = _write_root(tmp_path, _full_details(77.0))
+    before = (root / "BASELINE.json").read_text()
+    promote_baseline.promote(root=str(root), dry_run=True)
+    assert (root / "BASELINE.json").read_text() == before

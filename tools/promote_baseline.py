@@ -1,0 +1,120 @@
+"""Promote captured on-chip evidence into BASELINE.json's "measured"
+block (the self-regression gate's reference, bench.py vs_measured).
+
+Usage:
+    python tools/promote_baseline.py [--dry-run] [--allow-partial]
+
+Reads the <24h union of docs/logs/bench_*.json artifacts via the SAME
+scanner the union gate uses (bench._recent_captured_metrics — newest
+artifact wins per metric, invalidated/null values never count), then
+rewrites BASELINE.json "measured" and prints old->new lines for the
+BASELINE.md table update.
+
+Guard rails:
+  - refuses a partial union unless --allow-partial: promoting 3 of 7
+    metrics would leave the gate comparing fresh metrics against new
+    medians and stale metrics against old ones from DIFFERENT
+    sessions, hiding cross-session regressions;
+  - refuses to lower a median by more than the regression tolerance
+    (bench._REGRESSION_TOL): a capture that much below the median of
+    record should fail the gate and be investigated, not silently
+    become the new bar;
+  - never runs unattended in tools/tpu_revalidate.sh — promotion is
+    a deliberate act recorded in its own commit (BASELINE.json _note).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def promote(root=None, allow_partial=False, dry_run=False, today=None):
+    """Returns (new_measured, lines) or raises SystemExit with reason."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    union = {
+        name: value
+        for name, (value, _path) in bench._recent_captured_metrics(
+            root
+        ).items()
+    }
+    names = [n for n, _fn in bench.BENCH_METRICS]
+    missing = [n for n in names if n not in union]
+    if missing and not allow_partial:
+        raise SystemExit(
+            f"promote_baseline: union is missing {missing} — capture a "
+            "full set first, or pass --allow-partial to promote only "
+            "the captured metrics (mixed-session medians)"
+        )
+
+    path = os.path.join(root, "BASELINE.json")
+    with open(path) as f:
+        baseline = json.load(f)
+    measured = dict(baseline.get("measured") or {})
+
+    lines = []
+    for name in names:
+        if name not in union:
+            lines.append(f"  {name}: (not captured; keeping "
+                         f"{measured.get(name)})")
+            continue
+        old = measured.get(name)
+        new = round(float(union[name]), 2)
+        if (
+            isinstance(old, (int, float))
+            and old
+            and new < old * (1.0 - bench._REGRESSION_TOL)
+        ):
+            raise SystemExit(
+                f"promote_baseline: {name} captured {new} is more than "
+                f"{bench._REGRESSION_TOL:.0%} below the median of record "
+                f"{old} — that is a regression to investigate (the union "
+                "gate should have failed), not a new baseline"
+            )
+        measured[name] = new
+        lines.append(f"  {name}: {old} -> {new}")
+
+    if today is None:
+        import datetime
+
+        today = datetime.date.today().isoformat()
+    measured["measured_on"] = today
+    baseline["measured"] = measured
+    if not dry_run:
+        with open(path, "w") as f:
+            json.dump(baseline, f, indent=1)
+            f.write("\n")
+    return measured, lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the promotion without writing")
+    ap.add_argument("--allow-partial", action="store_true",
+                    help="promote an incomplete union (mixed-session "
+                         "medians; see module docstring)")
+    args = ap.parse_args(argv)
+    measured, lines = promote(
+        allow_partial=args.allow_partial, dry_run=args.dry_run
+    )
+    print("promote_baseline:"
+          + (" (dry run)" if args.dry_run else "")
+          + " measured medians"
+          f" (measured_on={measured['measured_on']}):")
+    for line in lines:
+        print(line)
+    if not args.dry_run:
+        print("BASELINE.json updated — now update the BASELINE.md table "
+              "rows to match and commit both.")
+
+
+if __name__ == "__main__":
+    main()
