@@ -248,7 +248,7 @@ def test_one_metric_child_refuses_cpu_fallback():
         env=env, capture_output=True, text=True, timeout=300, cwd=repo,
     )
     assert proc.returncode == 2, proc.stdout + proc.stderr
-    assert "refusing to measure" in proc.stderr
+    assert "refusing to run" in proc.stderr
     assert not proc.stdout.strip()  # no JSON line a parent could parse
 
 
@@ -557,6 +557,193 @@ def test_main_points_wedge_nulls_at_prior_evidence(monkeypatch, capsys):
         "nbody_ginter_s": [192.0, "docs/logs/y.json"]}
     # measured metrics never get a prior_evidence entry
     assert "sgemm_gflops" not in rec["prior_evidence"]
+
+
+def test_main_wedged_headline_emits_null_vs_baseline(monkeypatch, capsys):
+    """VERDICT r4 weak #4: a run whose sgemm child died used to emit
+    vs_baseline 1.0 — which a naive parser reads as "exactly on
+    baseline". A null headline must carry a null vs_baseline; the 1.0
+    placeholder survives only for a measured headline with no baseline
+    row to divide by."""
+    import json
+
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench, "_recent_captured_metrics", lambda root=None: {})
+    monkeypatch.setattr(
+        bench, "_run_one_subprocess",
+        lambda name, t: (None, "error") if name == "sgemm_gflops"
+        else (2.0, "ok"))
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert rec["vs_baseline"] is None
+    # every emitted line records which code produced it
+    assert isinstance(rec.get("git_head"), str) and rec["git_head"]
+
+
+def test_main_invalidates_capture_above_ceiling(monkeypatch, capsys):
+    """A fresh capture ABOVE its physical ceiling (BASELINE.json
+    "ceilings") is a measurement artifact — the 2026-07-31
+    drift-inflated sgemm readings — and must be nulled at the source
+    under the invalidation convention ([value, reason], scanners
+    ignore it) so no persisted artifact carries it into the union or
+    a baseline promotion."""
+    import json
+
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench, "_load_baseline",
+        lambda: {"measured": {"sgemm_gflops": 60000.0},
+                 "ceilings": {"sgemm_gflops": 61333.0}})
+    monkeypatch.setattr(
+        bench, "_recent_captured_metrics", lambda root=None: {})
+    monkeypatch.setattr(
+        bench, "_run_one_subprocess",
+        lambda name, t: (95973.82, "ok") if name == "sgemm_gflops"
+        else (1.0, "ok"))
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["details"]["sgemm_gflops"] is None
+    assert rec["value"] is None
+    assert rec["vs_baseline"] is None
+    assert rec["invalidated"]["sgemm_gflops"][0] == 95973.82
+    assert "ceiling" in rec["invalidated"]["sgemm_gflops"][1]
+    assert rec["details"]["nbody_ginter_s"] == 1.0  # others unaffected
+
+
+def test_device_normal_shares_one_executable_per_shape():
+    """ADVICE r4 (medium): a fresh jax.jit wrapper per call keys the
+    jit cache per WRAPPER, so same-shape operands (saxpy_stream's x
+    and y) each paid the ~20-40 s cold remote compile. The generator
+    must be cached per shape; only the PRNGKey varies."""
+    bench._normal_generator.cache_clear()
+    a = bench._device_normal(1, (8, 16))
+    b = bench._device_normal(2, (8, 16))
+    info = bench._normal_generator.cache_info()
+    assert (info.misses, info.hits) == (1, 1)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_metric_kernel_sources_cover_all_metrics():
+    """Every BENCH_METRICS name must map to its kernel sources for the
+    git-aware evidence cut-off — a metric without an entry would
+    silently get only the weaker bench.py-only epoch — and the mapped
+    paths must exist (a renamed kernel file would quietly disable the
+    filter for its metrics: git log on a missing path returns no
+    commits)."""
+    import os
+
+    repo = os.path.dirname(os.path.abspath(bench.__file__))
+    for name, _fn in bench.BENCH_METRICS:
+        srcs = bench._METRIC_KERNEL_SOURCES.get(name)
+        assert srcs, name
+        for s in srcs:
+            assert os.path.exists(os.path.join(repo, s)), s
+
+
+def test_union_rejects_evidence_predating_kernel_commit(tmp_path):
+    """VERDICT r4 weak #5: the evidence window must be git-aware, not
+    just wall-clock. An artifact stamped BEFORE the last commit
+    touching a metric's kernel sources (or bench.py) was measured on
+    pre-change code and must not satisfy the union for THAT metric;
+    metrics whose sources were untouched keep their evidence, and
+    evidence captured after the commit is accepted again."""
+    import datetime
+    import os
+    import subprocess
+
+    def git(*args, date=None):
+        env = dict(os.environ)
+        env["GIT_CONFIG_GLOBAL"] = "/dev/null"
+        env["GIT_CONFIG_SYSTEM"] = "/dev/null"
+        if date:
+            env["GIT_COMMITTER_DATE"] = date
+            env["GIT_AUTHOR_DATE"] = date
+        subprocess.run(
+            ["git", "-C", str(tmp_path), *args],
+            check=True, capture_output=True, env=env)
+
+    now = datetime.datetime.now()
+
+    def iso(hours_ago):
+        return (now - datetime.timedelta(hours=hours_ago)).strftime(
+            "%Y-%m-%dT%H:%M:%S")
+
+    git("init", "-q")
+    git("config", "user.email", "t@test")
+    git("config", "user.name", "t")
+    kdir = tmp_path / "tpukernels" / "kernels"
+    kdir.mkdir(parents=True)
+    (kdir / "sgemm.py").write_text("x = 1\n")
+    (kdir / "nbody.py").write_text("x = 1\n")
+    (tmp_path / "bench.py").write_text("y = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "base", date=iso(48))
+    (kdir / "sgemm.py").write_text("x = 2\n")
+    git("add", "-A")
+    git("commit", "-qm", "touch sgemm kernel", date=iso(1))
+
+    logs = tmp_path / "docs" / "logs"
+    logs.mkdir(parents=True)
+    fmt = "%Y-%m-%d_%H%M%S"
+    stamp_between = (now - datetime.timedelta(hours=2)).strftime(fmt)
+    _write_artifact(logs, stamp_between,
+                    {"sgemm_gflops": 100.0, "nbody_ginter_s": 50.0})
+    got = bench._recent_captured_metrics(root=str(tmp_path))
+    assert "sgemm_gflops" not in got          # predates the kernel commit
+    assert got["nbody_ginter_s"][0] == 50.0   # untouched kernel: kept
+
+    stamp_after = now.strftime(fmt)
+    _write_artifact(logs, stamp_after, {"sgemm_gflops": 101.0})
+    got = bench._recent_captured_metrics(root=str(tmp_path))
+    assert got["sgemm_gflops"][0] == 101.0
+
+
+def test_bare_prewarm_or_one_errors_instead_of_running_main():
+    """`bench.py --prewarm` / `--one` without a metric name must exit
+    with a usage error — not fall through to main() and run the full
+    seven-metric suite (holding the chip for the whole deadline and,
+    for --prewarm, emitting the JSON line the mode promises never to
+    produce). Unknown metric names get the same refusal."""
+    import os
+    import subprocess
+    import sys
+
+    from test_distributed import _scrubbed_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _scrubbed_env(fake_devices=None)
+    for args in (["--prewarm"], ["--one"], ["--prewarm", "nope"]):
+        proc = subprocess.run(
+            [sys.executable, "bench.py"] + args,
+            env=env, capture_output=True, text=True, timeout=300,
+            cwd=repo)
+        assert proc.returncode == 2, (args, proc.stdout, proc.stderr)
+        assert "usage:" in proc.stderr
+        assert not proc.stdout.strip()
+
+
+def test_prewarm_emits_no_stdout_json():
+    """`bench.py --prewarm <name>` (the revalidation queue's stencil3d
+    compile-cache warmer) compiles and runs both R variants but must
+    emit NO stdout line — nothing a scanner or parser could mistake
+    for a measurement — and must say so on stderr."""
+    import os
+    import subprocess
+    import sys
+
+    from test_distributed import _scrubbed_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _scrubbed_env(fake_devices=None)  # CPU, never the tunnel
+    env["TPK_BENCH_SMOKE"] = "1"  # collapse R so CPU finishes fast
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--prewarm", "saxpy_gb_s"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=repo)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert not proc.stdout.strip()
+    assert "prewarm complete" in proc.stderr
 
 
 def test_probe_attempts_env_cap(monkeypatch):

@@ -12,7 +12,7 @@ import bench
 from tools import promote_baseline
 
 
-def _write_root(tmp_path, details, measured=None):
+def _write_root(tmp_path, details, measured=None, ceilings=None):
     logs = tmp_path / "docs" / "logs"
     logs.mkdir(parents=True)
     stamp = datetime.datetime.now().strftime("bench_%Y-%m-%d_%H%M%S.json")
@@ -21,6 +21,8 @@ def _write_root(tmp_path, details, measured=None):
         "measured": {"measured_on": "2026-07-29", **(measured or {})},
         "published": {},
     }
+    if ceilings:
+        base["ceilings"] = ceilings
     (tmp_path / "BASELINE.json").write_text(json.dumps(base))
     return tmp_path
 
@@ -45,16 +47,60 @@ def test_promotes_full_union_and_stamps_date(tmp_path):
 def test_refuses_partial_union_without_flag(tmp_path):
     details = _full_details()
     del details["stencil3d_mcells_s"]
-    root = _write_root(tmp_path, details)
+    root = _write_root(
+        tmp_path, details, measured={"stencil3d_mcells_s": 83564.0}
+    )
     with pytest.raises(SystemExit, match="stencil3d"):
         promote_baseline.promote(root=str(root))
     # with the flag: promotes what exists, keeps the hole's old value
+    # EXACTLY on disk, and records the kept metric's real provenance
+    # (ADVICE r4: allow-partial used to re-stamp kept values with
+    # measured_on=today, misrepresenting where they came from)
     measured, lines = promote_baseline.promote(
         root=str(root), allow_partial=True
     )
-    assert "stencil3d_mcells_s" not in measured or measured.get(
-        "stencil3d_mcells_s"
-    ) is None or isinstance(measured.get("stencil3d_mcells_s"), float)
+    on_disk = json.loads((root / "BASELINE.json").read_text())["measured"]
+    assert on_disk["stencil3d_mcells_s"] == 83564.0
+    assert on_disk["sgemm_gflops"] == 100.0
+    assert "stencil3d_mcells_s" in on_disk["_not_remeasured"]
+    assert "2026-07-29" in on_disk["_not_remeasured"]
+
+
+def test_full_promotion_clears_partial_note(tmp_path):
+    root = _write_root(
+        tmp_path, _full_details(50.0),
+        measured={"_not_remeasured": "stale note from last time"},
+    )
+    promote_baseline.promote(root=str(root))
+    on_disk = json.loads((root / "BASELINE.json").read_text())["measured"]
+    assert "_not_remeasured" not in on_disk
+
+
+def test_refuses_implausible_jump_without_flag(tmp_path):
+    """ADVICE r4: the guard must be symmetric — a drift-inflated
+    capture promoted UPWARD silently raises the bar so honest future
+    captures fail the gate. A jump past _JUMP_TOL needs a human to
+    vouch a kernel change explains it."""
+    root = _write_root(
+        tmp_path, _full_details(130.0), measured={"sgemm_gflops": 100.0}
+    )
+    with pytest.raises(SystemExit, match="above the median"):
+        promote_baseline.promote(root=str(root))
+    measured, _ = promote_baseline.promote(root=str(root), allow_jump=True)
+    assert measured["sgemm_gflops"] == 130.0
+
+
+def test_refuses_promotion_above_ceiling_even_with_jump_flag(tmp_path):
+    """A capture above the physical ceiling is invalid evidence, full
+    stop — no flag may promote it (bench.py should have refused to
+    persist it in the first place)."""
+    root = _write_root(
+        tmp_path, _full_details(95973.82),
+        measured={"sgemm_gflops": 60834.0},
+        ceilings={"sgemm_gflops": 61333.0},
+    )
+    with pytest.raises(SystemExit, match="ceiling"):
+        promote_baseline.promote(root=str(root), allow_jump=True)
 
 
 def test_refuses_regressed_promotion(tmp_path):

@@ -14,11 +14,20 @@ Guard rails:
   - refuses a partial union unless --allow-partial: promoting 3 of 7
     metrics would leave the gate comparing fresh metrics against new
     medians and stale metrics against old ones from DIFFERENT
-    sessions, hiding cross-session regressions;
+    sessions, hiding cross-session regressions; an allow-partial
+    promotion records which metrics were NOT re-measured (and the
+    date their kept values are from) so BASELINE.json cannot
+    misrepresent their provenance;
   - refuses to lower a median by more than the regression tolerance
     (bench._REGRESSION_TOL): a capture that much below the median of
     record should fail the gate and be investigated, not silently
     become the new bar;
+  - symmetrically, refuses to RAISE a median past its physical
+    ceiling (BASELINE.json "ceilings") ever, or by more than
+    _JUMP_TOL without --allow-jump: the 2026-07-31 drift-inflated
+    sgemm captures (72.7/96.0 TFLOPS vs a ~61 ceiling) showed an
+    inflated capture silently raising the bar would make every honest
+    future capture fail the regression gate;
   - never runs unattended in tools/tpu_revalidate.sh — promotion is
     a deliberate act recorded in its own commit (BASELINE.json _note).
 """
@@ -34,8 +43,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402
 
+# A median may rise this much without --allow-jump; more is treated as
+# a suspect measurement (drift, estimator bug) until a human vouches a
+# kernel change explains it. Ceilings are refused unconditionally.
+_JUMP_TOL = 0.25
 
-def promote(root=None, allow_partial=False, dry_run=False, today=None):
+
+def promote(
+    root=None,
+    allow_partial=False,
+    dry_run=False,
+    today=None,
+    allow_jump=False,
+):
     """Returns (new_measured, lines) or raises SystemExit with reason."""
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -58,12 +78,14 @@ def promote(root=None, allow_partial=False, dry_run=False, today=None):
     with open(path) as f:
         baseline = json.load(f)
     measured = dict(baseline.get("measured") or {})
+    ceilings = baseline.get("ceilings") or {}
+    prev_on = measured.get("measured_on")
 
     lines = []
     for name in names:
         if name not in union:
             lines.append(f"  {name}: (not captured; keeping "
-                         f"{measured.get(name)})")
+                         f"{measured.get(name)} from {prev_on})")
             continue
         old = measured.get(name)
         new = round(float(union[name]), 2)
@@ -78,6 +100,29 @@ def promote(root=None, allow_partial=False, dry_run=False, today=None):
                 f"{old} — that is a regression to investigate (the union "
                 "gate should have failed), not a new baseline"
             )
+        if bench._is_measurement(ceilings.get(name)) and new > ceilings[name]:
+            raise SystemExit(
+                f"promote_baseline: {name} captured {new} exceeds its "
+                f"physical ceiling {ceilings[name]} (BASELINE.json "
+                "ceilings) — a drift-inflated measurement must be "
+                "invalidated, never promoted (bench.py should already "
+                "have refused to persist it)"
+            )
+        if (
+            isinstance(old, (int, float))
+            and old
+            and new > old * (1.0 + _JUMP_TOL)
+            and not allow_jump
+        ):
+            raise SystemExit(
+                f"promote_baseline: {name} captured {new} is more than "
+                f"{_JUMP_TOL:.0%} above the median of record {old} — a "
+                "jump that size is more often a measurement artifact "
+                "(the 2026-07-31 drift-inflated sgemm captures) than a "
+                "real speedup, and promoting it would make honest "
+                "future captures fail the gate; re-capture, or pass "
+                "--allow-jump if a kernel change explains it"
+            )
         measured[name] = new
         lines.append(f"  {name}: {old} -> {new}")
 
@@ -86,6 +131,17 @@ def promote(root=None, allow_partial=False, dry_run=False, today=None):
 
         today = datetime.date.today().isoformat()
     measured["measured_on"] = today
+    # provenance: measured_on now stamps only the re-measured metrics;
+    # values an allow-partial promotion KEPT must say where they are
+    # really from, or the date misrepresents a mixed-session table
+    kept = sorted(n for n in names if n not in union)
+    if kept:
+        measured["_not_remeasured"] = (
+            f"kept from {prev_on}, not re-measured on {today}: "
+            + ", ".join(kept)
+        )
+    else:
+        measured.pop("_not_remeasured", None)
     baseline["measured"] = measured
     if not dry_run:
         with open(path, "w") as f:
@@ -101,9 +157,15 @@ def main(argv=None):
     ap.add_argument("--allow-partial", action="store_true",
                     help="promote an incomplete union (mixed-session "
                          "medians; see module docstring)")
+    ap.add_argument("--allow-jump", action="store_true",
+                    help="permit a median to rise by more than "
+                         f"{_JUMP_TOL:.0%} (only when a kernel change "
+                         "explains it; ceilings are still enforced)")
     args = ap.parse_args(argv)
     measured, lines = promote(
-        allow_partial=args.allow_partial, dry_run=args.dry_run
+        allow_partial=args.allow_partial,
+        dry_run=args.dry_run,
+        allow_jump=args.allow_jump,
     )
     print("promote_baseline:"
           + (" (dry run)" if args.dry_run else "")

@@ -34,6 +34,28 @@ stamp() {
   touch "$stamp_dir/$1_$(date +%Y-%m-%d).done"
 }
 
+# 0. Pre-warm stencil3d's two R-variant compiles into the persistent
+#    cache in a throwaway killable subprocess (VERDICT r4: the tunnel
+#    wedged mid-stencil3d in two consecutive windows, and whether the
+#    trigger is the compile or the execute phase was never pinned).
+#    Non-gating and attempted ONCE per day: the attempt stamp lands
+#    BEFORE the run, so a wedge here cannot re-eat every subsequent
+#    flap window — the next attempt goes straight to bench, which
+#    orders stencil3d last anyway. Either way the stderr breadcrumb
+#    log (slope phases + jacobi3d slab geometry) is the postmortem
+#    evidence: the last line before a wedge names the phase.
+if ! step_done prewarm3d_attempt; then
+  stamp prewarm3d_attempt
+  prewarm_log="docs/logs/prewarm3d_$(date +%Y-%m-%d_%H%M%S).log"
+  if timeout -k 10 900 python bench.py --prewarm stencil3d_mcells_s \
+      >"$prewarm_log" 2>&1; then
+    echo "prewarm stencil3d: OK (compiles cached)"
+  else
+    echo "WARN: stencil3d prewarm failed rc=$? (non-gating) -" \
+         "$prewarm_log is the postmortem evidence"
+  fi
+fi
+
 # 1. Headline metrics (median-of-slopes; see bench.py docstring),
 #    then gate on the self-regression compare: any metric >15% below
 #    the BASELINE.json "measured" medians fails the queue loudly.

@@ -33,7 +33,16 @@ if ! flock -n 9; then
   # so a chaining caller can tell "already covered" from "ran".
   echo "tpu_wait: lock held (live watcher or orphaned child); waiting up to 30m"
   if ! flock -w 1800 9; then
-    echo "tpu_wait: lock still held after 30m - a live watcher owns it; exiting 3"
+    # Most likely a LIVE watcher (hours-long hold) — but an orphaned
+    # tpu_revalidate.sh queue child also inherits fd 9 and can hold it
+    # past 30m (the queue's worst case is ~2h of stamped steps on a
+    # healthy chip; the sweep's is ~21m). Print the commands that
+    # distinguish the two so the operator can kill a true orphan
+    # instead of silently losing watch coverage.
+    echo "tpu_wait: lock still held after 30m; exiting 3. Distinguish the holder:"
+    echo "  pgrep -af tpu_wait_and_revalidate    # a LIVE watcher - leave it alone"
+    echo "  pgrep -af 'tpu_revalidate|bench.py|sgemm_tune'  # an ORPHANED queue/sweep -"
+    echo "  if only the second matches, kill those PIDs and re-run this script"
     exit 3
   fi
   echo "tpu_wait: lock acquired after wait (previous holder exited)"

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -393,6 +394,21 @@ def jacobi3d(
     # padding handle shallow d (bz <= d keeps pad waste < one block)
     bz = min(bz, d)
     blocked = d * h * wp * 4 > _SMALL_BYTES
+    if (
+        os.environ.get("TPK_STENCIL_LOG") == "1"
+        or os.environ.get("TPK_BENCH_PREWARM") == "1"
+    ):
+        # wedge-postmortem breadcrumb (VERDICT r4 weak #3): the chosen
+        # slab geometry, printed at trace time so it lands in the
+        # bench child's stderr log BEFORE any remote compile/execute
+        slab_mib = (bz + 2 * k) * hp8 * wp * 4 / 2**20 if blocked else 0.0
+        print(
+            f"# jacobi3d: d={d} h={h} w={w} blocked={blocked} bz={bz} "
+            f"k={k} slab=({bz + 2 * k},{hp8},{wp}) {slab_mib:.1f} MiB "
+            f"vmem_limit={_COMPILER_PARAMS.vmem_limit_bytes // 2**20} MiB",
+            file=sys.stderr,
+            flush=True,
+        )
     pads = [(0, 0), (0, 0), (0, wp - w)]
     if blocked:
         pads[0] = (k, k + cdiv(d, bz) * bz - d)
