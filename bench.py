@@ -21,7 +21,6 @@ from __future__ import annotations
 import functools
 import json
 import os
-import signal
 import statistics
 import sys
 import time
@@ -34,33 +33,47 @@ from tpukernels._cachedir import ensure_compilation_cache
 
 ensure_compilation_cache()
 
+# Resilience layer (stdlib-only, so safe before the jax import): the
+# three timeout mechanisms live in watchdog, fault injection in
+# faults, and every wedge/partial/invalidation decision is journaled
+# as a structured health event (docs/RESILIENCE.md).
+from tpukernels.resilience import faults, journal, watchdog
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-_BENCH_TIMEOUT_S = 600  # per-benchmark watchdog (tunnel can wedge)
+# per-benchmark watchdog (tunnel can wedge); env-tunable so the CPU
+# chaos suite (tests/test_resilience.py) can drive the REAL timeout ->
+# hard-kill -> reclassify path in seconds instead of 12 minutes
+_BENCH_TIMEOUT_S = int(os.environ.get("TPK_BENCH_TIMEOUT_S", "600"))
+# held back from each child's window for the post-timeout wedge probe
+# (90 s) + JSON emission; also the slack callers must add on top of
+# TPK_BENCH_DEADLINE_S
+_CHILD_GRACE_S = int(os.environ.get("TPK_BENCH_CHILD_GRACE_S", "120"))
+# minimum budget left before a metric is still worth starting; must
+# exceed the grace reserve or the child's computed window goes
+# negative (a child spawned and killed instantly reads as a wedge)
+_DEADLINE_FLOOR_S = max(180, _CHILD_GRACE_S + 60)
 _REGRESSION_TOL = 0.15  # shared by check_regression and skip-captured
+# A capture may exceed its physical ceiling by this much before being
+# invalidated: the sgemm ceiling (61333) sits only 0.8% above the
+# median of record (60834), so ordinary upward noise on an honest
+# near-peak capture would otherwise be thrown away. Drift inflation —
+# the failure mode ceilings exist for — measured 19-58% high, far
+# outside this band. Documented in BASELINE.md/BASELINE.json;
+# tools/promote_baseline.py applies the same epsilon.
+_CEILING_EPS = 0.01
 
-
-class _Timeout(Exception):
-    pass
+_Timeout = watchdog.Timeout  # back-compat alias (tests, callers)
 
 
 def _with_timeout(fn, seconds=_BENCH_TIMEOUT_S):
     """Run fn() under SIGALRM so a wedged TPU tunnel skips one metric
-    instead of hanging the whole round."""
-
-    def handler(signum, frame):
-        raise _Timeout(f"exceeded {seconds}s")
-
-    old = signal.signal(signal.SIGALRM, handler)
-    signal.alarm(seconds)
-    try:
-        return fn()
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
+    instead of hanging the whole round. Soft layer only — see
+    tpukernels/resilience/watchdog.py for the semantics."""
+    return watchdog.run_with_alarm(fn, seconds, site="bench._with_timeout")
 
 
 def _timeit(fn, *args, reps=4, warmup=2):
@@ -131,12 +144,14 @@ def _slope(make_fn, r_small, r_big, samples=5):
     # BEFORE _slope is entered; the '--one <name> starting' line in
     # __main__ opens that phase and this first line closes it.
     print("# slope: entered (operands built)", file=sys.stderr, flush=True)
+    faults.phase_fault("operand")  # no-op without a TPK_FAULT_PLAN
     f_s, a_s = make_fn(r_small)
     f_b, a_b = make_fn(r_big)
     print(f"# slope: compiling R={r_small}", file=sys.stderr, flush=True)
     np.asarray(f_s(*a_s))  # compile + warm
     print(f"# slope: compiling R={r_big}", file=sys.stderr, flush=True)
     np.asarray(f_b(*a_b))
+    faults.phase_fault("compile")
     if os.environ.get("TPK_BENCH_PREWARM") == "1":
         # --prewarm mode: both R variants are now in the persistent
         # compilation cache and have executed once; timing would only
@@ -146,6 +161,7 @@ def _slope(make_fn, r_small, r_big, samples=5):
               file=sys.stderr, flush=True)
         return float("inf")
     print("# slope: timing", file=sys.stderr, flush=True)
+    faults.phase_fault("execute")
     if smoke:
         # both R variants built, compiled and executed — that is the
         # smoke coverage; timing µs-scale CPU runs would only flake
@@ -366,7 +382,19 @@ def _tpu_alive(timeout_s=180, attempts=6, retry_wait_s=120):
                 f"TPK_BENCH_PROBE_ATTEMPTS={cap!r}: expected a positive "
                 "integer"
             )
-    for attempt in range(attempts):
+    wait = os.environ.get("TPK_BENCH_PROBE_WAIT_S")
+    if wait is not None:
+        # chaos tests compress the patience loop to seconds; operators
+        # can likewise tune the flap-recovery wait without code edits
+        retry_wait_s = float(wait)
+
+    def probe_once(attempt):
+        forced = faults.probe_outcome()  # None without a TPK_FAULT_PLAN
+        if forced is not None:
+            journal.emit(
+                "probe", attempt=attempt, outcome=forced, injected=True
+            )
+            return "alive" if forced == "ok" else "retry"
         try:
             r = subprocess.run(
                 [
@@ -379,37 +407,44 @@ def _tpu_alive(timeout_s=180, attempts=6, retry_wait_s=120):
                 capture_output=True,
                 text=True,
             )
-            # require a TPU-class backend: a CPU fallback would
-            # silently report CPU numbers as TPU GFLOPS
-            if r.returncode == 0 and (
-                "platform=tpu" in r.stdout or "platform=axon" in r.stdout
-            ):
-                return True
-            if (
-                r.returncode == 0
-                and "platform=" in r.stdout
-                and not os.environ.get("PALLAS_AXON_POOL_IPS")
-            ):
-                # clean non-TPU answer with no TPU configured on this
-                # box: waiting cannot conjure one — exit fast. When
-                # the pool var IS set, a clean CPU answer can be a
-                # fail-fast tunnel outage (jax falls back silently),
-                # which recovers — that case keeps the retry patience,
-                # like hangs and errors do.
-                print(
-                    "# no TPU backend (" + r.stdout.strip() + ")",
-                    file=sys.stderr,
-                )
-                return False
         except subprocess.TimeoutExpired:
-            pass
-        print(
-            f"# TPU liveness probe failed (attempt {attempt + 1}/{attempts})",
-            file=sys.stderr,
+            journal.emit("probe", attempt=attempt, outcome="hang")
+            return "retry"
+        # require a TPU-class backend: a CPU fallback would silently
+        # report CPU numbers as TPU GFLOPS
+        if r.returncode == 0 and (
+            "platform=tpu" in r.stdout or "platform=axon" in r.stdout
+        ):
+            journal.emit("probe", attempt=attempt, outcome="alive")
+            return "alive"
+        if (
+            r.returncode == 0
+            and "platform=" in r.stdout
+            and not os.environ.get("PALLAS_AXON_POOL_IPS")
+        ):
+            # clean non-TPU answer with no TPU configured on this
+            # box: waiting cannot conjure one — exit fast. When
+            # the pool var IS set, a clean CPU answer can be a
+            # fail-fast tunnel outage (jax falls back silently),
+            # which recovers — that case keeps the retry patience,
+            # like hangs and errors do.
+            print(
+                "# no TPU backend (" + r.stdout.strip() + ")",
+                file=sys.stderr,
+            )
+            journal.emit(
+                "probe", attempt=attempt, outcome="no_tpu_configured"
+            )
+            return "dead"
+        journal.emit(
+            "probe", attempt=attempt, outcome="error",
+            returncode=r.returncode,
         )
-        if attempt + 1 < attempts:
-            time.sleep(retry_wait_s)
-    return False
+        return "retry"
+
+    return watchdog.patient_probe(
+        probe_once, attempts, retry_wait_s, label="TPU liveness probe"
+    )
 
 
 # The full metric surface, single source of truth: main() runs it and
@@ -468,6 +503,22 @@ def _iter_bench_artifacts(root=None):
             yield p, rec
 
 
+def _artifact_stamp(relpath):
+    """Unix timestamp embedded in a bench artifact's FILENAME, or None
+    when the path doesn't carry one (the writer's stamp is the only
+    portable ordering — git does not preserve mtimes)."""
+    import datetime
+
+    if not isinstance(relpath, str):
+        return None
+    try:
+        return datetime.datetime.strptime(
+            os.path.basename(relpath), "bench_%Y-%m-%d_%H%M%S.json"
+        ).timestamp()
+    except ValueError:
+        return None
+
+
 def _latest_persisted_artifact(root=None):
     """Newest docs/logs/bench_*.json holding at least one real
     measurement, as {"path": ..., "line": {...}} — or None. Only
@@ -508,22 +559,10 @@ _METRIC_KERNEL_SOURCES = {
 
 def _git_head(root=None):
     """HEAD sha stamped into the emitted JSON line so every persisted
-    artifact records which code produced it; None outside a repo."""
-    import subprocess
-
-    if root is None:
-        root = os.path.dirname(os.path.abspath(__file__))
-    try:
-        r = subprocess.run(
-            ["git", "-C", root, "rev-parse", "HEAD"],
-            capture_output=True,
-            text=True,
-            timeout=30,
-        )
-    except Exception:
-        return None
-    sha = r.stdout.strip()
-    return sha if r.returncode == 0 and sha else None
+    artifact records which code produced it; None outside a repo.
+    Same resolver the health journal stamps events with, so artifacts
+    and journal lines from one session can be correlated."""
+    return journal.git_head(root)
 
 
 def _last_commit_ts(root, paths):
@@ -577,7 +616,8 @@ def _metric_evidence_epochs(root):
     return out
 
 
-def _recent_captured_metrics(root=None, max_age_h=24.0):
+def _recent_captured_metrics(root=None, max_age_h=24.0, rejected=None,
+                             epochs=None):
     """Union of measured per-metric values from docs/logs/bench_*.json
     artifacts whose FILENAME timestamp is within `max_age_h` of now
     (newest artifact wins per metric). Returns {metric: (value,
@@ -594,24 +634,31 @@ def _recent_captured_metrics(root=None, max_age_h=24.0):
     metric, artifacts stamped before the last commit touching that
     metric's kernel sources or bench.py are rejected (see
     _metric_evidence_epochs) — evidence predating a same-day kernel
-    change must be re-measured, not carried."""
-    import datetime
+    change must be re-measured, not carried.
 
+    Rejections are never silent (ADVICE r5): each one prints a stderr
+    note naming the metric, the artifact and the blocking commit
+    timestamp, emits an `epoch_rejected` journal event, and — when the
+    caller passes a `rejected` dict — is recorded there as
+    {metric: (artifact_relpath, blocking_commit_ts)} so
+    check_regression can distinguish "epoch-rejected" from "absent".
+
+    `epochs` lets the union gate pass its already-computed
+    _metric_evidence_epochs table in (it needs the same table for the
+    carried re-check) instead of forking git twice per gate run."""
     if root is None:
         root = os.path.dirname(os.path.abspath(__file__))
-    now = datetime.datetime.now()
-    epochs = _metric_evidence_epochs(root)
+    now_ts = time.time()
+    if epochs is None:
+        epochs = _metric_evidence_epochs(root)
     out = {}
     # _iter_bench_artifacts yields newest first; first writer wins =
     # newest value per metric
     for p, rec in _iter_bench_artifacts(root):
-        try:
-            stamp = datetime.datetime.strptime(
-                os.path.basename(p), "bench_%Y-%m-%d_%H%M%S.json"
-            )
-        except ValueError:
+        stamp_ts = _artifact_stamp(p)
+        if stamp_ts is None:
             continue
-        age_h = (now - stamp).total_seconds() / 3600.0
+        age_h = (now_ts - stamp_ts) / 3600.0
         if not (0 <= age_h <= max_age_h):
             # future-stamped files are clock skew/testing noise, not
             # evidence
@@ -620,9 +667,21 @@ def _recent_captured_metrics(root=None, max_age_h=24.0):
             if not (_is_measurement(value) and name not in out):
                 continue
             epoch = epochs.get(name)
-            if epoch is not None and stamp.timestamp() < epoch:
+            if epoch is not None and stamp_ts < epoch:
                 # measured on pre-change code: a commit touching this
                 # metric's kernel (or bench.py) postdates the artifact
+                rel = os.path.relpath(p, root)
+                print(
+                    f"# epoch-rejected: {name} from {rel} (artifact "
+                    f"predates commit ts {epoch} touching its sources)",
+                    file=sys.stderr,
+                )
+                journal.emit(
+                    "epoch_rejected", metric=name, artifact=rel,
+                    blocking_commit_ts=epoch,
+                )
+                if rejected is not None and name not in rejected:
+                    rejected[name] = (rel, epoch)
                 continue
             out[name] = (value, os.path.relpath(p, root))
     return out
@@ -640,14 +699,14 @@ def _run_one_subprocess(name: str, timeout_s: float):
     child's progress lines land in the caller's log."""
     import subprocess
 
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--one", name],
-            timeout=timeout_s,
-            stdout=subprocess.PIPE,
-            text=True,
-        )
-    except subprocess.TimeoutExpired:
+    r, status = watchdog.kill_after(
+        [sys.executable, os.path.abspath(__file__), "--one", name],
+        timeout_s,
+        site=f"bench --one {name}",
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    if status == "timeout":
         return None, "timeout"
     if r.returncode != 0:
         return None, "error"
@@ -665,7 +724,16 @@ def main():
     # can outlast the caller and get killed mid-run after all
     t0 = time.monotonic()
     results = {}
+    journal.emit(
+        "run_start", mode="suite",
+        deadline_s=float(os.environ.get("TPK_BENCH_DEADLINE_S", "4800")),
+        fault_plan_active=faults.active(),
+    )
     if not _tpu_alive():
+        journal.emit(
+            "run_end", outcome="unreachable",
+            reason="TPU backend unreachable (tunnel down)",
+        )
         details = {"error": "TPU backend unreachable (tunnel down)"}
         prior = _latest_persisted_artifact()
         if prior is not None:
@@ -709,11 +777,25 @@ def main():
     # and children are always reaped; metrics past the deadline report
     # None. Callers must allow > TPK_BENCH_DEADLINE_S end to end.
     deadline = t0 + float(os.environ.get("TPK_BENCH_DEADLINE_S", "4800"))
-    # 120 s of each child's window is held back for the post-timeout
-    # wedge probe (90 s) + JSON emission, so main() cannot overrun the
-    # deadline by more than that reserve. Callers' outer timeouts must
-    # still allow TPK_BENCH_DEADLINE_S plus ~2 min of margin.
+    # _CHILD_GRACE_S of each child's window is held back for the
+    # post-timeout wedge probe (90 s) + JSON emission, so main() cannot
+    # overrun the deadline by more than that reserve. Callers' outer
+    # timeouts must still allow TPK_BENCH_DEADLINE_S plus that margin.
     metrics = list(BENCH_METRICS)
+    only = os.environ.get("TPK_BENCH_ONLY")
+    if only:
+        # chaos-test / targeted-re-measure knob: run only the named
+        # metrics. The emitted line then has partial coverage, which
+        # the union gate reports as rc 2 — this never weakens a gate.
+        want = [n.strip() for n in only.split(",") if n.strip()]
+        unknown = [n for n in want if n not in dict(BENCH_METRICS)]
+        if unknown:
+            raise ValueError(
+                f"TPK_BENCH_ONLY names unknown metrics {unknown}; known: "
+                + ", ".join(n for n, _f in BENCH_METRICS)
+            )
+        metrics = [(n, f) for n, f in metrics if n in want]
+        journal.emit("metrics_restricted", only=want)
     carried = {}
     if os.environ.get("TPK_BENCH_SKIP_CAPTURED") == "1":
         # watcher-fired queues set this: a flap window too short for
@@ -748,6 +830,11 @@ def main():
                 f"measuring {[n for n, _ in metrics]}",
                 file=sys.stderr,
             )
+            journal.emit(
+                "skip_captured",
+                carried=sorted(carried),
+                measuring=[n for n, _f in metrics],
+            )
     wedged = False
     # Physical upper bounds (BASELINE.json "ceilings"): a capture
     # ABOVE its ceiling is a measurement artifact — the 2026-07-31
@@ -761,30 +848,48 @@ def main():
     invalidated = {}
     for name, _fn in metrics:
         remaining = deadline - time.monotonic()
-        if wedged or remaining < 180:
-            if not wedged and remaining < 180:
+        if wedged or remaining < _DEADLINE_FLOOR_S:
+            if not wedged and remaining < _DEADLINE_FLOOR_S:
                 print(
                     f"# whole-run deadline reached before {name} - "
                     "emitting partial results",
                     file=sys.stderr,
                 )
+                journal.emit(
+                    "deadline_reached", before_metric=name,
+                    remaining_s=round(remaining, 1),
+                )
                 wedged = True  # skip the rest, same as the wedge path
             results[name] = None
+            journal.emit(
+                "partial_result", metric=name,
+                reason="skipped (wedged or deadline)",
+            )
             continue
         value, status = _run_one_subprocess(
-            name, min(_BENCH_TIMEOUT_S + 120, remaining - 120)
+            name,
+            min(_BENCH_TIMEOUT_S + _CHILD_GRACE_S,
+                remaining - _CHILD_GRACE_S),
         )
         ceiling = ceilings.get(name)
         if (
             value is not None
             and _is_measurement(ceiling)
-            and value > ceiling
+            and value > ceiling * (1.0 + _CEILING_EPS)
         ):
+            # > ceiling*(1+eps) is drift, not noise; a capture INSIDE
+            # the epsilon band is kept (_CEILING_EPS rationale above).
+            # The raw value stays in the artifact under "invalidated".
             print(
                 f"# {name}: {value} exceeds the physical ceiling "
-                f"{ceiling} - invalidated as drift-suspect (see "
-                "BASELINE.md methodology)",
+                f"{ceiling} (+{_CEILING_EPS:.0%} tolerance) - "
+                "invalidated as drift-suspect (see BASELINE.md "
+                "methodology)",
                 file=sys.stderr,
+            )
+            journal.emit(
+                "invalidated", metric=name, value=value, ceiling=ceiling,
+                epsilon=_CEILING_EPS,
             )
             invalidated[name] = [value, f"exceeds ceiling {ceiling}"]
             value = None
@@ -793,13 +898,20 @@ def main():
             print(f"# {name}: {value}", file=sys.stderr)
         else:
             print(f"# {name} FAILED ({status})", file=sys.stderr)
+            journal.emit("metric_failed", metric=name, status=status)
         sys.stderr.flush()
-        if status == "timeout" and not _tpu_alive(timeout_s=90, attempts=1):
-            print(
-                "# tunnel wedged mid-bench - emitting partial results",
-                file=sys.stderr,
+        if status == "timeout":
+            # one quick liveness re-probe decides slow vs wedged; the
+            # semantics live in watchdog.classify_timeout
+            verdict = watchdog.classify_timeout(
+                _tpu_alive(timeout_s=90, attempts=1), metric=name
             )
-            wedged = True
+            if verdict == "wedged":
+                print(
+                    "# tunnel wedged mid-bench - emitting partial results",
+                    file=sys.stderr,
+                )
+                wedged = True
 
     headline = results.get("sgemm_gflops")
     ratios = _ratios_vs_baseline(results, _load_baseline())
@@ -839,6 +951,14 @@ def main():
         }
         if prior:
             line["prior_evidence"] = prior
+    journal.emit(
+        "run_end",
+        outcome="wedged_partial" if wedged else "complete",
+        measured=sorted(n for n, v in results.items() if v is not None),
+        failed=failed,
+        invalidated=sorted(invalidated),
+        carried=sorted(carried),
+    )
     print(json.dumps(line))
 
 
@@ -923,22 +1043,57 @@ def check_regression(
     regressed = []  # rc 1: measured and too slow
     missing = []    # rc 2: not measured at all
     if union_persisted:
+        gate_root = root or os.path.dirname(os.path.abspath(__file__))
         fresh = {
             n: v
             for n, v in (rec.get("details") or {}).items()
             if _is_measurement(v)
         }
+        rejected = {}  # metric -> (artifact, blocking_commit_ts)
+        epochs = _metric_evidence_epochs(gate_root)
         merged = {
-            n: v for n, (v, _p) in _recent_captured_metrics(root).items()
+            n: v
+            for n, (v, _p) in _recent_captured_metrics(
+                root, rejected=rejected, epochs=epochs
+            ).items()
         }
         for n, vp in (rec.get("carried") or {}).items():
             # ["value", "path"] pairs captured at the skip DECISION —
             # counting them here pins the evidence window to that
             # moment, so a 23.5h-old artifact can't age out during
-            # the 40-80 min the fresh metrics take to measure
+            # the 40-80 min the fresh metrics take to measure.
+            # The git-epoch filter is RE-APPLIED at gate time
+            # (ADVICE r5): a commit landing between the skip decision
+            # and the gate invalidates the carried artifact for that
+            # metric exactly as it would a persisted one — the window
+            # pin covers wall-clock aging only, never code changes.
             v = vp[0] if isinstance(vp, (list, tuple)) and vp else None
-            if _is_measurement(v):
-                merged.setdefault(n, v)
+            p = vp[1] if isinstance(vp, (list, tuple)) and len(vp) > 1 else None
+            if not _is_measurement(v):
+                continue
+            epoch = epochs.get(n)
+            stamp = _artifact_stamp(p)
+            if (
+                epoch is not None
+                and stamp is not None
+                and stamp < epoch
+            ):
+                # same "never silent" contract as the persisted-artifact
+                # filter: the gate decision must be reconstructable from
+                # stderr and the health journal
+                print(
+                    f"# epoch-rejected: {n} carried from {p} (artifact "
+                    f"predates commit ts {epoch} touching its sources)",
+                    file=sys.stderr,
+                )
+                journal.emit(
+                    "epoch_rejected", metric=n, artifact=p,
+                    blocking_commit_ts=epoch, carried=True,
+                )
+                if n not in rejected:
+                    rejected[n] = (p, epoch)
+                continue
+            merged.setdefault(n, v)
         merged.update(fresh)
         ratios = _ratios_vs_baseline(merged, _load_baseline())
         # the headline must be FRESH — main()'s skip-captured branch
@@ -953,9 +1108,20 @@ def check_regression(
             )
         for name, _fn in BENCH_METRICS:
             if merged.get(name) is None:
-                missing.append(
-                    f"{name}: FAILED (no value in any artifact <24h)"
-                )
+                if name in rejected:
+                    # distinguish "evidence exists but predates a code
+                    # change" from "never captured": the fix for the
+                    # first is re-measuring, not waiting for a window
+                    art, ts = rejected[name]
+                    missing.append(
+                        f"{name}: FAILED (epoch-rejected: {art} predates "
+                        f"commit ts {ts} touching its sources - "
+                        "re-measure on current code)"
+                    )
+                else:
+                    missing.append(
+                        f"{name}: FAILED (no value in any artifact <24h)"
+                    )
             elif name in ratios and ratios[name] < 1.0 - tolerance:
                 regressed.append(
                     f"{name}: {ratios[name]:.3f}x of measured baseline"
@@ -990,6 +1156,12 @@ def check_regression(
 
 
 if __name__ == "__main__":
+    # A bench CLI run journals health events by default (library
+    # imports stay silent — journaling keys off TPK_HEALTH_JOURNAL).
+    # setdefault into os.environ so --one/--prewarm children inherit
+    # the SAME file and a whole session lands in one journal; set
+    # TPK_HEALTH_JOURNAL=0 to disable.
+    os.environ.setdefault("TPK_HEALTH_JOURNAL", journal.default_path())
     if len(sys.argv) > 1 and sys.argv[1] == "--check-regression":
         # stdin: the JSON line a prior `python bench.py` run printed
         sys.exit(
@@ -1047,6 +1219,7 @@ if __name__ == "__main__":
         # execute phase (the postmortem VERDICT r4 weak #3 asked for).
         _refuse_cpu_fallback("--prewarm")
         os.environ["TPK_BENCH_PREWARM"] = "1"
+        faults.enter_metric(sys.argv[2])  # no-op without a fault plan
         fn = dict(BENCH_METRICS)[sys.argv[2]]
         print(f"# prewarm: {sys.argv[2]} starting", file=sys.stderr,
               flush=True)
@@ -1062,6 +1235,7 @@ if __name__ == "__main__":
         # parent records None ("error"); the parent's wedge probe only
         # covers the hang mode.
         _refuse_cpu_fallback("--one")
+        faults.enter_metric(sys.argv[2])  # no-op without a fault plan
         fn = dict(BENCH_METRICS)[sys.argv[2]]
         # opens the operand-setup phase for the wedge-attribution
         # breadcrumbs (closed by _slope's 'entered' line)

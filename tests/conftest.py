@@ -122,6 +122,25 @@ def pytest_configure(config):
         env,
     )
 
+# Route the resilience health journal (docs/RESILIENCE.md) to a
+# throwaway dir for the whole suite: bench.py CLI children default it
+# to docs/logs/health_<date>.jsonl, and test-spawned runs (which
+# inherit os.environ via _scrubbed_env) must not append test noise to
+# the repo's real health logs. Tests that assert journal contents
+# override this with their own tmp path.
+if "TPK_HEALTH_JOURNAL" not in os.environ:
+    import tempfile
+
+    # one fixed per-user dir, reused across runs (mkdtemp here would
+    # leak a fresh /tmp dir per pytest invocation)
+    _journal_dir = os.path.join(
+        tempfile.gettempdir(), f"tpk_health_test_{os.getuid()}"
+    )
+    os.makedirs(_journal_dir, exist_ok=True)
+    os.environ["TPK_HEALTH_JOURNAL"] = os.path.join(
+        _journal_dir, "health_suite.jsonl"
+    )
+
 # Persist compiled executables across suite runs (the shared knob —
 # tpukernels/_cachedir.py; `import tpukernels` is deliberately
 # jax-free, so this respects the env-before-jax-import rule below).

@@ -700,6 +700,208 @@ def test_union_rejects_evidence_predating_kernel_commit(tmp_path):
     assert got["sgemm_gflops"][0] == 101.0
 
 
+def _git_kernel_repo(tmp_path, touched_kernel, touch_hours_ago=1):
+    """A tmp git repo whose base commit is 48h old and where ONE
+    kernel file was touched `touch_hours_ago` ago — the shape the
+    git-aware evidence epoch keys on."""
+    import datetime
+    import os
+    import subprocess
+
+    def git(*args, date=None):
+        env = dict(os.environ)
+        env["GIT_CONFIG_GLOBAL"] = "/dev/null"
+        env["GIT_CONFIG_SYSTEM"] = "/dev/null"
+        if date:
+            env["GIT_COMMITTER_DATE"] = date
+            env["GIT_AUTHOR_DATE"] = date
+        subprocess.run(
+            ["git", "-C", str(tmp_path), *args],
+            check=True, capture_output=True, env=env)
+
+    now = datetime.datetime.now()
+
+    def iso(hours_ago):
+        return (now - datetime.timedelta(hours=hours_ago)).strftime(
+            "%Y-%m-%dT%H:%M:%S")
+
+    git("init", "-q")
+    git("config", "user.email", "t@test")
+    git("config", "user.name", "t")
+    kdir = tmp_path / "tpukernels" / "kernels"
+    kdir.mkdir(parents=True)
+    for f in ("sgemm.py", "nbody.py", "vector_add.py", "stencil.py",
+              "scan.py", "histogram.py"):
+        (kdir / f).write_text("x = 1\n")
+    (tmp_path / "bench.py").write_text("y = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "base", date=iso(48))
+    (kdir / touched_kernel).write_text("x = 2\n")
+    git("add", "-A")
+    git("commit", "-qm", f"touch {touched_kernel}",
+        date=iso(touch_hours_ago))
+    return now
+
+
+def test_epoch_rejection_is_never_silent(tmp_path, capsys):
+    """ADVICE r5: an artifact dropped by the git-epoch filter must
+    announce itself — stderr note naming metric, artifact and the
+    blocking commit ts, plus an entry in the caller's `rejected`
+    dict — instead of silently shrinking the evidence union."""
+    import datetime
+
+    now = _git_kernel_repo(tmp_path, "sgemm.py")
+    logs = tmp_path / "docs" / "logs"
+    logs.mkdir(parents=True)
+    stamp = (now - datetime.timedelta(hours=2)).strftime(
+        "%Y-%m-%d_%H%M%S")
+    _write_artifact(logs, stamp,
+                    {"sgemm_gflops": 100.0, "nbody_ginter_s": 50.0})
+    rejected = {}
+    got = bench._recent_captured_metrics(
+        root=str(tmp_path), rejected=rejected)
+    assert "sgemm_gflops" not in got
+    assert set(rejected) == {"sgemm_gflops"}
+    art, ts = rejected["sgemm_gflops"]
+    assert art.endswith(f"bench_{stamp}.json")
+    assert isinstance(ts, int)
+    err = capsys.readouterr().err
+    assert "epoch-rejected: sgemm_gflops" in err
+    assert f"bench_{stamp}.json" in err
+    assert str(ts) in err
+
+
+def test_union_gate_distinguishes_epoch_rejected_from_absent(tmp_path, capsys):
+    """check_regression's union-mode "no value" breadcrumb must say
+    WHY coverage is missing: "epoch-rejected" (re-measure on current
+    code) reads differently from "absent" (wait for a window)."""
+    import datetime
+    import json
+
+    now = _git_kernel_repo(tmp_path, "nbody.py")
+    logs = tmp_path / "docs" / "logs"
+    logs.mkdir(parents=True)
+    measured = bench._load_baseline()["measured"]
+    names = [n for n, _ in bench.BENCH_METRICS]
+    stamp = (now - datetime.timedelta(hours=2)).strftime(
+        "%Y-%m-%d_%H%M%S")
+    # persisted artifact covers nbody only — and predates its commit
+    _write_artifact(logs, stamp,
+                    {"nbody_ginter_s": float(measured["nbody_ginter_s"])})
+    fresh = {n: float(measured[n]) for n in names
+             if n != "nbody_ginter_s"}
+    line = json.dumps({
+        "value": fresh["sgemm_gflops"], "details": fresh,
+        "vs_measured": {},
+    })
+    assert bench.check_regression(
+        line, union_persisted=True, root=str(tmp_path)) == 2
+    out = capsys.readouterr().out
+    assert "nbody_ginter_s: FAILED (epoch-rejected:" in out
+    assert "re-measure" in out
+    # an absent metric (no artifact at all) keeps the plain message
+    for f in logs.iterdir():
+        f.unlink()
+    assert bench.check_regression(
+        line, union_persisted=True, root=str(tmp_path)) == 2
+    out = capsys.readouterr().out
+    assert "nbody_ginter_s: FAILED (no value in any artifact <24h)" in out
+
+
+def test_union_reapplies_epoch_filter_to_carried(tmp_path, capsys):
+    """ADVICE r5: carried entries pin the evidence WINDOW to the skip
+    decision, but must not pin the CODE epoch — a commit touching the
+    metric's kernel between the skip decision and the gate invalidates
+    the carried value exactly like a persisted artifact."""
+    import datetime
+    import json
+
+    now = _git_kernel_repo(tmp_path, "nbody.py")
+    measured = bench._load_baseline()["measured"]
+    names = [n for n, _ in bench.BENCH_METRICS]
+    fresh = {n: float(measured[n]) for n in names
+             if n != "nbody_ginter_s"}
+    old_stamp = (now - datetime.timedelta(hours=2)).strftime(
+        "%Y-%m-%d_%H%M%S")
+    line = json.dumps({
+        "value": fresh["sgemm_gflops"], "details": fresh,
+        "vs_measured": {},
+        "carried": {"nbody_ginter_s": [
+            float(measured["nbody_ginter_s"]),
+            f"docs/logs/bench_{old_stamp}.json"]},
+    })
+    assert bench.check_regression(
+        line, union_persisted=True, root=str(tmp_path)) == 2
+    assert "epoch-rejected" in capsys.readouterr().out
+
+    # carried evidence captured AFTER the commit is still honored
+    new_stamp = now.strftime("%Y-%m-%d_%H%M%S")
+    line = json.dumps({
+        "value": fresh["sgemm_gflops"], "details": fresh,
+        "vs_measured": {},
+        "carried": {"nbody_ginter_s": [
+            float(measured["nbody_ginter_s"]),
+            f"docs/logs/bench_{new_stamp}.json"]},
+    })
+    assert bench.check_regression(
+        line, union_persisted=True, root=str(tmp_path)) == 0
+
+
+def test_ceiling_epsilon_keeps_near_peak_captures(monkeypatch, capsys):
+    """The sgemm ceiling sits 0.8% above the median of record, so
+    ordinary upward noise used to invalidate genuine near-peak
+    captures. A value INSIDE ceiling*(1+_CEILING_EPS) must be kept;
+    only past the band is it drift."""
+    import json
+
+    inside = 61333.0 * 1.005   # noise on an honest near-peak capture
+    outside = 61333.0 * 1.02   # past the documented band: drift
+    for value, expect_kept in ((inside, True), (outside, False)):
+        monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: True)
+        monkeypatch.setattr(
+            bench, "_load_baseline",
+            lambda: {"measured": {"sgemm_gflops": 60834.0},
+                     "ceilings": {"sgemm_gflops": 61333.0}})
+        monkeypatch.setattr(
+            bench, "_recent_captured_metrics",
+            lambda root=None, rejected=None: {})
+        monkeypatch.setattr(
+            bench, "_run_one_subprocess",
+            lambda name, t, v=value: (v, "ok")
+            if name == "sgemm_gflops" else (1.0, "ok"))
+        bench.main()
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        if expect_kept:
+            assert rec["value"] == inside
+            assert "invalidated" not in rec
+        else:
+            assert rec["value"] is None
+            # the raw value survives in the artifact for forensics
+            assert rec["invalidated"]["sgemm_gflops"][0] == outside
+            assert "ceiling" in rec["invalidated"]["sgemm_gflops"][1]
+
+
+def test_bench_only_restricts_metrics(monkeypatch, capsys):
+    """TPK_BENCH_ONLY (chaos-test / targeted re-measure knob): only
+    the named metrics run; unknown names fail loudly."""
+    import json
+
+    ran = []
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench, "_run_one_subprocess",
+        lambda name, t: (ran.append(name) or (1.0, "ok")))
+    monkeypatch.setenv("TPK_BENCH_ONLY", "saxpy_gb_s")
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert ran == ["saxpy_gb_s"]
+    assert set(rec["details"]) == {"saxpy_gb_s"}
+
+    monkeypatch.setenv("TPK_BENCH_ONLY", "nope")
+    with pytest.raises(ValueError, match="TPK_BENCH_ONLY"):
+        bench.main()
+
+
 def test_bare_prewarm_or_one_errors_instead_of_running_main():
     """`bench.py --prewarm` / `--one` without a metric name must exit
     with a usage error — not fall through to main() and run the full

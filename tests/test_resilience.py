@@ -1,0 +1,430 @@
+"""CPU chaos suite for the resilience layer (docs/RESILIENCE.md).
+
+Every wedge-handling path in bench.py, registry and capi had only ever
+been exercised by REAL tunnel failures on a live chip. These tests
+drive each one deterministically through TPK_FAULT_PLAN
+(tpukernels/resilience/faults.py) on CPU, asserting the observable
+recovery behavior — partial results, skip decisions, surfaced causes,
+preserved retry patience — plus the health-journal record and the
+clean-path zero-overhead contract.
+
+The bench subprocess tests compress the watchdog windows via
+TPK_BENCH_TIMEOUT_S / TPK_BENCH_CHILD_GRACE_S / TPK_BENCH_PROBE_WAIT_S
+so the REAL timeout -> hard-kill -> reclassify machinery runs in
+seconds; nothing in the handling path itself is stubbed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from test_distributed import _scrubbed_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_env(journal_path, plan=None, **extra):
+    env = _scrubbed_env(fake_devices=None)  # CPU, never the tunnel
+    env["TPK_BENCH_SMOKE"] = "1"
+    env["TPK_HEALTH_JOURNAL"] = str(journal_path)
+    env.pop("TPK_FAULT_PLAN", None)
+    if plan is not None:
+        env["TPK_FAULT_PLAN"] = json.dumps(plan)
+    for k, v in extra.items():
+        env[k] = str(v)
+    return env
+
+
+def _run_bench(env, args=(), timeout=420):
+    return subprocess.run(
+        [sys.executable, "bench.py", *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO,
+    )
+
+
+def _events(journal_path, kind=None):
+    recs = [
+        json.loads(line)
+        for line in journal_path.read_text().splitlines()
+        if line.strip()
+    ]
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    return recs
+
+
+@pytest.fixture
+def fault_plan(monkeypatch):
+    """Set an in-process fault plan; always restores the no-plan state
+    (module-level _PLAN outlives monkeypatch's env restore)."""
+    from tpukernels.resilience import faults
+
+    def set_plan(plan):
+        monkeypatch.setenv("TPK_FAULT_PLAN", json.dumps(plan))
+        faults.reload_plan()
+        return faults
+
+    yield set_plan
+    monkeypatch.delenv("TPK_FAULT_PLAN", raising=False)
+    faults.reload_plan()
+
+
+# ---------------------------------------------------------------- #
+# fault plan 1: mid-metric wedge -> partial results, null headline  #
+# ---------------------------------------------------------------- #
+
+def test_wedge_mid_metric_emits_partial_results(tmp_path):
+    """The 2026-07-31 signature, now reproducible: the headline child
+    hangs C-level-style (immune to its own SIGALRM guard), the parent
+    hard-kills it, the re-probe says the tunnel is gone -> WEDGED ->
+    every remaining metric is skipped without burning a watchdog
+    window, and the emitted line is partial with vs_baseline null —
+    never 1.0. The whole story must also be reconstructable from the
+    health journal alone via tools/health_report.py."""
+    journal = tmp_path / "health.jsonl"
+    # phase "operand": the wedge fires before any kernel compile, so
+    # the test is independent of which kernels this box's jax version
+    # can still compile (the wedge-HANDLING path is what's under test)
+    plan = {
+        "probe": ["ok", "dead"],
+        "wedge_metric": {"metric": "sgemm_gflops", "phase": "operand"},
+    }
+    proc = _run_bench(
+        _bench_env(journal, plan,
+                   TPK_BENCH_TIMEOUT_S=15, TPK_BENCH_CHILD_GRACE_S=5)
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "wedged mid-bench" in proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert rec["vs_baseline"] is None
+    import bench
+
+    assert set(rec["details"]) == {n for n, _f in bench.BENCH_METRICS}
+    assert all(v is None for v in rec["details"].values())
+
+    # journal: watchdog fire, wedged classification, partial results
+    fires = _events(journal, "watchdog_fire")
+    assert any(f["mechanism"] == "subprocess-kill" for f in fires)
+    cls = _events(journal, "wedge_classification")
+    assert [c["verdict"] for c in cls] == ["wedged"]
+    assert cls[0]["metric"] == "sgemm_gflops"
+    skipped = {e["metric"] for e in _events(journal, "partial_result")}
+    assert skipped == {n for n, _f in bench.BENCH_METRICS} - {
+        "sgemm_gflops"}
+    ends = _events(journal, "run_end")
+    assert ends and ends[-1]["outcome"] == "wedged_partial"
+
+    # the report reproduces the narrative from the journal alone
+    rep = subprocess.run(
+        [sys.executable, "tools/health_report.py", str(journal)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    for needle in ("WATCHDOG FIRED", "classified WEDGED",
+                   "partial result", "run ended: wedged_partial",
+                   "fault injected"):
+        assert needle in rep.stdout, (needle, rep.stdout)
+
+
+# ---------------------------------------------------------------- #
+# fault plan 2: child timeout with a live tunnel -> SLOW, continue  #
+# ---------------------------------------------------------------- #
+
+def test_timeout_with_live_tunnel_classified_slow(tmp_path):
+    """A hard-kill alone is NOT a wedge: when the post-timeout
+    re-probe answers, the verdict is SLOW and the remaining metrics
+    still get their windows (the subprocess-timeout recovery path)."""
+    journal = tmp_path / "health.jsonl"
+    # victims chosen CPU-runnable (saxpy/scan_hist are the metrics
+    # whose smoke children finish in seconds on any box); the wedge
+    # fires pre-compile so kernel compilability doesn't matter
+    plan = {
+        "probe": ["ok", "ok"],
+        "wedge_metric": {"metric": "saxpy_gb_s", "phase": "operand"},
+    }
+    proc = _run_bench(
+        _bench_env(journal, plan,
+                   TPK_BENCH_TIMEOUT_S=15, TPK_BENCH_CHILD_GRACE_S=5,
+                   TPK_BENCH_ONLY="saxpy_gb_s,scan_hist_melem_s")
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "wedged mid-bench" not in proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["details"]["saxpy_gb_s"] is None        # killed
+    assert rec["details"]["scan_hist_melem_s"] > 0     # still measured
+    cls = _events(journal, "wedge_classification")
+    assert [c["verdict"] for c in cls] == ["slow"]
+
+
+# ---------------------------------------------------------------- #
+# fault plan 3: startup probe wedged -> skip the whole run          #
+# ---------------------------------------------------------------- #
+
+def test_wedged_probe_skips_run_with_error_line(tmp_path):
+    """A tunnel that hangs every liveness probe must produce the
+    null-headline error line (pointing at prior evidence), not a hung
+    process waiting for an outer kill."""
+    journal = tmp_path / "health.jsonl"
+    proc = _run_bench(
+        _bench_env(journal, {"probe": ["hang"]},
+                   TPK_BENCH_PROBE_ATTEMPTS=2, TPK_BENCH_PROBE_WAIT_S=0)
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert "unreachable" in rec["details"]["error"]
+    probes = _events(journal, "probe")
+    assert [p["outcome"] for p in probes] == ["hang", "hang"]
+    assert all(p.get("injected") for p in probes)
+    ends = _events(journal, "run_end")
+    assert ends and ends[-1]["outcome"] == "unreachable"
+
+
+# ---------------------------------------------------------------- #
+# fault plan 4: probe hangs then recovers -> patience preserved     #
+# ---------------------------------------------------------------- #
+
+def test_probe_hang_then_recover_preserves_patience(tmp_path):
+    """Tunnel outages recover (observed 10+ min); two hung probe
+    attempts followed by a healthy one must lead to a measuring run,
+    not an early bail."""
+    journal = tmp_path / "health.jsonl"
+    proc = _run_bench(
+        _bench_env(journal, {"probe": ["hang", "hang", "ok"]},
+                   TPK_BENCH_PROBE_WAIT_S=0,
+                   TPK_BENCH_ONLY="saxpy_gb_s")
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["details"]["saxpy_gb_s"] > 0  # the run happened
+    probes = _events(journal, "probe")
+    assert [p["outcome"] for p in probes] == ["hang", "hang", "ok"]
+    ends = _events(journal, "run_end")
+    assert ends and ends[-1]["outcome"] == "complete"
+
+
+# ---------------------------------------------------------------- #
+# fault plan 5: kernel import failure -> real cause surfaced        #
+# ---------------------------------------------------------------- #
+
+def test_import_failure_surfaces_real_cause(tmp_path):
+    """A failed kernel-group import must surface ITS error from
+    lookup(), never a bare 'unknown kernel' dispatch-table miss."""
+    env = _scrubbed_env(fake_devices=None)
+    env["TPK_FAULT_PLAN"] = json.dumps({"fail_import": "nbody"})
+    env["TPK_HEALTH_JOURNAL"] = str(tmp_path / "health.jsonl")
+    body = (
+        "from tpukernels import registry\n"
+        "try:\n"
+        "    registry.lookup('nbody')\n"
+        "except KeyError as e:\n"
+        "    print('LOOKUP-ERR:', e)\n"
+        "    print('CAUSE:', repr(e.__cause__))\n"
+        "print('CORE-OK:', callable(registry.lookup('vector_add')))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", body],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "failed to import" in proc.stdout
+    assert "injected fault: fail_import nbody" in proc.stdout
+    # the unaffected groups still dispatch
+    assert "CORE-OK: True" in proc.stdout
+    # the failure is a structured health event, not just a traceback
+    fails = _events(tmp_path / "health.jsonl", "import_failure")
+    assert any("nbody" in f["kernels"] for f in fails)
+
+
+def test_required_group_import_failure_fails_loudly(tmp_path):
+    """An injected failure in the REQUIRED core group must abort
+    population with the injected cause (and stay retryable — the
+    transient-TPU-hiccup contract)."""
+    env = _scrubbed_env(fake_devices=None)
+    env["TPK_FAULT_PLAN"] = json.dumps({"fail_import": "sgemm"})
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from tpukernels import registry; registry.lookup('sgemm')"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode != 0
+    assert "injected fault: fail_import sgemm" in proc.stderr
+
+
+# ---------------------------------------------------------------- #
+# C-shim entry injection                                            #
+# ---------------------------------------------------------------- #
+
+def test_capi_fault_injection(fault_plan):
+    from tpukernels import capi
+
+    fault_plan({"fail_capi": "vector_add"})
+    x = np.zeros(4, np.float32)
+    y = np.zeros(4, np.float32)
+    params = json.dumps(
+        {"alpha": 1.0,
+         "buffers": [{"shape": [4], "dtype": "f32"}] * 2}
+    )
+    with pytest.raises(RuntimeError, match="injected fault: fail_capi"):
+        capi.run_from_c(
+            "vector_add", params, [x.ctypes.data, y.ctypes.data]
+        )
+
+
+# ---------------------------------------------------------------- #
+# clean-path contract: no plan -> no behavior change                #
+# ---------------------------------------------------------------- #
+
+def test_clean_path_output_byte_identical(tmp_path):
+    """With TPK_FAULT_PLAN unset the injection points are a single
+    guarded check; bench stdout for a fixed seed on CPU must be
+    byte-identical with no plan and with an empty (matching nothing)
+    plan."""
+    journal = tmp_path / "health.jsonl"
+    outs = []
+    for plan in (None, None, {}):
+        proc = _run_bench(
+            _bench_env(journal, plan), args=("--one", "saxpy_gb_s")
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        outs.append(proc.stdout)
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_no_plan_means_inactive_no_op():
+    from tpukernels.resilience import faults
+
+    assert os.environ.get("TPK_FAULT_PLAN") is None
+    assert not faults.active()
+    # every injection point is a cheap no-op
+    assert faults.probe_outcome() is None
+    faults.phase_fault("execute")
+    faults.import_fault(("sgemm",))
+    faults.capi_fault("sgemm")
+
+
+# ---------------------------------------------------------------- #
+# primitive units: plan loading, journal, watchdog                  #
+# ---------------------------------------------------------------- #
+
+def test_fault_plan_from_file_and_inline(tmp_path, fault_plan):
+    from tpukernels.resilience import faults
+
+    f = fault_plan({"hang_probe": 2})
+    assert f.active()
+    assert f.probe_outcome() == "hang"
+    assert f.probe_outcome() == "hang"
+    assert f.probe_outcome() is None  # sugar exhausted: real probe
+
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps({"fail_capi": "sgemm"}))
+    os.environ["TPK_FAULT_PLAN"] = str(plan_file)
+    try:
+        assert f.reload_plan() == {"fail_capi": "sgemm"}
+    finally:
+        del os.environ["TPK_FAULT_PLAN"]
+        f.reload_plan()
+
+
+def test_fault_plan_rejects_non_object(monkeypatch):
+    from tpukernels.resilience import faults
+
+    monkeypatch.setenv("TPK_FAULT_PLAN", "[1, 2]")
+    with pytest.raises(ValueError, match="JSON object"):
+        faults.reload_plan()
+    monkeypatch.delenv("TPK_FAULT_PLAN")
+    faults.reload_plan()
+
+
+def test_journal_emit_and_disable(tmp_path, monkeypatch):
+    from tpukernels.resilience import journal
+
+    p = tmp_path / "j.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(p))
+    journal.emit("probe", attempt=0, outcome="alive")
+    journal.emit("watchdog_fire", mechanism="sigalrm")
+    recs = [json.loads(x) for x in p.read_text().splitlines()]
+    assert [r["kind"] for r in recs] == ["probe", "watchdog_fire"]
+    for r in recs:
+        # HEAD sha + wall clock on every event (postmortem correlation)
+        assert r["ts"] and isinstance(r["t"], float) and r["pid"]
+        assert isinstance(r.get("git_head"), str)
+
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", "0")
+    assert journal.path() is None
+    journal.emit("probe", attempt=1)  # must be a silent no-op
+    assert len(p.read_text().splitlines()) == 2
+
+    # a directory routes to a dated file inside it
+    d = tmp_path / "jdir"
+    d.mkdir()
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(d))
+    journal.emit("probe", attempt=2)
+    files = list(d.iterdir())
+    assert len(files) == 1 and files[0].name.startswith("health_")
+
+
+def test_watchdog_alarm_guard():
+    import time
+
+    from tpukernels.resilience import watchdog
+
+    with pytest.raises(watchdog.Timeout):
+        watchdog.run_with_alarm(lambda: time.sleep(5), 1)
+    assert watchdog.run_with_alarm(lambda: 42, 1) == 42
+    time.sleep(1.2)  # a stale alarm would fire here
+
+
+def test_watchdog_kill_after():
+    from tpukernels.resilience import watchdog
+
+    proc, status = watchdog.kill_after(
+        [sys.executable, "-c", "import time; time.sleep(30)"], 0.5
+    )
+    assert (proc, status) == (None, "timeout")
+    proc, status = watchdog.kill_after(
+        [sys.executable, "-c", "print('hi')"], 30,
+        stdout=subprocess.PIPE, text=True,
+    )
+    assert status == "ok" and proc.stdout.strip() == "hi"
+
+
+def test_watchdog_classify_timeout(tmp_path, monkeypatch):
+    from tpukernels.resilience import journal, watchdog
+
+    p = tmp_path / "j.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(p))
+    assert watchdog.classify_timeout(True, metric="m") == "slow"
+    assert watchdog.classify_timeout(False, metric="m") == "wedged"
+    recs = [json.loads(x) for x in p.read_text().splitlines()]
+    assert [r["verdict"] for r in recs] == ["slow", "wedged"]
+
+
+def test_patient_probe_semantics(monkeypatch):
+    from tpukernels.resilience import watchdog
+
+    monkeypatch.setattr(watchdog.time, "sleep", lambda s: None)
+    seen = []
+
+    def probe(outcomes):
+        def once(attempt):
+            seen.append(attempt)
+            return outcomes[attempt]
+        return once
+
+    seen.clear()
+    assert watchdog.patient_probe(probe(["retry", "alive"]), 5, 0) is True
+    assert seen == [0, 1]
+    seen.clear()
+    # "dead" is definitive: patience must NOT continue
+    assert watchdog.patient_probe(probe(["dead"]), 5, 0) is False
+    assert seen == [0]
+    seen.clear()
+    assert watchdog.patient_probe(probe(["retry"] * 3), 3, 0) is False
+    assert seen == [0, 1, 2]

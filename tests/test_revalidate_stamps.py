@@ -1,0 +1,134 @@
+"""Stamp/resume logic of the revalidation queue, proven on CPU.
+
+tools/tpu_revalidate.sh resumes across tunnel flaps via per-day step
+stamps; that logic was previously inline (testable only by running the
+whole chip-bound queue) and is now sourced from
+tools/revalidate_lib.sh, so a stubbed queue here drives the EXACT
+step_done/stamp/run_step implementation the real queue runs:
+a failed step never stamps, a stamped step is skipped on retry, and
+TPK_REVALIDATE_FORCE=1 re-runs everything.
+"""
+
+import datetime
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "tools", "revalidate_lib.sh")
+
+QUEUE = """\
+#!/bin/bash
+# stubbed revalidation queue: same set -e gate discipline as the real
+# one, steps log their execution and step_b fails until $FLAG exists
+set -e -o pipefail
+stamp_dir="$STAMP_DIR"
+mkdir -p "$stamp_dir"
+source "$LIB"
+step_a() { echo a >> "$RUNLOG"; }
+step_b() { echo b >> "$RUNLOG"; [ -e "$FLAG" ]; }
+step_c() { echo c >> "$RUNLOG"; }
+run_step a step_a
+run_step b step_b
+run_step c step_c
+echo QUEUE-GREEN
+"""
+
+
+@pytest.fixture
+def queue(tmp_path):
+    script = tmp_path / "queue.sh"
+    script.write_text(QUEUE)
+    runlog = tmp_path / "runlog"
+    runlog.write_text("")
+    env = dict(os.environ)
+    env.update(
+        STAMP_DIR=str(tmp_path / "stamps"),
+        LIB=LIB,
+        RUNLOG=str(runlog),
+        FLAG=str(tmp_path / "flag"),
+    )
+    env.pop("TPK_REVALIDATE_FORCE", None)
+
+    def run(force=False):
+        e = dict(env)
+        if force:
+            e["TPK_REVALIDATE_FORCE"] = "1"
+        return subprocess.run(
+            ["bash", str(script)], env=e, capture_output=True,
+            text=True, timeout=60,
+        )
+
+    def ran():
+        return runlog.read_text().split()
+
+    return run, ran, tmp_path
+
+
+def _stamps(tmp_path):
+    d = tmp_path / "stamps"
+    return sorted(p.name.split("_")[0] for p in d.iterdir()) if d.is_dir() else []
+
+
+def test_failed_step_never_stamps_and_blocks_the_queue(queue):
+    run, ran, tmp = queue
+    r = run()
+    assert r.returncode != 0          # set -e: the gate fails loudly
+    assert ran() == ["a", "b"]        # c never reached
+    assert _stamps(tmp) == ["a"]      # the FAILED step did not stamp
+
+
+def test_stamped_steps_skip_on_retry_until_green(queue):
+    run, ran, tmp = queue
+    assert run().returncode != 0      # first attempt: b fails
+    (tmp / "flag").touch()            # "the tunnel recovered"
+    r = run()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "QUEUE-GREEN" in r.stdout
+    # a was NOT re-run (stamped); b and c ran on the retry
+    assert ran() == ["a", "b", "b", "c"]
+    assert _stamps(tmp) == ["a", "b", "c"]
+    # fully-green queue: every step skips
+    assert run().returncode == 0
+    assert ran() == ["a", "b", "b", "c"]
+
+
+def test_force_reruns_everything(queue):
+    run, ran, tmp = queue
+    (tmp / "flag").touch()
+    assert run().returncode == 0
+    assert ran() == ["a", "b", "c"]
+    r = run(force=True)               # same-day code change escape hatch
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ran() == ["a", "b", "c", "a", "b", "c"]
+
+
+def test_stamps_are_per_day(queue):
+    """A stamp from YESTERDAY must not satisfy today's queue — the
+    wall-clock scoping the lib documents."""
+    run, ran, tmp = queue
+    stamps = tmp / "stamps"
+    stamps.mkdir()
+    y = (datetime.date.today() - datetime.timedelta(days=1)).isoformat()
+    (stamps / f"a_{y}.done").touch()
+    (tmp / "flag").touch()
+    assert run().returncode == 0
+    assert ran() == ["a", "b", "c"]   # yesterday's stamp ignored
+
+
+def test_real_queue_scripts_parse_and_source_the_lib():
+    """bash -n both scripts (the queue is unattended — a syntax error
+    would surface mid-recovery) and pin the queue to the sourced lib
+    so these tests keep covering the deployed logic."""
+    for script in ("tools/tpu_revalidate.sh", "tools/revalidate_lib.sh",
+                   "tools/tpu_wait_and_revalidate.sh"):
+        r = subprocess.run(
+            ["bash", "-n", os.path.join(REPO, script)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, (script, r.stderr)
+    with open(os.path.join(REPO, "tools", "tpu_revalidate.sh")) as f:
+        body = f.read()
+    assert "source tools/revalidate_lib.sh" in body
+    assert "step_done()" not in body  # no drifted inline copy

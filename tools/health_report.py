@@ -1,0 +1,179 @@
+"""Summarize a resilience health journal into a session narrative.
+
+Usage:
+    python tools/health_report.py [journal.jsonl ...]
+
+With no arguments, reads the newest docs/logs/health_*.jsonl. The
+journal (tpukernels/resilience/journal.py, schema in
+docs/RESILIENCE.md) records every probe outcome, watchdog fire,
+slow-vs-wedged classification, partial-result decision, invalidation,
+evidence rejection and injected fault; this report reconstructs what a
+flapping session DID — which metrics were banked before the wedge,
+what the watchdogs killed, what the gate rejected and why — from the
+journal alone, replacing grep-the-stderr postmortems.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load(paths):
+    """Parse events from JSONL files, in file order then line order.
+    Unparseable lines are counted, not fatal — a journal truncated by
+    a crash is exactly when a postmortem is needed most."""
+    events, bad = [], 0
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                if isinstance(rec, dict):
+                    events.append(rec)
+    return events, bad
+
+
+def _fmt(ev):
+    """One narrative line per notable event; None for kinds the
+    narrative summarizes only in aggregate."""
+    ts = ev.get("ts", "?")
+    kind = ev.get("kind")
+    pid = ev.get("pid", "?")
+    if kind == "run_start":
+        return (f"{ts} [pid {pid}] bench run started "
+                f"(deadline {ev.get('deadline_s')}s"
+                + (", FAULT PLAN ACTIVE" if ev.get("fault_plan_active")
+                   else "") + ")")
+    if kind == "run_end":
+        if ev.get("outcome") == "unreachable":
+            return f"{ts} [pid {pid}] run ended: tunnel unreachable"
+        parts = [f"{ts} [pid {pid}] run ended: {ev.get('outcome')}"]
+        for key in ("measured", "failed", "invalidated", "carried"):
+            vals = ev.get(key)
+            if vals:
+                parts.append(f"{key}={','.join(vals)}")
+        return " ".join(parts)
+    if kind == "probe":
+        src = " (injected)" if ev.get("injected") else ""
+        return (f"{ts} [pid {pid}] probe attempt {ev.get('attempt')}: "
+                f"{ev.get('outcome')}{src}")
+    if kind == "watchdog_fire":
+        return (f"{ts} [pid {pid}] WATCHDOG FIRED "
+                f"({ev.get('mechanism')}) at {ev.get('site')} after "
+                f"{ev.get('timeout_s')}s")
+    if kind == "wedge_classification":
+        return (f"{ts} [pid {pid}] timeout on "
+                f"{ev.get('metric', '?')} classified "
+                f"{str(ev.get('verdict', '?')).upper()}"
+                + (" - skipping remaining metrics"
+                   if ev.get("verdict") == "wedged" else
+                   " - tunnel still answers, continuing"))
+    if kind == "partial_result":
+        return (f"{ts} [pid {pid}] partial result: "
+                f"{ev.get('metric')} {ev.get('reason')}")
+    if kind == "metric_failed":
+        return (f"{ts} [pid {pid}] metric {ev.get('metric')} FAILED "
+                f"({ev.get('status')})")
+    if kind == "deadline_reached":
+        return (f"{ts} [pid {pid}] whole-run deadline reached before "
+                f"{ev.get('before_metric')}")
+    if kind == "invalidated":
+        return (f"{ts} [pid {pid}] INVALIDATED {ev.get('metric')}="
+                f"{ev.get('value')} (> ceiling {ev.get('ceiling')} "
+                f"+{ev.get('epsilon')})")
+    if kind == "epoch_rejected":
+        return (f"{ts} [pid {pid}] evidence epoch-rejected: "
+                f"{ev.get('metric')} from {ev.get('artifact')} "
+                f"(predates commit ts {ev.get('blocking_commit_ts')})")
+    if kind == "fault_injected":
+        return (f"{ts} [pid {pid}] fault injected at "
+                f"{ev.get('site')}: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                            if k not in ("ts", "t", "pid", "git_head",
+                                         "kind", "site")))
+    if kind == "import_failure":
+        return (f"{ts} [pid {pid}] kernel import FAILED for "
+                f"{','.join(ev.get('kernels', []))}: {ev.get('error')}")
+    if kind == "capi_error":
+        return (f"{ts} [pid {pid}] C-shim dispatch error for "
+                f"{ev.get('kernel')}: {ev.get('error')}")
+    if kind == "skip_captured":
+        return (f"{ts} [pid {pid}] skip-captured: carrying "
+                f"{','.join(ev.get('carried', []))}; measuring "
+                f"{','.join(ev.get('measuring', []))}")
+    if kind == "metrics_restricted":
+        return (f"{ts} [pid {pid}] TPK_BENCH_ONLY restricts run to "
+                f"{','.join(ev.get('only', []))}")
+    return f"{ts} [pid {pid}] {kind}"
+
+
+def summarize(events, bad=0) -> str:
+    out = []
+    events = sorted(events, key=lambda e: e.get("t", 0.0))
+    heads = {e.get("git_head") for e in events if e.get("git_head")}
+    out.append(
+        f"health report: {len(events)} events"
+        + (f", {bad} unparseable lines" if bad else "")
+        + (f", git {'/'.join(sorted(h[:12] for h in heads))}"
+           if heads else "")
+    )
+    out.append("-" * 60)
+    for ev in events:
+        line = _fmt(ev)
+        if line:
+            out.append(line)
+    out.append("-" * 60)
+    counts = {}
+    for ev in events:
+        counts[ev.get("kind")] = counts.get(ev.get("kind"), 0) + 1
+    wedges = sum(
+        1 for e in events
+        if e.get("kind") == "wedge_classification"
+        and e.get("verdict") == "wedged"
+    )
+    fires = counts.get("watchdog_fire", 0)
+    out.append(
+        "totals: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    )
+    out.append(
+        f"verdict: {wedges} wedge(s), {fires} watchdog fire(s), "
+        f"{counts.get('partial_result', 0)} partial-result decision(s), "
+        f"{counts.get('fault_injected', 0)} injected fault(s)"
+    )
+    return "\n".join(out)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    paths = argv
+    if not paths:
+        found = sorted(
+            glob.glob(os.path.join(_REPO, "docs", "logs",
+                                   "health_*.jsonl")),
+            key=os.path.basename,
+        )
+        if not found:
+            print("health_report: no docs/logs/health_*.jsonl found",
+                  file=sys.stderr)
+            return 1
+        paths = [found[-1]]
+    events, bad = load(paths)
+    print(f"health_report: {', '.join(os.path.relpath(p) for p in paths)}")
+    print(summarize(events, bad))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,13 +100,21 @@ def promote(
                 f"{old} — that is a regression to investigate (the union "
                 "gate should have failed), not a new baseline"
             )
-        if bench._is_measurement(ceilings.get(name)) and new > ceilings[name]:
+        if bench._is_measurement(ceilings.get(name)) and new > ceilings[
+            name
+        ] * (1.0 + bench._CEILING_EPS):
+            # same epsilon band bench.py's capture-time invalidation
+            # uses: the sgemm ceiling sits 0.8% above the median of
+            # record, so ordinary upward noise on an honest near-peak
+            # capture must neither be invalidated nor refused here.
+            # Past the band it is drift, never a speedup.
             raise SystemExit(
                 f"promote_baseline: {name} captured {new} exceeds its "
-                f"physical ceiling {ceilings[name]} (BASELINE.json "
-                "ceilings) — a drift-inflated measurement must be "
-                "invalidated, never promoted (bench.py should already "
-                "have refused to persist it)"
+                f"physical ceiling {ceilings[name]} by more than "
+                f"{bench._CEILING_EPS:.0%} (BASELINE.json ceilings) — a "
+                "drift-inflated measurement must be invalidated, never "
+                "promoted (bench.py should already have refused to "
+                "persist it)"
             )
         if (
             isinstance(old, (int, float))

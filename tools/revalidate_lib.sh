@@ -1,0 +1,38 @@
+# Per-day step-stamp library for the revalidation queue — SOURCED by
+# tools/tpu_revalidate.sh and by tests/test_revalidate_stamps.py, so
+# the stamp/resume logic the tests prove is the logic the queue runs.
+#
+# Contract (caller must set $stamp_dir and create it):
+#   step_done NAME   -> success iff NAME completed today; always fails
+#                       under TPK_REVALIDATE_FORCE=1 so a same-day
+#                       code change can force a full re-run
+#   stamp NAME       -> mark NAME complete for today (stamps are
+#                       wall-clock-scoped per day, not git-aware — the
+#                       same accepted tradeoff as the bench evidence
+#                       window)
+#   run_step NAME CMD [ARGS...]
+#                    -> skip when stamped; otherwise run CMD and stamp
+#                       ONLY on success. The caller runs under `set -e`
+#                       (the queue is a gate), so a failing CMD aborts
+#                       the queue BEFORE the stamp line — a failed step
+#                       can never stamp, and the retry re-runs it.
+
+step_done() {
+  [ "${TPK_REVALIDATE_FORCE:-}" = "1" ] && return 1
+  [ -e "$stamp_dir/$1_$(date +%Y-%m-%d).done" ]
+}
+
+stamp() {
+  touch "$stamp_dir/$1_$(date +%Y-%m-%d).done"
+}
+
+run_step() {
+  local _rs_name="$1"
+  shift
+  if step_done "$_rs_name"; then
+    echo "revalidate: step '$_rs_name' already completed today - skipping"
+    return 0
+  fi
+  "$@"
+  stamp "$_rs_name"
+}

@@ -24,15 +24,12 @@ cd "$(dirname "$0")/.."
 # re-run after a same-day code change with TPK_REVALIDATE_FORCE=1.
 # The bench step is never stamped: its own skip-captured logic keeps
 # it cheap, and the sgemm canary + union gate must run every attempt.
+# step_done/stamp/run_step live in the sourced lib so the CPU test
+# suite (tests/test_revalidate_stamps.py) proves the exact
+# stamp/resume logic this queue runs — not a copy of it.
 stamp_dir="docs/logs/.revalidate_stamps"
 mkdir -p "$stamp_dir"
-step_done() {
-  [ "${TPK_REVALIDATE_FORCE:-}" = "1" ] && return 1
-  [ -e "$stamp_dir/$1_$(date +%Y-%m-%d).done" ]
-}
-stamp() {
-  touch "$stamp_dir/$1_$(date +%Y-%m-%d).done"
-}
+source tools/revalidate_lib.sh
 
 # 0. Pre-warm stencil3d's two R-variant compiles into the persistent
 #    cache in a throwaway killable subprocess (VERDICT r4: the tunnel
@@ -82,21 +79,21 @@ printf '%s\n' "$bench_out" | tail -1 > "docs/logs/bench_$(date +%Y-%m-%d_%H%M%S)
 printf '%s\n' "$bench_out" | tail -1 | python bench.py --check-regression $union_flag
 
 # 2. C acceptance gate: serial/omp + real TPU rows + fake-device mesh
-if ! step_done c_gate; then
+c_gate_step() {
   make -C c -s
   (cd c && timeout 900 env TPK_TEST_TPU=1 TPK_TEST_MESH=8 ./run_all.sh | tail -3)
-  stamp c_gate
-fi
+}
+run_step c_gate c_gate_step
 
 # 2b. C-path scan_histogram throughput (docs/NEXT.md item 2): the
 #     combined one-dispatch adapter halved per-rep dispatch cost;
 #     record this Melem/s in docs/PERF.md next to the kernel-level
 #     number.
-if ! step_done c_scan_timing; then
+c_scan_timing_step() {
   make -C c -s
   (cd c && timeout 600 ./bin/scan_histogram --device=tpu --n=4194304 --check)
-  stamp c_scan_timing
-fi
+}
+run_step c_scan_timing c_scan_timing_step
 
 # 2c. Profiler evidence for the roofline claims (VERDICT r3 item 5):
 #     XProf traces of the two headline kernels, summarized into
@@ -117,7 +114,7 @@ fi
 # 2d. Knob sanity: histogram impls agree, sgemm precisions hold their
 #     error contracts (exercised by the suite below too; these are
 #     quick re-confirms on the chip while the tunnel is warm)
-if ! step_done knob_sanity; then
+knob_sanity_step() {
   for impl in mxu vpu; do
     timeout 600 env TPK_HIST_IMPL=$impl python -c "
 from bench import bench_scan_hist
@@ -126,8 +123,8 @@ print('scan_hist $impl:', round(bench_scan_hist(), 1))"
   timeout 600 env TPK_SGEMM_PRECISION=float32 python -c "
 from bench import bench_sgemm
 print('sgemm f32 (bf16_6x):', round(bench_sgemm(), 1))"
-  stamp knob_sanity
-fi
+}
+run_step knob_sanity knob_sanity_step
 
 # 3. Compiled-path test suite (axon backend, kernels compile on chip).
 # TPK_REQUIRE_TPU=1: a still-wedged tunnel must FAIL here, not slip
@@ -142,12 +139,12 @@ fi
 # follow compile cost: each kernel file owns its kernel's variants;
 # "rest" is the capi/distributed/bench/host machinery, which mostly
 # spawns scrubbed-CPU subprocesses and reuses the kernels' cache.
+do_pytest_group() {  # pipefail is set, so a failing pytest fails this
+  timeout 1200 env TPK_REQUIRE_TPU=1 python -m pytest "$@" -q | tail -2
+}
 pytest_group() {  # $1 = group name, $2... = pytest file args
   local grp="$1"; shift
-  if ! step_done "pytest_$grp"; then
-    timeout 1200 env TPK_REQUIRE_TPU=1 python -m pytest "$@" -q | tail -2
-    stamp "pytest_$grp"
-  fi
+  run_step "pytest_$grp" do_pytest_group "$@"
 }
 pytest_group vector_add tests/test_vector_add.py
 pytest_group sgemm      tests/test_sgemm.py

@@ -27,6 +27,10 @@ from tpukernels._cachedir import ensure_compilation_cache
 
 ensure_compilation_cache()
 
+# Resilience layer (stdlib-only, honors the env-before-jax-import
+# rule): fault-injection point + health journal for the C entry.
+from tpukernels.resilience import faults, journal
+
 _PROFILE_DIR = os.environ.get("TPU_KERNELS_PROFILE")
 _profiling = False
 
@@ -410,6 +414,7 @@ _ADAPTERS = {
 
 def run_from_c(kernel: str, params_json: str, addrs) -> int:
     _maybe_start_profiler()
+    faults.capi_fault(kernel)  # single is-None check without a plan
     p = json.loads(params_json)
     specs = p.get("buffers", [])
     if len(specs) != len(addrs):
@@ -423,5 +428,12 @@ def run_from_c(kernel: str, params_json: str, addrs) -> int:
         raise KeyError(
             f"no C adapter for kernel {kernel!r}; known: {sorted(_ADAPTERS)}"
         ) from None
-    fn(p, arrs)
+    try:
+        fn(p, arrs)
+    except Exception as e:  # noqa: BLE001 — journaled, then re-raised
+        # the C host sees the exception through the shim; the journal
+        # keeps a structured record even when the host's stderr is
+        # lost (opt-in: no-op unless TPK_HEALTH_JOURNAL is set)
+        journal.emit("capi_error", kernel=kernel, error=repr(e))
+        raise
     return 0

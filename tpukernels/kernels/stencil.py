@@ -400,11 +400,18 @@ def jacobi3d(
     ):
         # wedge-postmortem breadcrumb (VERDICT r4 weak #3): the chosen
         # slab geometry, printed at trace time so it lands in the
-        # bench child's stderr log BEFORE any remote compile/execute
-        slab_mib = (bz + 2 * k) * hp8 * wp * 4 / 2**20 if blocked else 0.0
+        # bench child's stderr log BEFORE any remote compile/execute.
+        # slab=none on the unblocked path (ADVICE r5): printing a slab
+        # tuple the kernel never materializes would let a postmortem
+        # misattribute an unblocked-path hang to slab geometry.
+        if blocked:
+            slab_mib = (bz + 2 * k) * hp8 * wp * 4 / 2**20
+            geom = f"slab=({bz + 2 * k},{hp8},{wp}) {slab_mib:.1f} MiB"
+        else:
+            geom = "slab=none"
         print(
             f"# jacobi3d: d={d} h={h} w={w} blocked={blocked} bz={bz} "
-            f"k={k} slab=({bz + 2 * k},{hp8},{wp}) {slab_mib:.1f} MiB "
+            f"k={k} {geom} "
             f"vmem_limit={_COMPILER_PARAMS.vmem_limit_bytes // 2**20} MiB",
             file=sys.stderr,
             flush=True,

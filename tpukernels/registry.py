@@ -15,6 +15,11 @@ from __future__ import annotations
 
 from typing import Callable, Dict
 
+# stdlib-only (no jax), so importing it here keeps `import tpukernels`
+# jax-free; gives _populate its fault-injection point and journals
+# real import failures as health events (docs/RESILIENCE.md)
+from tpukernels.resilience import faults, journal
+
 _REGISTRY: Dict[str, Callable] = {}
 _IMPORT_ERRORS: Dict[str, BaseException] = {}  # kernel -> why it's absent
 _POPULATED = False
@@ -55,11 +60,16 @@ def _populate():
     # failure (e.g. TPU runtime hiccup at first import) is retryable.
     def _group(names, load, required=False):
         try:
+            faults.import_fault(names)  # no-op without a TPK_FAULT_PLAN
             load()
         except Exception as e:  # noqa: BLE001 — recorded, re-raised on use
             stripped = e.with_traceback(None)
             for n in names:
                 _IMPORT_ERRORS[n] = stripped
+            journal.emit(
+                "import_failure", kernels=list(names),
+                required=required, error=repr(stripped),
+            )
             if required:
                 raise
 

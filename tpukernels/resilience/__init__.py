@@ -1,0 +1,29 @@
+"""Unified resilience layer for the wedge-prone TPU path.
+
+The axon PJRT tunnel's documented failure mode is FLAPPING: ~2-25
+healthy minutes, then a mid-run wedge that HANGS rather than errors
+(docs/NEXT.md, BASELINE.md status notes). The repo grew three separate
+defenses against it — a SIGALRM guard, per-metric killable
+subprocesses, a probe-retry patience loop — plus stderr-breadcrumb
+postmortems. None of that was testable without a live chip. This
+package makes the wedge-handling paths deterministic, observable and
+regression-testable on CPU:
+
+- ``faults``   — deterministic fault injection driven by the
+  ``TPK_FAULT_PLAN`` env var (inline JSON or a path to a JSON file).
+  Injection points are threaded through bench.py's probe/measure
+  phases, ``registry._populate`` and ``capi.run_from_c``; with no plan
+  set every injection point is a single ``is None`` check.
+- ``watchdog`` — the one home for the three timeout mechanisms
+  (SIGALRM soft guard, subprocess hard kill, probe retry patience)
+  with explicit "slow vs wedged" classification semantics.
+- ``journal``  — structured JSONL health-event log
+  (``docs/logs/health_*.jsonl``) replacing grep-the-stderr
+  postmortems; ``tools/health_report.py`` turns one into a narrative.
+
+Import-order contract: everything here is stdlib-only (no jax, no
+numpy) so bench.py/capi.py can import it BEFORE jax, and
+``import tpukernels`` stays jax-free. See docs/RESILIENCE.md.
+"""
+
+from tpukernels.resilience import faults, journal, watchdog  # noqa: F401

@@ -1,0 +1,179 @@
+"""Deterministic fault injection for the wedge-prone TPU path.
+
+``TPK_FAULT_PLAN`` holds either inline JSON or the path of a JSON
+file; unset (the production case) makes every injection point a single
+``_PLAN is None`` check — no dict lookups, no string compares — so the
+hot paths (capi's C timing loop, bench's slope loop) pay nothing.
+
+Plan schema (all keys optional; see docs/RESILIENCE.md for the full
+contract and examples):
+
+- ``"probe": ["hang", "hang", "ok"]`` — scripted liveness-probe
+  outcomes, consumed one per probe ATTEMPT in the consuming process
+  (the last entry repeats once exhausted). ``"ok"`` forces alive
+  without spawning the probe subprocess, ``"hang"`` behaves as a
+  probe timeout, ``"dead"`` as a probe error; anything else falls
+  through to the real probe.
+- ``"hang_probe": N`` — sugar: the first N probe attempts hang, later
+  ones run the real probe.
+- ``"wedge_metric": {"metric": "stencil3d_mcells_s", "phase":
+  "execute"}`` — the bench child measuring that metric hangs at that
+  phase (``operand`` | ``compile`` | ``execute``), immune to SIGALRM
+  exactly like a wedged C-level PJRT call, so only the parent's hard
+  kill can reap it. Omitting ``"metric"`` matches any metric;
+  ``"phase"`` defaults to ``execute``.
+- ``"fail_metric": {...}`` — same matching, but raises instead of
+  hanging (the child errors loudly — the NON-wedge failure mode).
+- ``"fail_import": "nbody"`` — registry._populate's group containing
+  that kernel name raises ImportError at load time.
+- ``"fail_capi": "sgemm"`` / ``"wedge_capi": "sgemm"`` — the C-shim
+  entry ``capi.run_from_c`` raises / hangs when dispatching that
+  kernel.
+
+Fault state (probe script position, current metric) is per-process;
+plans reach bench's ``--one`` children through env inheritance. Every
+fired fault emits a ``fault_injected`` journal event so chaos runs are
+self-describing in the health log.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+from tpukernels.resilience import journal
+
+
+def _load_plan():
+    raw = os.environ.get("TPK_FAULT_PLAN")
+    if not raw or not raw.strip():
+        return None
+    if raw.lstrip()[:1] in ("{", "["):  # inline JSON (a non-object
+        plan = json.loads(raw)          # still fails the check below)
+    else:
+        with open(raw) as f:
+            plan = json.load(f)
+    if not isinstance(plan, dict):
+        raise ValueError(
+            f"TPK_FAULT_PLAN must be a JSON object, got {type(plan).__name__}"
+        )
+    return plan
+
+
+_PLAN = _load_plan()
+_PROBE_IDX = 0       # probe attempts consumed (per process)
+_CURRENT_METRIC = None  # set by bench's --one/--prewarm child entry
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def reload_plan():
+    """Re-read TPK_FAULT_PLAN (tests flip the env mid-process; real
+    runs load once at import). Resets per-process fault state."""
+    global _PLAN, _PROBE_IDX, _CURRENT_METRIC
+    _PLAN = _load_plan()
+    _PROBE_IDX = 0
+    _CURRENT_METRIC = None
+    return _PLAN
+
+
+def _wedge(desc: str):
+    """Simulate a C-level wedge: the hang must survive the SIGALRM
+    soft guard (signal handlers only run between Python bytecodes, and
+    a real wedged PJRT call never yields one) so that only the
+    subprocess-kill watchdog layer can end it — the exact signature
+    bench.py's slow-vs-wedged classification keys on."""
+    print(f"# fault: wedging ({desc})", file=sys.stderr, flush=True)
+    try:
+        signal.signal(signal.SIGALRM, signal.SIG_IGN)
+    except ValueError:
+        pass  # non-main thread: the sleep loop below still hangs
+    while True:
+        time.sleep(60)
+
+
+def _fail(desc: str):
+    raise RuntimeError(f"injected fault: {desc}")
+
+
+def probe_outcome():
+    """Scripted outcome for the next liveness-probe attempt, or None
+    to run the real probe. One entry consumed per attempt."""
+    global _PROBE_IDX
+    if _PLAN is None:
+        return None
+    idx = _PROBE_IDX
+    out = None
+    script = _PLAN.get("probe")
+    if script:
+        out = script[min(idx, len(script) - 1)]
+    elif idx < int(_PLAN.get("hang_probe", 0)):
+        out = "hang"
+    if out is None:
+        return None
+    _PROBE_IDX += 1
+    journal.emit("fault_injected", site="probe", outcome=out, attempt=idx)
+    return out
+
+
+def enter_metric(name: str):
+    """Record which bench metric this (child) process is measuring so
+    phase_fault can match wedge_metric/fail_metric plans."""
+    global _CURRENT_METRIC
+    if _PLAN is None:
+        return
+    _CURRENT_METRIC = name
+
+
+def phase_fault(phase: str):
+    """Injection point for bench's measure phases (operand, compile,
+    execute — the _slope breadcrumb phases)."""
+    if _PLAN is None:
+        return
+    for key, action in (("wedge_metric", _wedge), ("fail_metric", _fail)):
+        spec = _PLAN.get(key)
+        if not spec:
+            continue
+        want = spec.get("metric")
+        if want is not None and want != _CURRENT_METRIC:
+            continue
+        if spec.get("phase", "execute") != phase:
+            continue
+        journal.emit(
+            "fault_injected",
+            site="metric",
+            fault=key,
+            metric=_CURRENT_METRIC,
+            phase=phase,
+        )
+        action(f"{key} {_CURRENT_METRIC or '<any>'}:{phase}")
+
+
+def import_fault(kernels):
+    """Injection point for registry._populate: raise when the plan's
+    fail_import kernel belongs to the group being loaded."""
+    if _PLAN is None:
+        return
+    want = _PLAN.get("fail_import")
+    if want and want in kernels:
+        journal.emit("fault_injected", site="import", kernels=list(kernels))
+        raise ImportError(f"injected fault: fail_import {want}")
+
+
+def capi_fault(kernel: str):
+    """Injection point for capi.run_from_c (the C shim's entry)."""
+    if _PLAN is None:
+        return
+    if _PLAN.get("fail_capi") == kernel:
+        journal.emit("fault_injected", site="capi", kernel=kernel,
+                     fault="fail_capi")
+        _fail(f"fail_capi {kernel}")
+    if _PLAN.get("wedge_capi") == kernel:
+        journal.emit("fault_injected", site="capi", kernel=kernel,
+                     fault="wedge_capi")
+        _wedge(f"wedge_capi {kernel}")

@@ -1,0 +1,123 @@
+"""Structured JSONL health-event log (docs/RESILIENCE.md §journal).
+
+Every resilience-relevant decision — probe outcomes, watchdog fires,
+slow-vs-wedged classifications, partial-result decisions, evidence
+rejections, injected faults — is appended as one JSON line so a
+flapping session can be reconstructed from the journal alone
+(tools/health_report.py) instead of grepping stderr breadcrumbs.
+
+Routing (``TPK_HEALTH_JOURNAL``):
+- unset        — journaling DISABLED. Library contexts (the C shim's
+  embedded interpreter, unit tests importing bench) stay silent;
+  ``bench.py`` run as a CLI defaults the var to
+  ``docs/logs/health_<date>.jsonl`` so its ``--one`` children inherit
+  the same file and a whole run lands in one journal.
+- ``0``/``off``/``none`` — explicitly disabled.
+- a directory  — ``health_<date>.jsonl`` inside it.
+- anything else — used verbatim as the journal file path.
+
+Events are best-effort by design: a full disk or unwritable path must
+degrade observability, never take down the run being observed. Each
+record carries a wall-clock ISO timestamp, a unix ``t``, the emitting
+``pid`` and the repo ``git_head`` sha, so artifacts and journal lines
+from the same session can be correlated (the ISSUE's
+"stamped with HEAD sha and wall clock").
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import time
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_DISABLED = ("", "0", "off", "none")
+_HEAD_CACHE: list = []  # [sha_or_None] once resolved (per process)
+
+
+def git_head(root=None):
+    """HEAD sha of `root` (default: this repo), or None outside a git
+    repo / without git. Cached per process for the default root — the
+    journal stamps every event and must not fork git each time."""
+    import subprocess
+
+    if root is None:
+        if _HEAD_CACHE:
+            return _HEAD_CACHE[0]
+        root = _REPO
+        cache = _HEAD_CACHE
+    else:
+        cache = None
+    try:
+        r = subprocess.run(
+            ["git", "-C", root, "rev-parse", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+        sha = r.stdout.strip()
+        sha = sha if r.returncode == 0 and sha else None
+    except Exception:
+        sha = None
+    if cache is not None:
+        cache.append(sha)
+    return sha
+
+
+def default_path():
+    """Where bench.py's CLI entry routes the journal when the operator
+    didn't choose: one file per day next to the bench artifacts."""
+    return os.path.join(
+        _REPO,
+        "docs",
+        "logs",
+        f"health_{datetime.date.today().isoformat()}.jsonl",
+    )
+
+
+def path():
+    """Resolved journal file path, or None when journaling is off.
+    Re-read from the environment on every call: events are rare and
+    tests (and bench children) retarget the journal via env."""
+    raw = os.environ.get("TPK_HEALTH_JOURNAL")
+    if raw is None or raw.strip().lower() in _DISABLED:
+        return None
+    if os.path.isdir(raw):
+        return os.path.join(
+            raw, f"health_{datetime.date.today().isoformat()}.jsonl"
+        )
+    return raw
+
+
+def enabled() -> bool:
+    return path() is not None
+
+
+def emit(kind: str, **fields):
+    """Append one health event; never raises (observability must not
+    become a new failure mode of the path it observes)."""
+    p = path()
+    if p is None:
+        return
+    now = time.time()
+    rec = {
+        "ts": datetime.datetime.fromtimestamp(now).isoformat(
+            timespec="seconds"
+        ),
+        "t": round(now, 3),
+        "pid": os.getpid(),
+        "git_head": git_head(),
+        "kind": kind,
+    }
+    rec.update(fields)
+    try:
+        d = os.path.dirname(p)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(p, "a") as f:
+            f.write(json.dumps(rec, default=repr) + "\n")
+    except OSError:
+        pass

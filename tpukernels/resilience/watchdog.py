@@ -1,0 +1,114 @@
+"""The one home for the repo's three timeout mechanisms.
+
+bench.py grew three divergent defenses against the flapping axon
+tunnel; each now lives here once, with its semantics written down:
+
+1. ``run_with_alarm`` — SIGALRM soft guard. Interrupts PURE-PYTHON
+   slowness only: signal handlers run between Python bytecodes, so a
+   hung C-level PJRT call (the real wedge mode, observed 2026-07-31)
+   sails straight past it. Use it as a second layer inside a process
+   something else can kill, never as the only defense.
+2. ``kill_after`` — subprocess hard kill. The only mechanism that
+   ends a true wedge: the child is killable from outside regardless
+   of where it hangs. Anything that might touch the tunnel for real
+   runs under this.
+3. ``patient_probe`` — retry/backoff patience for liveness probes.
+   Tunnel outages of 10+ minutes recover, so probes retry with a
+   deliberate wait; a DEFINITIVE answer ("no TPU configured on this
+   box") aborts the patience early — waiting cannot conjure hardware.
+
+Slow vs wedged (``classify_timeout``): after a hard-kill fires, one
+quick liveness re-probe decides which world we are in. Probe answers
+→ the child was merely SLOW (the tunnel is fine; later work may
+proceed). Probe fails → the tunnel WEDGED mid-run (skip remaining
+work immediately rather than burning a full watchdog window on each
+item). Both verdicts are journaled, as is every watchdog fire.
+"""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import sys
+import time
+
+from tpukernels.resilience import journal
+
+
+class Timeout(Exception):
+    """Raised by run_with_alarm when the SIGALRM guard fires."""
+
+
+def run_with_alarm(fn, seconds: int, site: str | None = None):
+    """Layer 1 (soft): run fn() under SIGALRM, raising Timeout after
+    `seconds`. Restores the previous handler and cancels the alarm on
+    every exit path — a stale alarm firing later would kill an
+    innocent caller."""
+
+    def handler(signum, frame):
+        journal.emit(
+            "watchdog_fire", mechanism="sigalrm", site=site,
+            timeout_s=seconds,
+        )
+        raise Timeout(f"exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(int(seconds))
+    try:
+        return fn()
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def kill_after(argv, timeout_s: float, site: str | None = None, **run_kw):
+    """Layer 2 (hard): run `argv` as a killable subprocess. Returns
+    (CompletedProcess, "ok") or (None, "timeout") once the kill fired.
+    The caller interprets the child's returncode — a nonzero exit is
+    the child failing LOUDLY, which is not a wedge."""
+    try:
+        proc = subprocess.run(argv, timeout=timeout_s, **run_kw)
+    except subprocess.TimeoutExpired:
+        journal.emit(
+            "watchdog_fire", mechanism="subprocess-kill", site=site,
+            timeout_s=timeout_s, argv=[str(a) for a in argv[:4]],
+        )
+        return None, "timeout"
+    return proc, "ok"
+
+
+def patient_probe(
+    probe_once,
+    attempts: int,
+    retry_wait_s: float,
+    label: str = "probe",
+):
+    """Layer 3 (patience): retry `probe_once(attempt)` up to `attempts`
+    times, sleeping `retry_wait_s` between goes. probe_once returns
+    "alive" (stop: True), "dead" (stop: False — a definitive negative
+    that waiting cannot fix), or "retry" (hang/error: patience
+    continues). Exhausted patience is False."""
+    for attempt in range(attempts):
+        r = probe_once(attempt)
+        if r == "alive":
+            return True
+        if r == "dead":
+            return False
+        print(
+            f"# {label} failed (attempt {attempt + 1}/{attempts})",
+            file=sys.stderr,
+        )
+        if attempt + 1 < attempts:
+            time.sleep(retry_wait_s)
+    return False
+
+
+def classify_timeout(probe_alive: bool, **ctx) -> str:
+    """Post-hard-kill verdict: "slow" (tunnel answers — continue with
+    remaining work) or "wedged" (tunnel gone — skip the rest). The
+    classification is journaled with the caller's context (metric
+    name etc.) so a postmortem reads the decision, not just its
+    side effects."""
+    verdict = "slow" if probe_alive else "wedged"
+    journal.emit("wedge_classification", verdict=verdict, **ctx)
+    return verdict
