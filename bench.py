@@ -14,6 +14,15 @@ repeat counts R_small and R_big, and the per-iteration time is
 other per-call constant cancels exactly; compile time is excluded by
 warm-up calls as usual. Each loop body carries a data dependence on
 the previous iteration so XLA cannot hoist or batch the work.
+
+Tuning integration (docs/TUNING.md): each metric's kernel resolves its
+block geometry per call via tpukernels/tuning with precedence
+env-override > tuned-cache > shipped-default, so a `--one` child both
+serves as the autotune sweep's measurement probe (tools/autotune.py
+sets the env knobs per candidate and TPK_TUNING_CACHE=0) and, in
+normal runs, automatically benefits from promoted entries; a cache-
+sourced resolution lands a `tuning_resolved` health event in this
+run's journal.
 """
 
 from __future__ import annotations

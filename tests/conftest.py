@@ -141,6 +141,25 @@ if "TPK_HEALTH_JOURNAL" not in os.environ:
         _journal_dir, "health_suite.jsonl"
     )
 
+# Isolate the tuning cache (docs/TUNING.md) for the same reason: a
+# smoke autotune run leaves entries under the repo .jax_cache, and the
+# suite's kernel calls (plus its subprocess children, via env
+# inheritance) must measure the SHIPPED defaults, not whatever the
+# last sweep promoted. Tests that assert cache behavior point
+# TPK_TUNING_CACHE_DIR at their own tmp path.
+if "TPK_TUNING_CACHE_DIR" not in os.environ:
+    import tempfile
+
+    _tuning_dir = os.path.join(
+        tempfile.gettempdir(), f"tpk_tuning_test_{os.getuid()}"
+    )
+    os.makedirs(_tuning_dir, exist_ok=True)
+    os.environ["TPK_TUNING_CACHE_DIR"] = _tuning_dir
+    try:  # entries a previous suite run promoted must not steer this one
+        os.unlink(os.path.join(_tuning_dir, "tuning.json"))
+    except OSError:
+        pass
+
 # Persist compiled executables across suite runs (the shared knob —
 # tpukernels/_cachedir.py; `import tpukernels` is deliberately
 # jax-free, so this respects the env-before-jax-import rule below).

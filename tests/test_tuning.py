@@ -1,0 +1,422 @@
+"""CPU suite for the autotuning subsystem (docs/TUNING.md).
+
+Covers the ISSUE-2 acceptance surface without a chip:
+
+- cache key/invalidation round-trips (jax-version and git-epoch
+  rejections are loud: journal event + stderr note);
+- the analytic VMEM feasibility arithmetic that prunes infeasible
+  sgemm candidates before chip time;
+- resolution precedence env-override > tuned-cache > shipped-default,
+  proven end to end: a cache entry written by `tools/autotune.py
+  --kernel sgemm --smoke` is demonstrably READ by a subsequent
+  `bench.py --one sgemm_gflops` (the `tuning_resolved` journal event
+  records per-knob sources), and a set env knob beats it;
+- a fault-injected sweep (TPK_FAULT_PLAN, env-narrowed wedge) proving
+  one wedged candidate is hard-killed and cannot eat the sweep.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from test_distributed import _scrubbed_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _events(journal_path, kind=None):
+    recs = [
+        json.loads(line)
+        for line in journal_path.read_text().splitlines()
+        if line.strip()
+    ]
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    return recs
+
+
+def _tuning_env(tmp_path, **extra):
+    """Subprocess env: CPU (never the tunnel), isolated tuning cache
+    and journal under tmp_path, smoke-collapsed bench repeats."""
+    env = _scrubbed_env(fake_devices=None)
+    env["TPK_TUNING_CACHE_DIR"] = str(tmp_path / "tcache")
+    env["TPK_HEALTH_JOURNAL"] = str(tmp_path / "health.jsonl")
+    env["TPK_BENCH_SMOKE"] = "1"
+    env.pop("TPK_FAULT_PLAN", None)
+    env.pop("TPK_TUNING_CACHE", None)
+    for k, v in extra.items():
+        env[k] = str(v)
+    return env
+
+
+@pytest.fixture
+def tuning_cache_dir(tmp_path, monkeypatch):
+    """In-process isolated cache dir (conftest already redirects, but
+    each test wants its own empty one)."""
+    d = tmp_path / "tcache"
+    monkeypatch.setenv("TPK_TUNING_CACHE_DIR", str(d))
+    return d
+
+
+# ---------------------------------------------------------------- #
+# search space: candidates, VMEM pruning arithmetic, env parsing     #
+# ---------------------------------------------------------------- #
+
+def test_sgemm_vmem_arithmetic_and_pruning():
+    """The analytic model reproduces the documented budget facts: the
+    shipped control needs 24 MiB of a 32 MiB budget, bn=2048 with
+    bk=2048 is over budget (the combination the old sgemm_tune grid
+    called infeasible), and candidates() prunes exactly those."""
+    from tpukernels.kernels.sgemm import TUNABLES, _vmem_bytes
+
+    control = {"bm": 256, "bn": 2048, "bk": 1024}
+    assert _vmem_bytes(control) == 24 * 1024 * 1024
+    assert TUNABLES.feasible(control)
+    bad = {"bm": 128, "bn": 2048, "bk": 2048}
+    assert _vmem_bytes(bad) > TUNABLES.vmem_budget_bytes
+    assert not TUNABLES.feasible(bad)
+
+    cands, pruned = TUNABLES.candidates()
+    assert cands[0] == control  # defaults first = the control row
+    assert pruned == 3  # the three bm values paired with bn=bk=2048
+    assert all(
+        not (c["bn"] == 2048 and c["bk"] == 2048) for c in cands
+    )
+    # the old tools/sgemm_tune.py documented grid is a subset
+    old_grid = [
+        (256, 2048, 1024), (128, 2048, 1024), (512, 2048, 1024),
+        (256, 2048, 512), (256, 1024, 1024), (256, 1024, 2048),
+        (512, 1024, 1024),
+    ]
+    as_tuples = {(c["bm"], c["bn"], c["bk"]) for c in cands}
+    assert set(old_grid) <= as_tuples
+
+
+def test_env_parse_fail_loud(monkeypatch):
+    """TPK_* knob contract: garbage raises a ValueError naming the
+    var, for int and choice tunables alike."""
+    from tpukernels.kernels.sgemm import TUNABLES as SGEMM
+    from tpukernels.kernels.histogram import TUNABLES as HIST
+    from tpukernels.tuning import resolve
+
+    for bad in ("0", "-8", "abc"):
+        monkeypatch.setenv("TPK_SGEMM_BM", bad)
+        with pytest.raises(ValueError, match="TPK_SGEMM_BM"):
+            resolve(SGEMM)
+    monkeypatch.delenv("TPK_SGEMM_BM")
+    monkeypatch.setenv("TPK_HIST_IMPL", "gpu")
+    with pytest.raises(ValueError, match="TPK_HIST_IMPL"):
+        resolve(HIST)
+
+
+def test_env_for_skips_kernel_computed_defaults():
+    """env_for leaves None (kernel-computed) params unset so a sweep
+    control row inherits the kernel's own fallback logic."""
+    from tpukernels.kernels.histogram import TUNABLES
+
+    assert TUNABLES.env_for(TUNABLES.defaults()) == {"TPK_HIST_ACC": "i8"}
+    assert TUNABLES.env_for({"impl": "vpu", "acc": "f32"}) == {
+        "TPK_HIST_IMPL": "vpu", "TPK_HIST_ACC": "f32",
+    }
+
+
+# ---------------------------------------------------------------- #
+# cache: round-trip, key shape, invalidation                         #
+# ---------------------------------------------------------------- #
+
+def test_cache_roundtrip_and_key(tuning_cache_dir):
+    from tpukernels.kernels.sgemm import TUNABLES
+    from tpukernels.tuning import cache
+
+    params = {"bm": 128, "bn": 1024, "bk": 512}
+    key = cache.put(
+        params=params, space=TUNABLES, shape=(1024, 1024, 1024),
+        dtype="float32", kind="cpu", value=10.0, control=9.0,
+    )
+    assert key == "sgemm|1024x1024x1024|float32|cpu"
+    got = cache.get(TUNABLES, (1024, 1024, 1024), "float32", kind="cpu")
+    assert got == params
+    # different shape / dtype / device: a miss, never a fuzzy match
+    assert cache.get(TUNABLES, (2048, 2048, 2048), "float32", "cpu") is None
+    assert cache.get(TUNABLES, (1024, 1024, 1024), "bfloat16", "cpu") is None
+    assert cache.get(TUNABLES, (1024, 1024, 1024), "float32", "tpu_v5") is None
+
+
+def _corrupt_entry(cache, field, value):
+    p = cache.path()
+    with open(p) as f:
+        data = json.load(f)
+    entry = next(iter(data["entries"].values()))
+    entry[field] = value
+    with open(p, "w") as f:
+        json.dump(data, f)
+
+
+def test_cache_invalidation_is_loud(tuning_cache_dir, tmp_path,
+                                    monkeypatch, capsys):
+    """Stale entries — tuned under another jax version or before the
+    last commit touching the kernel sources — are rejected with a
+    tuning_rejected journal event, mirroring bench.py's git-epoch
+    evidence rules."""
+    from tpukernels.kernels.sgemm import TUNABLES
+    from tpukernels.tuning import cache
+
+    journal_path = tmp_path / "j.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(journal_path))
+    shape, dtype = (64, 64, 64), "float32"
+    cache.put(params={"bm": 128}, space=TUNABLES, shape=shape,
+              dtype=dtype, kind="cpu")
+
+    # tuned under another jax version: rejected
+    _corrupt_entry(cache, "jax", "0.0.1")
+    cache._REJECT_NOTED.clear()
+    assert cache.get(TUNABLES, shape, dtype, "cpu") is None
+
+    # version healed but a commit touching the sources postdates the
+    # entry (sha mismatch): git-epoch rejection
+    import jax
+
+    _corrupt_entry(cache, "jax", jax.__version__)
+    _corrupt_entry(cache, "source_sha", "f" * 40)
+    cache._REJECT_NOTED.clear()
+    assert cache.get(TUNABLES, shape, dtype, "cpu") is None
+    rejects = _events(journal_path, "tuning_rejected")
+    assert len(rejects) >= 2
+    reasons = " ".join(r["reason"] for r in rejects)
+    assert "jax" in reasons and "stale" in reasons
+    err = capsys.readouterr().err
+    assert "tuning-cache rejected" in err
+
+    # a matching entry (sha healed) round-trips again
+    real_sha = cache.source_sha(TUNABLES.sources)
+    _corrupt_entry(cache, "source_sha", real_sha)
+    assert cache.get(TUNABLES, shape, dtype, "cpu") == {"bm": 128}
+
+
+def test_smoke_entries_scoped_to_smoke_mode(tuning_cache_dir,
+                                            monkeypatch):
+    """A smoke-promoted entry (meaningless collapsed-repeat values)
+    must be honored only under TPK_BENCH_SMOKE=1 — a normal dispatch
+    at the same key keeps shipped defaults."""
+    from tpukernels.kernels.sgemm import TUNABLES
+    from tpukernels.tuning import cache
+
+    cache.put(params={"bm": 128}, space=TUNABLES, shape=(32, 32, 32),
+              dtype="float32", kind="cpu", smoke=True)
+    monkeypatch.delenv("TPK_BENCH_SMOKE", raising=False)
+    cache._REJECT_NOTED.clear()
+    assert cache.get(TUNABLES, (32, 32, 32), "float32", "cpu") is None
+    monkeypatch.setenv("TPK_BENCH_SMOKE", "1")
+    assert cache.get(TUNABLES, (32, 32, 32), "float32", "cpu") == {
+        "bm": 128
+    }
+
+
+def test_quick_probes_first_tunable():
+    """--quick = control + single-axis probes of the first tunable —
+    the old sgemm_tune QUICK rows (control, bm=128, bm=512), via the
+    same quick_candidates() the runner calls."""
+    from tpukernels.kernels.sgemm import TUNABLES
+
+    quick = TUNABLES.quick_candidates()
+    assert [(c["bm"], c["bn"], c["bk"]) for c in quick] == [
+        (256, 2048, 1024), (128, 2048, 1024), (512, 2048, 1024),
+    ]
+
+
+def test_empty_sweep_reports_not_crashes(tmp_path):
+    """--max-candidates 0 (or a fully pruned space) must exit 2 with
+    the documented message, not an IndexError traceback."""
+    env = _tuning_env(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "tools/autotune.py", "--kernel", "vector_add",
+         "--smoke", "--max-candidates", "0"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "no candidate produced a number" in proc.stdout
+
+
+def test_cache_disable_knob(tuning_cache_dir, monkeypatch):
+    from tpukernels.kernels.sgemm import TUNABLES
+    from tpukernels.tuning import cache
+
+    cache.put(params={"bm": 128}, space=TUNABLES, shape=(8, 8, 8),
+              dtype="float32", kind="cpu")
+    assert cache.get(TUNABLES, (8, 8, 8), "float32", "cpu") is not None
+    monkeypatch.setenv("TPK_TUNING_CACHE", "0")
+    assert cache.get(TUNABLES, (8, 8, 8), "float32", "cpu") is None
+
+
+# ---------------------------------------------------------------- #
+# precedence: env > cache > default                                  #
+# ---------------------------------------------------------------- #
+
+def test_resolve_precedence(tuning_cache_dir, monkeypatch):
+    from tpukernels.kernels.sgemm import TUNABLES
+    from tpukernels.tuning import cache, resolve
+    from tpukernels.tuning import space as tspace
+
+    shape, dtype = (512, 512, 512), "float32"
+    monkeypatch.delenv("TPK_SGEMM_BM", raising=False)
+    monkeypatch.delenv("TPK_SGEMM_BN", raising=False)
+    monkeypatch.delenv("TPK_SGEMM_BK", raising=False)
+
+    # 1. nothing set, empty cache: shipped defaults
+    assert resolve(TUNABLES, shape, dtype) == TUNABLES.defaults()
+
+    # 2. cache entry beats defaults (device kind defaults to the
+    # running backend — cpu here)
+    cache.put(params={"bm": 128, "bn": 1024, "bk": 512}, space=TUNABLES,
+              shape=shape, dtype=dtype, kind=cache.device_kind())
+    tspace._JOURNALED.clear()
+    assert resolve(TUNABLES, shape, dtype) == {
+        "bm": 128, "bn": 1024, "bk": 512,
+    }
+
+    # 3. a set env knob beats the cache for ITS tunable only
+    monkeypatch.setenv("TPK_SGEMM_BM", "512")
+    assert resolve(TUNABLES, shape, dtype) == {
+        "bm": 512, "bn": 1024, "bk": 512,
+    }
+
+    # registry exposes the same path
+    from tpukernels import registry
+
+    assert registry.resolve_params("sgemm", shape, dtype)["bm"] == 512
+    monkeypatch.delenv("TPK_SGEMM_BM")
+    assert registry.resolve_params("sgemm", shape, dtype)["bm"] == 128
+
+
+def test_registry_tunables_surface():
+    from tpukernels import registry
+
+    assert set(registry.tunable_kernels()) == {
+        "sgemm", "vector_add", "scan", "histogram", "nbody",
+        "stencil2d", "stencil3d",
+    }
+    assert registry.tunables("sgemm").metric == "sgemm_gflops"
+    with pytest.raises(KeyError, match="TUNABLES"):
+        registry.tunables("scan_exclusive")
+
+
+# ---------------------------------------------------------------- #
+# end to end: autotune --smoke writes, bench --one reads             #
+# ---------------------------------------------------------------- #
+
+def test_autotune_smoke_writes_cache_and_bench_reads_it(tmp_path):
+    """The ISSUE-2 acceptance flow: `tools/autotune.py --kernel sgemm
+    --smoke` completes on CPU, writes a cache entry; a subsequent
+    `bench.py --one sgemm_gflops` resolution demonstrably reads it
+    (per-knob sources in the tuning_resolved journal event), and a set
+    env knob beats the cache for its tunable only."""
+    env = _tuning_env(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "tools/autotune.py", "--kernel", "sgemm",
+         "--smoke", "--max-candidates", "2"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "promoted ->" in proc.stdout
+
+    cache_file = tmp_path / "tcache" / "tuning.json"
+    data = json.loads(cache_file.read_text())
+    key = "sgemm|1024x1024x1024|float32|cpu"
+    assert key in data["entries"]
+    entry = data["entries"][key]
+    assert entry["smoke"] is True
+    assert set(entry["params"]) == {"bm", "bn", "bk"}
+
+    journal = tmp_path / "health.jsonl"
+    cand = _events(journal, "tuning_candidate")
+    assert len(cand) == 2 and all(c["status"] == "ok" for c in cand)
+    promoted = _events(journal, "tuning_promoted")
+    assert len(promoted) == 1 and promoted[0]["smoke"] is True
+
+    # the read side: bench --one under the same cache dir
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--one", "sgemm_gflops"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout.strip().splitlines()[-1])["value"] > 0
+    resolved = _events(journal, "tuning_resolved")
+    assert resolved, "bench --one did not consult the tuning cache"
+    last = resolved[-1]
+    assert last["kernel"] == "sgemm"
+    assert last["sources"] == {"bm": "cache", "bn": "cache", "bk": "cache"}
+    assert last["params"] == entry["params"]
+
+    # env beats cache, per tunable
+    env2 = dict(env, TPK_SGEMM_BM="128")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--one", "sgemm_gflops"],
+        env=env2, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    last = _events(journal, "tuning_resolved")[-1]
+    assert last["sources"]["bm"] == "env" and last["params"]["bm"] == 128
+    assert last["sources"]["bn"] == "cache"
+
+
+# ---------------------------------------------------------------- #
+# chaos: one wedged candidate cannot eat the sweep                   #
+# ---------------------------------------------------------------- #
+
+def test_wedged_candidate_cannot_eat_sweep(tmp_path):
+    """An env-narrowed TPK_FAULT_PLAN wedges exactly the rows=256
+    vector_add candidate (C-level-style hang, immune to SIGALRM); the
+    runner's watchdog hard-kills it after TPK_TUNE_TIMEOUT_S and the
+    sweep continues to a promotion decision — the old tuner's 'one bad
+    candidate cannot eat the window' contract, now fault-proven."""
+    plan = {
+        "wedge_metric": {
+            "metric": "saxpy_gb_s",
+            "phase": "operand",
+            "env": {"TPK_SAXPY_ROWS": "256"},
+        }
+    }
+    env = _tuning_env(
+        tmp_path,
+        TPK_FAULT_PLAN=json.dumps(plan),
+        TPK_TUNE_TIMEOUT_S="20",
+    )
+    proc = subprocess.run(
+        [sys.executable, "tools/autotune.py", "--kernel", "vector_add",
+         "--smoke", "--max-candidates", "3"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    journal = tmp_path / "health.jsonl"
+    cand = _events(journal, "tuning_candidate")
+    by_rows = {c["params"]["rows"]: c["status"] for c in cand}
+    # candidate order is defaults-first: 512 (control), 256, 1024
+    assert by_rows[256] == "timeout"  # wedged -> hard-killed
+    assert by_rows[512] == "ok" and by_rows[1024] == "ok"
+    fires = _events(journal, "watchdog_fire")
+    assert any(f["mechanism"] == "subprocess-kill" for f in fires)
+    ends = _events(journal, "tuning_sweep_end")
+    assert ends and ends[-1]["measured"] == 2 and ends[-1]["failed"] == 1
+
+
+def test_fault_env_match_unit(monkeypatch):
+    """phase_fault's env narrowing: a spec with an env clause fires
+    only in processes whose environment matches."""
+    from tpukernels.resilience import faults
+
+    plan = {"fail_metric": {"phase": "execute",
+                            "env": {"TPK_X_TEST": "yes"}}}
+    monkeypatch.setenv("TPK_FAULT_PLAN", json.dumps(plan))
+    faults.reload_plan()
+    try:
+        faults.phase_fault("execute")  # env absent: must not fire
+        monkeypatch.setenv("TPK_X_TEST", "yes")
+        with pytest.raises(RuntimeError, match="injected fault"):
+            faults.phase_fault("execute")
+    finally:
+        monkeypatch.delenv("TPK_FAULT_PLAN")
+        faults.reload_plan()

@@ -1,0 +1,136 @@
+"""Autotune CLI: sweep a kernel's declarative search space and promote
+the winner into the persistent tuning cache (docs/TUNING.md).
+
+Usage (real sweep needs a healthy tunnel window — AFTER
+tools/tpu_revalidate.sh has gone green; the queue owns the first chip
+minutes):
+
+    python tools/autotune.py --list                    # tunable kernels
+    python tools/autotune.py --kernel sgemm            # full sweep
+    python tools/autotune.py --kernel sgemm --quick    # 3 candidates
+    python tools/autotune.py --kernel sgemm --smoke    # CPU interpret
+                                                       # pipeline proof
+
+Each candidate runs through the real metric path (`bench.py --one
+<metric>` — slope method, median of samples, CPU-fallback refusal) in
+a killable subprocess via the resilience watchdog, so one wedged
+candidate costs TPK_TUNE_TIMEOUT_S and nothing more. Candidates whose
+analytic VMEM need exceeds the kernel's budget are pruned before any
+chip time is spent; a promotion requires beating the shipped-default
+control row by >3% on the bench medians (runner.PROMOTE_MARGIN).
+
+--smoke runs the identical sweep/cache/journal machinery on CPU
+interpret mode (TPK_BENCH_SMOKE collapses repeat counts; values are
+meaningless and the entry is keyed device_kind=cpu so it can never
+steer a TPU run) — the CI proof that the tuner's whole pipeline
+works, wired non-gating into tools/tpu_revalidate.sh.
+
+The PARENT process never touches the TPU tunnel: it scrubs its own env
+to CPU before importing jax-bound modules and hands bench children the
+ORIGINAL environment (or the smoke env under --smoke).
+
+Exit codes: 0 = sweep ran (promoted or not); 2 = no candidate produced
+a number (tunnel down / all wedged).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="sweep a kernel's TUNABLES search space"
+    )
+    ap.add_argument("--kernel", help="registry kernel name (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list tunable kernels and exit")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU interpret-mode pipeline proof (CI)")
+    ap.add_argument("--quick", action="store_true",
+                    help="only the 3 most promising candidates")
+    ap.add_argument("--max-candidates", type=int, default=None,
+                    help="cap the sweep (default: smoke caps at 3)")
+    ap.add_argument("--timeout-s", type=float, default=None,
+                    help="per-candidate watchdog (default "
+                         "TPK_TUNE_TIMEOUT_S, 420 real / 60 smoke)")
+    args = ap.parse_args(argv)
+
+    # children must see the environment as the operator launched it;
+    # capture BEFORE the parent scrubs itself off the tunnel
+    base_env = dict(os.environ)
+
+    # resilience is stdlib-only: safe to import before the scrub. A
+    # CLI sweep journals by default, one file per day, shared with its
+    # bench children via env inheritance (same convention as bench.py).
+    from tpukernels.resilience import journal
+
+    os.environ.setdefault("TPK_HEALTH_JOURNAL", journal.default_path())
+    base_env.setdefault(
+        "TPK_HEALTH_JOURNAL", os.environ["TPK_HEALTH_JOURNAL"]
+    )
+
+    # parent-only scrub: TUNABLES live in kernel modules, which import
+    # jax — on this box sitecustomize force-registers the axon TPU
+    # backend unless the pool var is empty, and the parent holding the
+    # tunnel open would serialize against its own bench children
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from tpukernels import registry
+    from tpukernels.tuning import runner
+
+    if args.list:
+        for name in registry.tunable_kernels():
+            sp = registry.tunables(name)
+            knobs = ", ".join(
+                f"{t.name}({t.env})" for t in sp.tunables
+            )
+            print(f"{name:12s} metric={sp.metric}  knobs: {knobs}")
+        return 0
+    if not args.kernel:
+        ap.error("--kernel is required (or --list)")
+
+    summary = runner.tune(
+        args.kernel,
+        smoke=args.smoke,
+        quick=args.quick,
+        max_candidates=args.max_candidates,
+        timeout_s=args.timeout_s,
+        base_env=base_env,
+        echo=lambda line: print(line, flush=True),
+    )
+    best, control = summary["best"], summary["control"]
+    if best is None:
+        print("no candidate produced a number - tunnel down/wedged?")
+        return 2
+    line = (
+        "best: "
+        + " ".join(f"{k}={v}" for k, v in best["params"].items())
+        + f" at {best['value']:.2f} {summary['metric']}"
+    )
+    if control and control["value"]:
+        line += f" ({best['value'] / control['value']:.3f}x of default)"
+    print(line)
+    if summary["promoted"] is not None:
+        print(
+            f"promoted -> {summary['cache_path']} "
+            f"[{summary['cache_key']}]"
+            + (" (smoke entry: pipeline proof, not a tuning claim)"
+               if args.smoke else "")
+        )
+    else:
+        print(
+            "not promoted: best must beat the default control by "
+            f">{runner.PROMOTE_MARGIN:.0%} on medians (docs/TUNING.md)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,101 +1,50 @@
-"""One-command sgemm tile sweep for a healthy tunnel window.
+"""DEPRECATED thin wrapper: the sgemm tile sweep now lives in the
+autotuning subsystem (docs/TUNING.md).
 
-Usage (AFTER tools/tpu_revalidate.sh has gone green — the queue owns
-the first chip minutes of any window):
+    python tools/sgemm_tune.py [--quick]
+        ==  python tools/autotune.py --kernel sgemm [--quick]
 
-    python tools/sgemm_tune.py            # default grid, ~2 min/config
-    python tools/sgemm_tune.py --quick    # 3 most promising configs
+This entry point is kept so the revalidation docs (docs/NEXT.md,
+BASELINE.md methodology notes) stay valid verbatim. Everything the old
+one-off shell documented moved into the subsystem:
 
-Each config runs in its own killable subprocess via the exact metric
-path of record (`bench.py --one sgemm_gflops` — slope method, median
-of samples, CPU-fallback refusal), with TPK_SGEMM_{BM,BN,BK}
-overriding the tile PREFERENCES (kernels/sgemm.py _env_pref;
-alignment and padding stay with _pick_block). A config whose
-double-buffered VMEM need exceeds the 32 MiB budget fails at remote
-compile — reported as a FAIL row, not a crash, so one bad candidate
-can't eat the window.
+- the grid rationale (bm 128/512 probes the A-reload vs
+  accumulator-locality trade, bk 512 probes accumulator turnarounds,
+  bn 1024 halves B residency, bk 2048 infeasible with bn 2048) is now
+  `kernels/sgemm.py` TUNABLES — the sweep values plus the analytic
+  32 MiB VMEM model that PRUNES the infeasible combos instead of
+  burning a remote-compile failure on them;
+- the killable-subprocess-per-config discipline is
+  `tpukernels/tuning/runner.py` on the resilience watchdog;
+- the ">3% over the control before promoting" guidance is enforced in
+  code (runner.PROMOTE_MARGIN) and the winner lands in the persistent
+  tuning cache, where sgemm dispatch reads it per shape/dtype/device
+  (precedence env > cache > default) — no more manual default edits
+  after a confirming re-run.
 
-Grid rationale (config of record is 1024^3, bf16_3x):
-  - (256, 2048, 1024) is the shipped default = the control row;
-  - bm 128/512 probes the A-reload vs accumulator-locality trade;
-  - bk 512 probes whether 2 accumulator turnarounds beat 1 at looser
-    VMEM pressure; bk 2048 is infeasible with bn 2048 (B hi+lo pair
-    would double past the budget) so it is only paired with bn 1024;
-  - bn 1024 halves B residency to make room for the bk/bm probes.
-A config beating the control by >3% on this sweep's medians is worth
-promoting to the default after a confirming re-run; update the
-docstring arithmetic in _sgemm_padded when you do.
+Run it (like the old tool) only AFTER tools/tpu_revalidate.sh has gone
+green — the queue owns the first chip minutes of any window.
 """
 
-import argparse
-import json
 import os
-import subprocess
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-GRID = [
-    (256, 2048, 1024),  # control: shipped default
-    (128, 2048, 1024),
-    (512, 2048, 1024),
-    (256, 2048, 512),
-    (256, 1024, 1024),
-    (256, 1024, 2048),
-    (512, 1024, 1024),
-]
-QUICK = GRID[:3]
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
 
 
-def run_config(bm, bn, bk, timeout_s=420):
-    env = dict(os.environ)
-    env.update(
-        TPK_SGEMM_BM=str(bm), TPK_SGEMM_BN=str(bn), TPK_SGEMM_BK=str(bk)
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    print(
+        "# sgemm_tune.py is deprecated: forwarding to "
+        "`python tools/autotune.py --kernel sgemm` (docs/TUNING.md)",
+        file=sys.stderr,
     )
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py"), "--one",
-             "sgemm_gflops"],
-            env=env, timeout=timeout_s, cwd=REPO,
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        return None, "timeout (wedge?)"
-    if r.returncode != 0:
-        return None, f"rc={r.returncode} (compile fail / VMEM budget?)"
-    try:
-        return json.loads(r.stdout.strip().splitlines()[-1])["value"], "ok"
-    except (ValueError, KeyError, IndexError):
-        return None, "unparseable output"
+    import autotune
 
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true",
-                    help="only the 3 most promising configs")
-    args = ap.parse_args()
-    grid = QUICK if args.quick else GRID
-
-    rows = []
-    control = None
-    for bm, bn, bk in grid:
-        value, status = run_config(bm, bn, bk)
-        rows.append((bm, bn, bk, value, status))
-        if (bm, bn, bk) == GRID[0] and value:
-            control = value
-        shown = f"{value:9.1f}" if value else f"    FAIL ({status})"
-        print(f"bm={bm:4d} bn={bn:4d} bk={bk:4d}  {shown}", flush=True)
-
-    best = max((r for r in rows if r[3]), key=lambda r: r[3], default=None)
-    if best is None:
-        print("no config produced a number - tunnel down/wedged?")
-        sys.exit(2)
-    bm, bn, bk, value, _ = best
-    line = f"best: bm={bm} bn={bn} bk={bk} at {value:.1f} GFLOPS"
-    if control:
-        line += f" ({value / control:.3f}x of the shipped default)"
-    print(line)
+    return autotune.main(["--kernel", "sgemm", *argv])
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

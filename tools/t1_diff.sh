@@ -1,0 +1,54 @@
+#!/bin/bash
+# Tier-1 compare-by-failure-SET (ROADMAP.md "Tier-1 verify" note).
+#
+# The tier-1 suite always exits rc=1 in this container: ~50
+# pre-existing failures come from jax pallas API drift and other
+# environment facts, not from the change under review. Judging a
+# change by the exit code therefore judges the ENVIRONMENT; the honest
+# gate is the DIFF of failure sets — "no worse than seed" means no
+# test fails now that passed in the seed log.
+#
+# Usage:
+#   tools/t1_diff.sh <seed_t1.log> <current_t1.log>
+#
+# where each log is the raw `pytest -q` output (the ROADMAP tier-1
+# command tees it to /tmp/_t1.log). Lines are matched by test id only
+# (`FAILED path::test` / `ERROR path`) — the truncated reason text
+# after " - " changes with line numbers and is ignored.
+#
+# Exit codes: 0 = no new failures (fixed tests are reported, never
+# penalized); 1 = at least one NEW failure (listed); 2 = usage/IO.
+set -u -o pipefail
+
+if [ $# -ne 2 ] || [ ! -r "$1" ] || [ ! -r "$2" ]; then
+  echo "usage: $0 <seed_t1.log> <current_t1.log> (readable files)" >&2
+  exit 2
+fi
+
+seed_set=$(mktemp) || exit 2
+cur_set=$(mktemp) || exit 2
+trap 'rm -f "$seed_set" "$cur_set"' EXIT
+
+# test id only: strip the " - <reason>" tail, dedupe, sort for comm
+extract() {
+  grep -aE '^(FAILED|ERROR) ' "$1" | sed 's/ - .*//' | sort -u
+}
+extract "$1" > "$seed_set"
+extract "$2" > "$cur_set"
+
+new=$(comm -13 "$seed_set" "$cur_set")
+fixed=$(comm -23 "$seed_set" "$cur_set")
+
+echo "seed failures:    $(wc -l < "$seed_set")"
+echo "current failures: $(wc -l < "$cur_set")"
+if [ -n "$fixed" ]; then
+  echo "fixed since seed ($(echo "$fixed" | wc -l)):"
+  echo "$fixed" | sed 's/^/  /'
+fi
+if [ -n "$new" ]; then
+  echo "NEW failures ($(echo "$new" | wc -l)) - regression:"
+  echo "$new" | sed 's/^/  /'
+  exit 1
+fi
+echo "OK: no new failures vs seed"
+exit 0

@@ -158,6 +158,25 @@ pytest_group rest tests/ \
   --ignore=tests/test_nbody.py --ignore=tests/test_determinism.py \
   --ignore=tests/test_fuzz_shapes.py
 
+# 3b. Autotune pipeline smoke (docs/TUNING.md): proves the sweep ->
+#     cache -> dispatch path end to end on CPU interpret mode. Needs
+#     no tunnel (the --smoke parent scrubs itself and its bench
+#     children off the axon pool), so it never eats a flap window;
+#     non-gating and once per day, like the profiler capture — a
+#     broken TUNER must not block a queue whose measurement gates all
+#     passed. The smoke cache entry is keyed device_kind=cpu and can
+#     never steer a TPU dispatch.
+if ! step_done autotune_smoke; then
+  autotune_log="docs/logs/autotune_smoke_$(date +%Y-%m-%d_%H%M%S).log"
+  if timeout -k 10 600 python tools/autotune.py --kernel sgemm --smoke \
+      >"$autotune_log" 2>&1; then
+    stamp autotune_smoke
+    echo "autotune smoke: OK (pipeline proven; $autotune_log)"
+  else
+    echo "WARN: autotune smoke failed rc=$? (non-gating) - $autotune_log"
+  fi
+fi
+
 # 4. Sanitizer gates (SURVEY.md §5): ASan then UBSan rebuilds, full
 #    gate incl. the embedded-CPython shim rows on a scrubbed CPU env
 #    (kernels auto-interpret there), then restore the normal build.

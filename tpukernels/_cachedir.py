@@ -30,3 +30,22 @@ def ensure_compilation_cache(env: dict | None = None) -> str:
         "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
     )
     return target["JAX_COMPILATION_CACHE_DIR"]
+
+
+def tuning_cache_path(env: dict | None = None) -> str:
+    """Path of the persistent tuning cache (docs/TUNING.md §cache).
+
+    Lives beside the compilation cache under the same root — one
+    ``tuning.json`` per cache dir — unless ``TPK_TUNING_CACHE_DIR``
+    redirects it (tests and throwaway sweeps point it at a tmp dir so
+    they never touch the repo's real tuned params). Reading the env on
+    every call, not at import, keeps the redirect effective for
+    monkeypatched tests.
+    """
+    target = os.environ if env is None else env
+    d = target.get("TPK_TUNING_CACHE_DIR")
+    if not d:
+        d = target.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            _REPO, ".jax_cache"
+        )
+    return os.path.join(d, "tuning.json")

@@ -1,0 +1,36 @@
+"""Single choke point for JAX/Pallas API drift (the 0.4.x <-> 0.9 port).
+
+The kernels were written against the newer Pallas TPU surface; the
+environment they run in may ship an older jax. Every symbol that has
+been renamed across that span is resolved HERE, once, so a drift hit
+is one edit in this file instead of a sweep over every kernel module.
+Kernel modules import ``pl``/``pltpu``/``CompilerParams`` from here and
+never touch ``jax.experimental.pallas`` directly for drift-prone names.
+
+Known drift resolved today:
+
+- ``pltpu.CompilerParams`` (jax >= 0.7) vs ``pltpu.TPUCompilerParams``
+  (jax 0.4.x, e.g. the 0.4.37 this container bakes in). Same fields
+  either way (``dimension_semantics``, ``vmem_limit_bytes``, ...), so
+  the alias is a plain name fix, not an adapter.
+
+Import-order note: this module imports jax, so it must NOT be imported
+by ``import tpukernels`` (registry stays lazy / jax-free). Only kernel
+modules and other already-jax-bound code may import it.
+"""
+
+from __future__ import annotations
+
+from jax.experimental import pallas as pl  # noqa: F401  (re-export)
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (re-export)
+
+# the rename: prefer the current name, fall back to the 0.4.x one
+CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+if CompilerParams is None:  # pragma: no cover - would mean a 3rd rename
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams - a new Pallas API drift; teach "
+        "tpukernels/compat.py the new name"
+    )

@@ -35,19 +35,38 @@ Out-of-range values (and the padding the wrappers add) count nothing.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from tpukernels.compat import pl, pltpu
+from tpukernels.tuning import SearchSpace, Tunable, resolve
 from tpukernels.utils import cdiv, default_interpret
 from tpukernels.utils.shapes import LANES
 
 _BLOCK_ROWS = 256
 _MXU_BM = 2048  # rows per grid block on the MXU path
 _MXU_T = 16  # (8, 128) tiles lane-concatenated per matmul (K = 2048)
+
+# Declarative search space (docs/TUNING.md): both knobs are
+# categorical. impl's default is None — it is nbins-dependent (mxu
+# only exists for nbins <= 256), so the kernel computes the fallback;
+# env/cache values still resolve through the same precedence. The
+# scan_hist metric drives scan AND histogram together (see
+# kernels/scan.py TUNABLES note).
+TUNABLES = SearchSpace(
+    kernel="histogram",
+    metric="scan_hist_melem_s",
+    bench_shape=(1 << 22, 256),
+    bench_dtype="int32",
+    sources=("tpukernels/kernels/histogram.py",),
+    tunables=(
+        Tunable("impl", env="TPK_HIST_IMPL", default=None,
+                values=("mxu", "vpu"), choice=True),
+        Tunable("acc", env="TPK_HIST_ACC", default="i8",
+                values=("i8", "f32"), choice=True),
+    ),
+)
 
 
 # ------------------------------------------------------------ MXU path
@@ -194,29 +213,27 @@ def _hist_2d(x2, nbins, acc_name="i8", interpret=False):
 def histogram(x, nbins: int, interpret: bool | None = None):
     """Count int32 values in [0, nbins); returns (nbins,) int32.
 
-    Env knobs (read here, outside jit, so toggling is never masked by
-    a cached trace): TPK_HIST_IMPL picks the path — 'mxu' (nibble
-    matmuls; default for nbins <= 256) or 'vpu' (broadcast compares;
-    the only choice above 256 bins). TPK_HIST_ACC picks the VPU
-    path's one-hot accumulator dtype: 'i8' (default) or 'f32'.
-    Counts are exact on every path."""
+    Impl/accumulator knobs resolve through the tuning subsystem
+    (resolved here, outside jit, so toggling is never masked by a
+    cached trace; precedence env > tuned cache > default):
+    TPK_HIST_IMPL picks the path — 'mxu' (nibble matmuls; default for
+    nbins <= 256) or 'vpu' (broadcast compares; the only choice above
+    256 bins). TPK_HIST_ACC picks the VPU path's one-hot accumulator
+    dtype: 'i8' (default) or 'f32'. Counts are exact on every path."""
     if interpret is None:
         interpret = default_interpret()
-    impl = os.environ.get("TPK_HIST_IMPL", "mxu" if nbins <= 256 else "vpu")
-    if impl not in ("mxu", "vpu"):
-        raise ValueError(
-            f"TPK_HIST_IMPL={impl!r}: expected 'mxu' or 'vpu'"
-        )
+    params = resolve(
+        TUNABLES, shape=(int(x.size), int(nbins)), dtype="int32"
+    )
+    impl = params["impl"]
+    if impl is None:
+        impl = "mxu" if nbins <= 256 else "vpu"
     if impl == "mxu" and nbins > 256:
         raise ValueError(
             f"TPK_HIST_IMPL=mxu supports nbins <= 256, got {nbins} "
             "(the hi/lo nibble decomposition is 16x16)"
         )
-    acc_name = os.environ.get("TPK_HIST_ACC", "i8")
-    if acc_name not in ("i8", "f32"):
-        raise ValueError(
-            f"TPK_HIST_ACC={acc_name!r}: expected 'i8' or 'f32'"
-        )
+    acc_name = params["acc"]
     x = x.reshape(-1).astype(jnp.int32)
     n = x.size
     if n == 0:

@@ -26,14 +26,45 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from tpukernels.compat import pl, pltpu
+from tpukernels.tuning import SearchSpace, Tunable, resolve
 from tpukernels.utils import cdiv, default_interpret
 from tpukernels.utils.shapes import LANES
 
 _BI = 256  # i-bodies per grid step
 _BJ = 2048  # j-bodies per inner chunk
+
+
+def _vmem_bytes(params, shape=None):
+    """Resident j-set (4 SoA f32 arrays over n bodies) + the (bi, bj)
+    pairwise VPU temporaries (~6 live at once: dx/dy/dz/r2/inv_r/w) +
+    the streamed (1, bi) i/out tiles (negligible). Shape-aware: the
+    j-set term is what actually scales."""
+    n = shape[0] if shape else 1 << 16
+    n_pad = cdiv(n, LANES) * LANES
+    return 4 * n_pad * 4 + 6 * params["bi"] * params["bj"] * 4
+
+
+# Declarative search space (docs/TUNING.md). bi trades grid-step count
+# against the (bi, bj) VPU tile's register/VMEM pressure; bj trades
+# inner-loop trip count against the same. Defaults are the shipped
+# GPU-Gems-style tiling the baseline was measured at.
+TUNABLES = SearchSpace(
+    kernel="nbody",
+    metric="nbody_ginter_s",
+    bench_shape=(1 << 16,),
+    bench_dtype="float32",
+    sources=("tpukernels/kernels/nbody.py",),
+    tunables=(
+        Tunable("bi", env="TPK_NBODY_BI", default=_BI,
+                values=(256, 128, 512)),
+        Tunable("bj", env="TPK_NBODY_BJ", default=_BJ,
+                values=(2048, 1024, 4096)),
+    ),
+    vmem_budget_bytes=64 * 1024 * 1024,
+    vmem_bytes=_vmem_bytes,
+)
 
 
 def _forces_kernel(n_pad, bi, bj, eps2_ref, xi_ref, yi_ref, zi_ref,
@@ -72,10 +103,18 @@ def _forces_kernel(n_pad, bi, bj, eps2_ref, xi_ref, yi_ref, zi_ref,
     az_ref[:] = az.reshape(1, bi)
 
 
-def _forces(px, py, pz, m, eps2, interpret):
+def _forces(px, py, pz, m, eps2, bi, bj, interpret):
     n_pad = px.shape[1]
-    bi = min(_BI, n_pad)
-    bj = min(_BJ, n_pad)
+    bi = min(bi, n_pad)
+    bj = min(bj, n_pad)
+    # the j-sweep advances in exact bj strides (pl.ds over the resident
+    # arrays): a bj that doesn't divide n_pad would silently drop the
+    # remainder bodies, so lane-align the preference and degrade to the
+    # next 128-multiple that divides (terminates at 128 — n_pad is
+    # always a LANES multiple)
+    bj = max(LANES, bj // LANES * LANES)
+    while n_pad % bj:
+        bj -= LANES
     grid = (cdiv(n_pad, bi),)
     ispec = pl.BlockSpec((1, bi), lambda i: (0, i), memory_space=pltpu.VMEM)
     jspec = pl.BlockSpec(memory_space=pltpu.VMEM)  # whole array resident
@@ -97,12 +136,13 @@ def _forces(px, py, pz, m, eps2, interpret):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("steps", "interpret")
+    jax.jit, static_argnames=("steps", "bi", "bj", "interpret")
 )
-def _nbody_jit(px, py, pz, vx, vy, vz, m, dt, eps2, steps, interpret):
+def _nbody_jit(px, py, pz, vx, vy, vz, m, dt, eps2, steps, bi, bj,
+               interpret):
     def step(_, s):
         px, py, pz, vx, vy, vz = s
-        ax, ay, az = _forces(px, py, pz, m, eps2, interpret)
+        ax, ay, az = _forces(px, py, pz, m, eps2, bi, bj, interpret)
         vx = vx + ax * dt
         vy = vy + ay * dt
         vz = vz + az * dt
@@ -117,10 +157,16 @@ def _nbody_jit(px, py, pz, vx, vy, vz, m, dt, eps2, steps, interpret):
 def nbody_step(px, py, pz, vx, vy, vz, m, dt=1e-3, eps=1e-2, steps=1,
                interpret: bool | None = None):
     """Advance N bodies `steps` leapfrog steps. 1-D float32 SoA inputs;
-    returns updated (px, py, pz, vx, vy, vz)."""
+    returns updated (px, py, pz, vx, vy, vz).
+
+    Tile sizes resolve through the tuning subsystem (env
+    TPK_NBODY_{BI,BJ} > tuned cache for this shape/dtype/device >
+    shipped defaults 256/2048); _forces clamps them to the padded
+    body count and bj to an exact stride."""
     if interpret is None:
         interpret = default_interpret()
     n = px.size
+    tiles = resolve(TUNABLES, shape=(n,), dtype=px.dtype.name)
     pad = cdiv(n, LANES) * LANES - n
     arrs = [a.reshape(1, -1) for a in (px, py, pz, vx, vy, vz, m)]
     if pad:
@@ -129,7 +175,8 @@ def nbody_step(px, py, pz, vx, vy, vz, m, dt=1e-3, eps=1e-2, steps=1,
     px2, py2, pz2, vx2, vy2, vz2, m2 = arrs
     out = _nbody_jit(
         px2, py2, pz2, vx2, vy2, vz2, m2,
-        jnp.float32(dt), jnp.float32(eps * eps), int(steps), interpret
+        jnp.float32(dt), jnp.float32(eps * eps), int(steps),
+        tiles["bi"], tiles["bj"], interpret
     )
     return tuple(a.reshape(-1)[:n] for a in out)
 

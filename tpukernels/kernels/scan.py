@@ -33,13 +33,40 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from tpukernels.compat import pl, pltpu
+from tpukernels.tuning import SearchSpace, Tunable, resolve
 from tpukernels.utils import cdiv, default_interpret
 from tpukernels.utils.shapes import LANES
 
 _BLOCK_ROWS = 256
+
+
+def _vmem_bytes(params, shape=None):
+    """Streamed in/out int32 blocks, pipeline double-buffered, plus
+    the (bm, 128) triangular-ones matmul operands — small at every
+    sweep value; the model keeps the axis budget-honest."""
+    bm = params["rows"]
+    return 2 * 2 * bm * LANES * 4 + LANES * LANES * 4
+
+
+# Declarative search space (docs/TUNING.md). rows trades grid-step
+# overhead against the (bm, 128) MXU scan matmul's tile size. The
+# scan_hist bench metric drives scan AND histogram together, so a
+# promotion here reflects the combined loop — documented in TUNING.md.
+TUNABLES = SearchSpace(
+    kernel="scan",
+    metric="scan_hist_melem_s",
+    bench_shape=(1 << 22,),
+    bench_dtype="int32",
+    sources=("tpukernels/kernels/scan.py",),
+    tunables=(
+        Tunable("rows", env="TPK_SCAN_ROWS", default=_BLOCK_ROWS,
+                values=(256, 128, 512)),
+    ),
+    vmem_budget_bytes=16 * 1024 * 1024,
+    vmem_bytes=_vmem_bytes,
+)
 
 
 def _cumsum_log(x, axis: int):
@@ -122,10 +149,10 @@ def _scan_kernel(x_ref, o_ref, carry_ref):
     carry_ref[0] = carry_ref[0] + jnp.sum(row_tot)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _scan_2d(x2, interpret=False):
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _scan_2d(x2, block_rows=_BLOCK_ROWS, interpret=False):
     rows = x2.shape[0]
-    bm = min(_BLOCK_ROWS, rows)
+    bm = min(block_rows, rows)
     grid = (cdiv(rows, bm),)
     return pl.pallas_call(
         _scan_kernel,
@@ -143,17 +170,24 @@ def _scan_2d(x2, interpret=False):
 
 
 def inclusive_scan(x, interpret: bool | None = None):
-    """Inclusive prefix sum of a 1-D array (float32 or int32)."""
+    """Inclusive prefix sum of a 1-D array (float32 or int32).
+
+    Block rows resolve through the tuning subsystem (env
+    TPK_SCAN_ROWS > tuned cache for this shape/dtype/device >
+    shipped default 256)."""
     if interpret is None:
         interpret = default_interpret()
     n = x.size
+    block_rows = resolve(TUNABLES, shape=(n,), dtype=x.dtype.name)["rows"]
     x = x.reshape(-1)
     rows = max(cdiv(n, LANES), 1)
-    bm = min(_BLOCK_ROWS, rows)  # mirrors _scan_2d's choice
+    bm = min(block_rows, rows)  # mirrors _scan_2d's choice
     padded = cdiv(rows, bm) * bm * LANES
     if padded != n:
         x = jnp.pad(x, (0, padded - n))  # zeros don't disturb the scan
-    out = _scan_2d(x.reshape(-1, LANES), interpret=interpret)
+    out = _scan_2d(
+        x.reshape(-1, LANES), block_rows=block_rows, interpret=interpret
+    )
     return out.reshape(-1)[:n]
 
 

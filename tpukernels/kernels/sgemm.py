@@ -32,31 +32,63 @@ import os
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+# drift-prone Pallas names resolve through the compat choke point
+# (tpukernels/compat.py): this env may ship pltpu.TPUCompilerParams
+# (jax 0.4.x) where the code was written against CompilerParams
+from tpukernels.compat import CompilerParams, pl, pltpu
+from tpukernels.tuning import SearchSpace, Tunable, resolve
 from tpukernels.utils import cdiv, default_interpret
 
 
-def _env_pref(name: str, default: int) -> int:
-    """Tile-preference override (TPK_SGEMM_{BM,BN,BK}) for the on-chip
-    tuner (tools/sgemm_tune.py). Overrides the PREFERRED size handed
-    to _pick_block, not the raw block — alignment and padding safety
-    stay with the picker. Fail-loud on garbage, like every other TPK_*
-    knob. NOTE: larger bn/bk raise the double-buffered VMEM need past
-    the 32 MiB budget documented in _sgemm_padded; an infeasible
-    combo fails at (remote) compile time, which the tuner reports as
-    a FAIL row rather than a number."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        val = int(raw)
-    except ValueError:
-        val = 0
-    if val <= 0:
-        raise ValueError(f"{name}={raw!r}: expected a positive integer")
-    return val
+def _vmem_bytes(params, shape=None):
+    """Analytic double-buffered VMEM need of a (bm, bn, bk) tile
+    PREFERENCE — the 32 MiB arithmetic the old tools/sgemm_tune.py
+    documented in prose, now the search space's feasibility filter.
+
+    Model (bf16_3x, the config of record): the K-streamed A and B
+    hi/lo bf16 block pairs are pipeline double-buffered (x2); the C
+    and out f32 blocks revisit per (i, j) and count once, as does the
+    f32 accumulator scratch:
+
+        8*bm*bk  (A hi+lo, buffered) + 8*bk*bn  (B hi+lo, buffered)
+        + 12*bm*bn  (C + out + acc)
+
+    Control (256, 2048, 1024) = 24 MiB inside the 32 MiB budget;
+    bn=2048 with bk=2048 puts B alone at 32 MiB — the combination the
+    old tuner grid documented as infeasible. Deliberately SHAPE-BLIND
+    (`shape` ignored): _pick_block clamps preferences per dim at call
+    time, so a clamped candidate is merely redundant in a sweep, never
+    wrong — while shape-aware arithmetic at the 1024^3 config of
+    record would clamp everything feasible and stop pruning the
+    combos that matter at larger N."""
+    bm, bn, bk = params["bm"], params["bn"], params["bk"]
+    return 8 * bm * bk + 8 * bk * bn + 12 * bm * bn
+
+
+# Declarative search space (docs/TUNING.md): sweep values carry the
+# old tools/sgemm_tune.py grid rationale — bm 128/512 probes the
+# A-reload vs accumulator-locality trade, bk 512 probes accumulator
+# turnarounds at looser VMEM pressure, bn 1024 halves B residency to
+# make room for the bk/bm probes; defaults-first ordering makes the
+# control row the sweep's first candidate and --quick's base.
+TUNABLES = SearchSpace(
+    kernel="sgemm",
+    metric="sgemm_gflops",
+    bench_shape=(1024, 1024, 1024),
+    bench_dtype="float32",
+    sources=("tpukernels/kernels/sgemm.py",),
+    tunables=(
+        Tunable("bm", env="TPK_SGEMM_BM", default=256,
+                values=(256, 128, 512)),
+        Tunable("bn", env="TPK_SGEMM_BN", default=2048,
+                values=(2048, 1024)),
+        Tunable("bk", env="TPK_SGEMM_BK", default=1024,
+                values=(1024, 512, 2048)),
+    ),
+    vmem_budget_bytes=32 * 1024 * 1024,
+    vmem_bytes=_vmem_bytes,
+)
 
 
 def _pick_block(dim: int, preferred: int, align: int) -> int:
@@ -166,7 +198,7 @@ def _sgemm_padded(
         grid=grid,
         out_specs=c_spec,
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             # The tall-K blocks need ~28 MiB once double-buffered at
             # the widest case (B hi+lo at 1024x2048 bf16 is 8 MiB
@@ -234,9 +266,15 @@ def sgemm(
     # TFLOPS vs 52.7 with bn=1024); past 2048, B's double-buffered
     # hi+lo pair would blow the 32 MiB VMEM budget. Small bm keeps
     # A+C+acc in the remaining headroom.
-    bm = _pick_block(m, _env_pref("TPK_SGEMM_BM", 256), 8)
-    bn = _pick_block(n, _env_pref("TPK_SGEMM_BN", 2048), 128)
-    bk = _pick_block(k, _env_pref("TPK_SGEMM_BK", 1024), 128)
+    #
+    # Tile PREFERENCES resolve through the tuning subsystem (env
+    # TPK_SGEMM_{BM,BN,BK} > tuned cache entry for this
+    # shape/dtype/device > the TUNABLES defaults above); alignment
+    # and padding safety stay with _pick_block either way.
+    prefs = resolve(TUNABLES, shape=(m, k, n), dtype=a.dtype.name)
+    bm = _pick_block(m, prefs["bm"], 8)
+    bn = _pick_block(n, prefs["bn"], 128)
+    bk = _pick_block(k, prefs["bk"], 128)
     pm, pn, pk = (cdiv(m, bm) * bm, cdiv(n, bn) * bn, cdiv(k, bk) * bk)
     if (pm, pk) != (m, k):
         a = jnp.pad(a, ((0, pm - m), (0, pk - k)))

@@ -45,17 +45,54 @@ import sys
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+# drift-prone Pallas names resolve through the compat choke point
+# (tpukernels/compat.py): this env may ship pltpu.TPUCompilerParams
+# (jax 0.4.x) where the code was written against CompilerParams
+from tpukernels.compat import CompilerParams, pl, pltpu
+from tpukernels.tuning import SearchSpace, Tunable, resolve
 from tpukernels.utils import cdiv, default_interpret
 from tpukernels.utils.shapes import LANES
+
+# Declarative search spaces (docs/TUNING.md): the temporal-blocking
+# depth k (sweeps fused per HBM pass) is the one knob worth sweeping —
+# docs/PERF.md records k>8 as VPU-bound (parked, docs/NEXT.md item 4),
+# so the sweep stays within the ghost-band bound. Slab geometry
+# (bm/bz) self-adapts to the VMEM budget in the pickers below and is
+# deliberately NOT a tunable: an env-forced slab that ignores the
+# budget arithmetic would fail remote compile, not run slower. No
+# vmem model for the same reason — every candidate is feasible by
+# construction.
+TUNABLES = (
+    SearchSpace(
+        kernel="stencil2d",
+        metric="stencil2d_mcells_s",
+        bench_shape=(4096, 4096),
+        bench_dtype="float32",
+        sources=("tpukernels/kernels/stencil.py",),
+        tunables=(
+            Tunable("k", env="TPK_STENCIL_K", default=8,
+                    values=(8, 6, 4, 2)),
+        ),
+    ),
+    SearchSpace(
+        kernel="stencil3d",
+        metric="stencil3d_mcells_s",
+        bench_shape=(384, 384, 384),
+        bench_dtype="float32",
+        sources=("tpukernels/kernels/stencil.py",),
+        tunables=(
+            Tunable("k", env="TPK_STENCIL_K", default=8,
+                    values=(8, 6, 4, 2)),
+        ),
+    ),
+)
 
 _SMALL_BYTES = 4 * 1024 * 1024  # whole-grid-in-VMEM threshold
 _VMEM_BUDGET = 10 * 1024 * 1024  # slab + (pipelined) out blocks must fit
 # temporal blocking materializes a few full-slab temporaries per fused
 # sweep; the default 16 MiB Mosaic scoped-vmem limit is too tight
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+_COMPILER_PARAMS = CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
 
 def _pick_bm(wp: int) -> int:
@@ -207,13 +244,14 @@ def jacobi2d(
     """Run `iters` Jacobi 5-point sweeps on (H, W) float32.
 
     `k` is the temporal-blocking depth (sweeps fused per HBM pass) for
-    the blocked path, 1..8; default 8, or env TPK_STENCIL_K."""
+    the blocked path, 1..8; default 8, resolved via the tuning
+    subsystem (env TPK_STENCIL_K > tuned cache > default)."""
     if interpret is None:
         interpret = default_interpret()
-    if k is None:
-        k = int(os.environ.get("TPK_STENCIL_K", "8"))
-    k = max(1, min(k, _GHOST2D))
     h, w = x.shape
+    if k is None:
+        k = resolve(TUNABLES[0], shape=(h, w), dtype=x.dtype.name)["k"]
+    k = max(1, min(k, _GHOST2D))
     wp = max(cdiv(w, LANES) * LANES, LANES)
     bm = _pick_bm(wp)
     # blocked purely by size: the small path holds the whole grid in
@@ -367,13 +405,14 @@ def jacobi3d(
     """Run `iters` Jacobi 7-point sweeps on (D, H, W) float32.
 
     `k` is the temporal-blocking depth (sweeps fused per HBM pass) for
-    the blocked path; default 8, or env TPK_STENCIL_K."""
+    the blocked path; default 8, resolved via the tuning subsystem
+    (env TPK_STENCIL_K > tuned cache > default)."""
     if interpret is None:
         interpret = default_interpret()
-    if k is None:
-        k = int(os.environ.get("TPK_STENCIL_K", "8"))
-    k = max(1, min(k, 8))
     d, h, w = x.shape
+    if k is None:
+        k = resolve(TUNABLES[1], shape=(d, h, w), dtype=x.dtype.name)["k"]
+    k = max(1, min(k, 8))
     wp = max(cdiv(w, LANES) * LANES, LANES)
     hp8 = cdiv(h, 8) * 8
     # joint (k, bz) pick: wide planes shrink bz toward its floor of 1,

@@ -24,24 +24,50 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from tpukernels.compat import pl, pltpu
+from tpukernels.tuning import SearchSpace, Tunable, resolve
 from tpukernels.utils import cdiv, default_interpret
 from tpukernels.utils.shapes import LANES
 
 _BLOCK_ROWS = 512  # (512, 128) f32 block = 256 KiB per operand in VMEM
 
 
+def _vmem_bytes(params, shape=None):
+    """3 streamed f32 blocks (x, y, out — y aliases out but XLA may
+    keep a defensive copy), pipeline double-buffered (docs/TUNING.md).
+    Generous headroom at every sweep value; the model exists so the
+    sweep axis stays budget-honest if values grow."""
+    return 2 * 3 * params["rows"] * LANES * 4
+
+
+# Declarative search space (docs/TUNING.md). rows trades grid-step
+# overhead against VMEM residency; 512 is the shipped default the
+# 655 GB/s capture was measured at.
+TUNABLES = SearchSpace(
+    kernel="vector_add",
+    metric="saxpy_gb_s",
+    bench_shape=(1 << 20,),
+    bench_dtype="float32",
+    sources=("tpukernels/kernels/vector_add.py",),
+    tunables=(
+        Tunable("rows", env="TPK_SAXPY_ROWS", default=_BLOCK_ROWS,
+                values=(512, 256, 1024, 2048)),
+    ),
+    vmem_budget_bytes=16 * 1024 * 1024,
+    vmem_bytes=_vmem_bytes,
+)
+
+
 def _saxpy_kernel(alpha_ref, x_ref, y_ref, o_ref):
     o_ref[:] = alpha_ref[0, 0] * x_ref[:] + y_ref[:]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _saxpy_2d(alpha, x2, y2, interpret=False):
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _saxpy_2d(alpha, x2, y2, block_rows=_BLOCK_ROWS, interpret=False):
     rows = x2.shape[0]
-    grid = (cdiv(rows, _BLOCK_ROWS),)
-    block = (min(_BLOCK_ROWS, rows), LANES)
+    grid = (cdiv(rows, block_rows),)
+    block = (min(block_rows, rows), LANES)
     return pl.pallas_call(
         _saxpy_kernel,
         out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
@@ -58,10 +84,15 @@ def _saxpy_2d(alpha, x2, y2, interpret=False):
 
 
 def saxpy(alpha, x, y, interpret: bool | None = None):
-    """y_out = alpha*x + y for 1-D float arrays of any length."""
+    """y_out = alpha*x + y for 1-D float arrays of any length.
+
+    Block rows resolve through the tuning subsystem (env
+    TPK_SAXPY_ROWS > tuned cache for this shape/dtype/device >
+    shipped default 512)."""
     if interpret is None:
         interpret = default_interpret()
     n = x.size
+    rows = resolve(TUNABLES, shape=(n,), dtype=x.dtype.name)["rows"]
     x = x.reshape(-1)
     y = y.reshape(-1)
     padded = cdiv(n, LANES) * LANES
@@ -71,7 +102,7 @@ def saxpy(alpha, x, y, interpret: bool | None = None):
     x2 = x.reshape(-1, LANES)
     y2 = y.reshape(-1, LANES)
     alpha2 = jnp.asarray(alpha, dtype=x.dtype).reshape(1, 1)
-    out = _saxpy_2d(alpha2, x2, y2, interpret=interpret)
+    out = _saxpy_2d(alpha2, x2, y2, block_rows=rows, interpret=interpret)
     return out.reshape(-1)[:n]
 
 

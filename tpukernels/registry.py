@@ -9,6 +9,21 @@ Population is lazy: kernel modules (and with them JAX and the TPU
 runtime) are only imported on the first lookup()/names() call, so a C
 host embedding Python pays nothing for `import tpukernels` until it
 actually dispatches a kernel.
+
+Tuning integration (docs/TUNING.md): kernel modules export declarative
+``TUNABLES`` search spaces, registered here alongside the callables
+(``tunables(name)`` / ``tunable_kernels()``). Dispatch consults the
+persistent tuning cache at kernel RESOLUTION time — each kernel
+wrapper calls ``tpukernels.tuning.resolve`` per call with its actual
+shape/dtype, and ``resolve_params(name, shape, dtype)`` exposes the
+same path for introspection — with documented precedence:
+
+    env-override  >  tuned-cache  >  shipped-default
+
+i.e. a set ``TPK_*`` knob always wins, else a validated cache entry
+for (kernel, shape, dtype, device_kind), else the defaults the module
+ships. Resolution lives in the wrapper, not in lookup(): the cache is
+keyed per shape/dtype, which only exist at call time.
 """
 
 from __future__ import annotations
@@ -17,10 +32,13 @@ from typing import Callable, Dict
 
 # stdlib-only (no jax), so importing it here keeps `import tpukernels`
 # jax-free; gives _populate its fault-injection point and journals
-# real import failures as health events (docs/RESILIENCE.md)
+# real import failures as health events (docs/RESILIENCE.md).
+# tuning.space is likewise stdlib-only at import time.
 from tpukernels.resilience import faults, journal
+from tpukernels.tuning import space as _tuning_space
 
 _REGISTRY: Dict[str, Callable] = {}
+_TUNABLES: Dict[str, "_tuning_space.SearchSpace"] = {}
 _IMPORT_ERRORS: Dict[str, BaseException] = {}  # kernel -> why it's absent
 _POPULATED = False
 
@@ -42,6 +60,34 @@ def lookup(name: str) -> Callable:
 def names():
     _populate()
     return sorted(_REGISTRY)
+
+
+def tunables(name: str) -> "_tuning_space.SearchSpace":
+    """The declarative search space a kernel module exported for
+    `name` (docs/TUNING.md §schema). KeyError for kernels without one
+    (derived entries like scan_exclusive tune through their base
+    kernel's space)."""
+    _populate()
+    try:
+        return _TUNABLES[name]
+    except KeyError:
+        raise KeyError(
+            f"kernel {name!r} exports no TUNABLES; tunable kernels: "
+            f"{sorted(_TUNABLES)}"
+        ) from None
+
+
+def tunable_kernels():
+    _populate()
+    return sorted(_TUNABLES)
+
+
+def resolve_params(name: str, shape=None, dtype=None) -> dict:
+    """Resolved tunable values for one prospective `name` call at
+    (shape, dtype), with the documented precedence env-override >
+    tuned-cache > shipped-default — the same path the kernel wrapper
+    takes at dispatch, exposed for tools and tests."""
+    return _tuning_space.resolve(tunables(name), shape=shape, dtype=dtype)
 
 
 def _populate():
@@ -73,18 +119,27 @@ def _populate():
             if required:
                 raise
 
+    def _spaces(mod):
+        # search spaces register beside the callables so one failed
+        # group leaves the others' tuning surface intact too
+        for sp in _tuning_space.spaces_of(mod):
+            _TUNABLES[sp.kernel] = sp
+
     def _load_core():
         import tpukernels.kernels.vector_add as _vector_add
         import tpukernels.kernels.sgemm as _sgemm
 
         _REGISTRY["vector_add"] = _vector_add.saxpy
         _REGISTRY["sgemm"] = _sgemm.sgemm
+        _spaces(_vector_add)
+        _spaces(_sgemm)
 
     def _load_stencil():
         import tpukernels.kernels.stencil as _stencil
 
         _REGISTRY["stencil2d"] = _stencil.jacobi2d
         _REGISTRY["stencil3d"] = _stencil.jacobi3d
+        _spaces(_stencil)
 
     def _load_scan_hist():
         import tpukernels.kernels.scan as _scan
@@ -93,11 +148,14 @@ def _populate():
         _REGISTRY["scan"] = _scan.inclusive_scan
         _REGISTRY["scan_exclusive"] = _scan.exclusive_scan
         _REGISTRY["histogram"] = _histogram.histogram
+        _spaces(_scan)
+        _spaces(_histogram)
 
     def _load_nbody():
         import tpukernels.kernels.nbody as _nbody
 
         _REGISTRY["nbody"] = _nbody.nbody_step
+        _spaces(_nbody)
 
     _group(("vector_add", "sgemm"), _load_core, required=True)
     _group(("stencil2d", "stencil3d"), _load_stencil)

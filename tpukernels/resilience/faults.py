@@ -21,7 +21,11 @@ contract and examples):
   phase (``operand`` | ``compile`` | ``execute``), immune to SIGALRM
   exactly like a wedged C-level PJRT call, so only the parent's hard
   kill can reap it. Omitting ``"metric"`` matches any metric;
-  ``"phase"`` defaults to ``execute``.
+  ``"phase"`` defaults to ``execute``. An optional ``"env": {"VAR":
+  "value", ...}`` narrows the match to processes whose environment
+  carries exactly those values — how the tuning chaos tests wedge ONE
+  sweep candidate (candidates differ only by their TPK_* knobs) while
+  its siblings run clean.
 - ``"fail_metric": {...}`` — same matching, but raises instead of
   hanging (the child errors loudly — the NON-wedge failure mode).
 - ``"fail_import": "nbody"`` — registry._populate's group containing
@@ -143,6 +147,12 @@ def phase_fault(phase: str):
         if want is not None and want != _CURRENT_METRIC:
             continue
         if spec.get("phase", "execute") != phase:
+            continue
+        want_env = spec.get("env")
+        if want_env and any(
+            os.environ.get(k) != v for k, v in want_env.items()
+        ):
+            # env-narrowed spec: this process is not the target
             continue
         journal.emit(
             "fault_injected",

@@ -1,0 +1,34 @@
+"""Autotuning subsystem (docs/TUNING.md).
+
+Three layers, each importable on its own:
+
+- ``space``  — declarative search spaces. Each kernel module exports
+  ``TUNABLES`` (a :class:`~tpukernels.tuning.space.SearchSpace`, or a
+  tuple of them for multi-kernel modules) naming its tunable knobs,
+  their env-var spellings, shipped defaults, sweep values, and an
+  analytic VMEM-budget model that prunes infeasible candidates before
+  they burn chip time. ``space.resolve`` is the single param-resolution
+  path every kernel wrapper calls, with documented precedence
+  env-override > tuned-cache > shipped-default.
+- ``cache``  — the persistent JSON tuning cache under the
+  ``_cachedir`` root, keyed by (kernel, shape, dtype, device_kind) and
+  validated against the jax version and the HEAD sha of the kernel's
+  sources (git-epoch invalidation, mirroring bench.py's evidence
+  rules: params tuned on pre-change code are rejected loudly, never
+  silently applied).
+- ``runner`` — the sweep driver behind ``tools/autotune.py``: each
+  candidate runs through the real metric path (``bench.py --one``) in
+  a killable subprocess via the resilience watchdog, journaling
+  ``tuning_candidate``/``tuning_promoted`` health events; ``--smoke``
+  exercises the whole pipeline on CPU interpret mode for CI.
+
+This package is stdlib-only at import time (jax is imported lazily
+inside functions) so ``tpukernels.registry`` can import it without
+breaking the ``import tpukernels`` jax-free contract.
+"""
+
+from tpukernels.tuning.space import (  # noqa: F401
+    SearchSpace,
+    Tunable,
+    resolve,
+)

@@ -1,0 +1,250 @@
+"""Persistent tuning cache (docs/TUNING.md §cache).
+
+One JSON file under the ``_cachedir`` root (``tuning.json``, path via
+``TPK_TUNING_CACHE_DIR`` override for tests/sweeps) holding one entry
+per key
+
+    kernel|shape|dtype|device_kind      e.g. sgemm|1024x1024x1024|float32|cpu
+
+Each entry records the promoted params plus the evidence that scoped
+them: the jax version, the sha of the last commit touching the
+kernel's sources, the repo HEAD at promotion time, the measured value
+and control, a wall-clock stamp, and whether it came from a --smoke
+run (smoke entries are honored only under ``TPK_BENCH_SMOKE=1`` —
+their params were picked by meaningless collapsed-repeat values).
+``get`` re-validates jax version and source sha at READ time —
+git-epoch invalidation mirroring bench.py's evidence rules: params
+tuned on pre-change kernel code are rejected (loudly: stderr note +
+``tuning_rejected`` journal event), never silently applied. Outside a
+git checkout (sha unavailable) the sha check is skipped — the cache
+then degrades to version-scoped, which installs without history can
+live with.
+
+Reads are memoized on (mtime, size) so a kernel wrapper consulting the
+cache per call costs dict lookups, not file I/O. Writes are atomic
+(tmp + rename) read-modify-write.
+
+``TPK_TUNING_CACHE=0`` (or ``off``/``none``) disables lookups — kernels
+then run env overrides / shipped defaults only; the sweep runner sets
+it for its bench children so a half-written cache can never steer the
+sweep measuring it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from tpukernels import _cachedir
+from tpukernels.resilience import journal
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_DISABLED = ("0", "off", "none")
+_FILE_MEMO: dict = {}  # path -> (stat_key, parsed)
+_SHA_MEMO: dict = {}  # (root, sources) -> sha_or_None
+_REJECT_NOTED: set = set()  # (key, reason) already surfaced this process
+
+
+def enabled() -> bool:
+    raw = os.environ.get("TPK_TUNING_CACHE")
+    return raw is None or raw.strip().lower() not in _DISABLED
+
+
+def path() -> str:
+    return _cachedir.tuning_cache_path()
+
+
+def canon_shape(shape) -> str:
+    if not shape:
+        return "-"
+    return "x".join(str(int(d)) for d in shape)
+
+
+def canon_dtype(dtype) -> str:
+    if dtype is None:
+        return "-"
+    return str(dtype)
+
+
+def device_kind() -> str:
+    """Canonical device kind of the default backend (lazy jax import —
+    by the time a kernel resolves params, jax is loaded anyway)."""
+    import jax
+
+    return jax.devices()[0].device_kind.lower().replace(" ", "_")
+
+
+def key_str(kernel, shape=None, dtype=None, kind=None) -> str:
+    if kind is None:
+        kind = device_kind()
+    return "|".join(
+        (kernel, canon_shape(shape), canon_dtype(dtype), kind)
+    )
+
+
+def source_sha(sources, root=None):
+    """Sha of the newest commit touching any of `sources` (the cache's
+    git epoch — the sha sibling of bench._last_commit_ts), or None
+    when git/history is unavailable. Memoized per process."""
+    root = root or _REPO
+    memo = (root, tuple(sources))
+    if memo in _SHA_MEMO:
+        return _SHA_MEMO[memo]
+    try:
+        r = subprocess.run(
+            ["git", "-C", root, "log", "-1", "--format=%H", "--",
+             *sources],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+        sha = r.stdout.strip() or None
+        if r.returncode != 0:
+            sha = None
+    except Exception:
+        sha = None
+    _SHA_MEMO[memo] = sha
+    return sha
+
+
+def _load(p):
+    """Parsed cache file (memoized on stat); {} when absent/corrupt —
+    an unreadable cache degrades to shipped defaults, never raises."""
+    try:
+        st = os.stat(p)
+        stat_key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return {}
+    memo = _FILE_MEMO.get(p)
+    if memo and memo[0] == stat_key:
+        return memo[1]
+    try:
+        with open(p) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    if not isinstance(data, dict):
+        data = {}
+    _FILE_MEMO[p] = (stat_key, data)
+    return data
+
+
+def _reject(key, reason, **fields):
+    """Loud-rejection contract (same as bench's epoch rejections): a
+    stale entry's dismissal must be reconstructable from stderr and
+    the journal, but only once per process per cause."""
+    memo = (key, reason)
+    if memo in _REJECT_NOTED:
+        return
+    _REJECT_NOTED.add(memo)
+    print(f"# tuning-cache rejected: {key} ({reason})", file=sys.stderr)
+    journal.emit("tuning_rejected", key=key, reason=reason, **fields)
+
+
+def get(space, shape=None, dtype=None, kind=None):
+    """Validated params dict for (space.kernel, shape, dtype, kind), or
+    None on miss/disabled/stale. See module docstring for the
+    validation rules."""
+    if not enabled():
+        return None
+    data = _load(path())
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        return None
+    key = key_str(space.kernel, shape, dtype, kind)
+    entry = entries.get(key)
+    if not isinstance(entry, dict):
+        return None
+    if entry.get("smoke") and os.environ.get("TPK_BENCH_SMOKE") != "1":
+        # smoke entries prove the sweep->cache->dispatch pipeline;
+        # their params were picked by MEANINGLESS collapsed-repeat
+        # values, so they are honored only inside smoke runs (the CI
+        # proof path) — a normal dispatch at the same key must keep
+        # the shipped defaults. device_kind=cpu keying already shields
+        # TPU runs; this shields CPU/interpret runs in the same
+        # checkout after a revalidate step-3b smoke sweep.
+        _reject(key, "smoke entry ignored outside TPK_BENCH_SMOKE=1")
+        return None
+    import jax
+
+    if entry.get("jax") != jax.__version__:
+        _reject(
+            key,
+            f"tuned on jax {entry.get('jax')}, running {jax.__version__}",
+        )
+        return None
+    sha = source_sha(space.sources)
+    if sha is not None and entry.get("source_sha") not in (None, sha):
+        _reject(
+            key,
+            "stale: a commit touching "
+            + ",".join(space.sources)
+            + " postdates this entry",
+            entry_sha=entry.get("source_sha"),
+            current_sha=sha,
+        )
+        return None
+    params = entry.get("params")
+    return params if isinstance(params, dict) else None
+
+
+def put(
+    space,
+    params: dict,
+    shape=None,
+    dtype=None,
+    kind=None,
+    value=None,
+    control=None,
+    smoke=False,
+    jax_version=None,
+):
+    """Atomically upsert one entry; returns its key. ``jax_version``/
+    ``kind`` let the sweep runner stamp the identity its bench
+    CHILDREN measured under (probed via subprocess) instead of the
+    parent's."""
+    if jax_version is None:
+        import jax
+
+        jax_version = jax.__version__
+    key = key_str(space.kernel, shape, dtype, kind)
+    p = path()
+    entry = {
+        "params": {k: v for k, v in params.items() if v is not None},
+        "value": value,
+        "control": control,
+        "jax": jax_version,
+        "source_sha": source_sha(space.sources),
+        "git_head": journal.git_head(),
+        "recorded": round(time.time(), 3),
+        "smoke": bool(smoke),
+    }
+    d = os.path.dirname(p)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    # flock-serialized read-modify-write: tmp+rename alone keeps the
+    # file uncorrupted but lets two near-simultaneous sweeps (the
+    # daily revalidate smoke step vs an operator sweep) each write a
+    # snapshot missing the other's promotion — last writer would win
+    import fcntl
+
+    with open(f"{p}.lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        _FILE_MEMO.pop(p, None)  # re-read under the lock, not the memo
+        data = _load(p)
+        data.setdefault("entries", {})[key] = entry
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, p)
+    _FILE_MEMO.pop(p, None)
+    journal.emit(
+        "tuning_cache_put", key=key, params=entry["params"],
+        value=value, control=control, smoke=bool(smoke),
+    )
+    return key

@@ -1,0 +1,274 @@
+"""Autotune sweep runner (docs/TUNING.md §runner; CLI tools/autotune.py).
+
+Every candidate runs through the REAL metric path — ``bench.py --one
+<metric>`` with the candidate's env knobs — in a killable subprocess
+via the resilience watchdog, so one wedged candidate costs its timeout
+and nothing more (the PR-1 lesson: SIGALRM cannot interrupt a hung
+C-level PJRT call; a subprocess kill can). Each candidate lands a
+``tuning_candidate`` journal event; a promotion lands
+``tuning_promoted`` plus the cache write.
+
+Promotion rule (docs/TUNING.md): a candidate is promoted into the
+tuning cache only when it beats the shipped-default CONTROL row by
+more than :data:`PROMOTE_MARGIN` on the bench medians — matching the
+old sgemm_tune's ">3% before promoting" guidance, now enforced in code
+instead of prose. ``--smoke`` mode is the exception: values there are
+meaningless (TPK_BENCH_SMOKE collapses the repeat counts), so smoke
+promotes the first measurable candidate marked ``smoke: true`` — its
+purpose is proving the sweep → cache → dispatch pipeline on CPU, and
+its entry is keyed by device_kind=cpu so it can never steer a TPU run.
+
+Bench children always run with ``TPK_TUNING_CACHE=0``: env overrides
+dominate every tunable anyway, but a knob the candidate leaves unset
+(a kernel-computed default) must fall back to the SHIPPED default, not
+to whatever a half-written cache says.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from tpukernels.resilience import journal, watchdog
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+PROMOTE_MARGIN = 0.03  # tuned config must beat control by >3% on medians
+
+# CPU interpret-mode sweep for CI: never touches the tunnel, collapses
+# repeat counts, forces interpret so kernels need no chip to compile
+_SMOKE_ENV = {
+    "PALLAS_AXON_POOL_IPS": "",
+    "JAX_PLATFORMS": "cpu",
+    "TPK_BENCH_SMOKE": "1",
+    "TPU_KERNELS_INTERPRET": "1",
+}
+
+
+def probe_identity(env, timeout_s=240):
+    """(device_kind, jax_version) as the bench CHILDREN will see them —
+    probed in a subprocess under the same env, because the parent may
+    run scrubbed-CPU while the children dial the tunnel. Returns None
+    when the probe hangs or errors (the caller aborts the sweep: with
+    no identity there is no valid cache key to write)."""
+    code = (
+        "import jax, json; d = jax.devices()[0]; "
+        "print(json.dumps({'device_kind': "
+        "d.device_kind.lower().replace(' ', '_'), "
+        "'jax': jax.__version__}))"
+    )
+    r, status = watchdog.kill_after(
+        [sys.executable, "-c", code],
+        timeout_s,
+        site="autotune identity probe",
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    if status != "ok" or r.returncode != 0:
+        return None
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return None
+
+
+def run_candidate(metric, env, timeout_s):
+    """One candidate through ``bench.py --one`` under the watchdog's
+    hard kill. (value, status) with status in ok|timeout|error|parse —
+    the same vocabulary bench's own per-metric isolation uses."""
+    r, status = watchdog.kill_after(
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--one", metric],
+        timeout_s,
+        site=f"autotune --one {metric}",
+        env=env,
+        cwd=_REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    if status == "timeout":
+        return None, "timeout"
+    if r.returncode != 0:
+        return None, "error"
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1])["value"], "ok"
+    except (ValueError, KeyError, IndexError):
+        return None, "parse"
+
+
+def tune(
+    kernel: str,
+    smoke: bool = False,
+    quick: bool = False,
+    max_candidates: int | None = None,
+    timeout_s: float | None = None,
+    base_env: dict | None = None,
+    echo=None,
+):
+    """Sweep one kernel's search space; returns a summary dict.
+
+    ``base_env`` is the environment bench children run under (default:
+    os.environ — callers that scrub their OWN env for a tunnel-free
+    parent pass the original here). ``echo`` gets one line per
+    candidate for CLI progress."""
+    from tpukernels import registry
+    from tpukernels.tuning import cache as tcache
+
+    space = registry.tunables(kernel)
+    if space.metric is None:
+        raise ValueError(
+            f"kernel {kernel!r} declares no bench metric; nothing to tune"
+        )
+    echo = echo or (lambda line: None)
+    env0 = dict(os.environ if base_env is None else base_env)
+    if smoke:
+        env0.update(_SMOKE_ENV)
+    env0["TPK_TUNING_CACHE"] = "0"  # children never read mid-sweep
+    if timeout_s is None:
+        timeout_s = float(
+            os.environ.get("TPK_TUNE_TIMEOUT_S", "60" if smoke else "420")
+        )
+
+    ident = probe_identity(env0)
+    if ident is None:
+        raise RuntimeError(
+            "autotune: environment identity probe failed (backend "
+            "unreachable?) - no valid cache key can be written"
+        )
+
+    cands, pruned = space.candidates(shape=space.bench_shape)
+    if pruned:
+        # no silent caps: pruned candidates are part of the story
+        echo(
+            f"# {pruned} candidate(s) pruned by the VMEM budget "
+            f"({space.vmem_budget_bytes // 2**20} MiB)"
+        )
+    if quick:
+        # "3 most promising" (space.quick_candidates docstring): the
+        # control plus single-axis probes of the first declared
+        # tunable — the A-reload vs accumulator-locality trade the
+        # old sgemm grid rationale ranked first
+        cands = space.quick_candidates(shape=space.bench_shape)
+    if smoke and max_candidates is None:
+        max_candidates = 3
+    if max_candidates is not None and len(cands) > max_candidates:
+        echo(
+            f"# sweep capped at {max_candidates} of {len(cands)} "
+            "candidates (--max-candidates)"
+        )
+        cands = cands[:max_candidates]
+    if not cands:
+        # everything pruned or capped away: the documented "nothing
+        # measured" outcome, not an IndexError mid-summary
+        journal.emit(
+            "tuning_sweep_end", kernel=kernel, measured=0, failed=0,
+            promoted=None,
+        )
+        return {
+            "kernel": kernel, "metric": space.metric, "identity": ident,
+            "rows": [], "control": None, "best": None, "promoted": None,
+            "cache_key": None, "cache_path": tcache.path(),
+            "pruned": pruned,
+        }
+
+    journal.emit(
+        "tuning_sweep_start",
+        kernel=kernel,
+        metric=space.metric,
+        candidates=len(cands),
+        pruned=pruned,
+        smoke=smoke,
+        device_kind=ident["device_kind"],
+    )
+    rows = []
+    for params in cands:
+        env = dict(env0)
+        env.update(space.env_for(params))
+        t0 = time.monotonic()
+        value, status = run_candidate(space.metric, env, timeout_s)
+        elapsed = round(time.monotonic() - t0, 2)
+        journal.emit(
+            "tuning_candidate",
+            kernel=kernel,
+            params=params,
+            value=value,
+            status=status,
+            elapsed_s=elapsed,
+        )
+        shown = (
+            f"{value:12.2f}" if value is not None else f"  FAIL ({status})"
+        )
+        echo(
+            "  ".join(f"{k}={v}" for k, v in params.items())
+            + f"  {shown}"
+        )
+        rows.append({"params": params, "value": value, "status": status})
+
+    # candidates() puts the shipped defaults first; if a space ever
+    # ships infeasible defaults (pruned), there is no control row and
+    # nothing can prove the >3% margin — no promotion then.
+    control = rows[0] if rows[0]["params"] == space.defaults() else None
+    measured = [r for r in rows if r["value"] is not None]
+    best = max(measured, key=lambda r: r["value"], default=None)
+    promoted = None
+    if smoke:
+        # pipeline proof, not a tuning claim (see module docstring):
+        # sweep-order-first, so the written entry is deterministic —
+        # the collapsed-repeat values max() would pick between are
+        # meaningless by construction
+        promoted = measured[0] if measured else None
+    elif (
+        best is not None
+        and control is not None
+        and best is not control
+        and control["value"]
+        and best["value"] > control["value"] * (1.0 + PROMOTE_MARGIN)
+    ):
+        promoted = best
+    key = None
+    if promoted is not None:
+        key = tcache.put(
+            space,
+            promoted["params"],
+            shape=space.bench_shape,
+            dtype=space.bench_dtype,
+            kind=ident["device_kind"],
+            value=promoted["value"],
+            control=control["value"] if control else None,
+            smoke=smoke,
+            jax_version=ident["jax"],
+        )
+        journal.emit(
+            "tuning_promoted",
+            kernel=kernel,
+            key=key,
+            params=promoted["params"],
+            value=promoted["value"],
+            control=control["value"] if control else None,
+            smoke=smoke,
+        )
+    journal.emit(
+        "tuning_sweep_end",
+        kernel=kernel,
+        measured=len(measured),
+        failed=len(rows) - len(measured),
+        promoted=promoted["params"] if promoted else None,
+    )
+    return {
+        "kernel": kernel,
+        "metric": space.metric,
+        "identity": ident,
+        "rows": rows,
+        "control": control,
+        "best": best,
+        "promoted": promoted,
+        "cache_key": key,
+        "cache_path": tcache.path(),
+        "pruned": pruned,
+    }

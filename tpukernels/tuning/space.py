@@ -1,0 +1,231 @@
+"""Declarative autotuning search spaces (docs/TUNING.md §schema).
+
+A kernel's tunable surface is data, not code: a
+:class:`SearchSpace` names each knob (:class:`Tunable`), its env-var
+spelling, shipped default and sweep values, plus an analytic
+VMEM-budget model so infeasible candidates are pruned *before* burning
+chip time — generalizing the 32 MiB arithmetic the old
+``tools/sgemm_tune.py`` documented in prose.
+
+:func:`resolve` is the single param-resolution path every kernel
+wrapper calls, with the documented precedence
+
+    env-override  >  tuned-cache  >  shipped-default
+
+Env parsing is fail-loud (``TPK_SGEMM_BM=abc`` raises a ValueError
+naming the var, like every other TPK_* knob); cache-sourced values are
+validated with the same rules but REJECTED (treated as absent, with a
+``tuning_rejected`` journal event) instead of raising — a corrupt
+cache file must degrade to shipped defaults, never take down a kernel
+call.
+
+Stdlib-only at import time: jax is only imported lazily via the cache
+module, so search spaces are introspectable (``tools/autotune.py
+--list``) without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from tpukernels.resilience import journal
+
+
+@dataclass(frozen=True)
+class Tunable:
+    """One tunable knob: a positive int (block dims, pipeline depth) or
+    a categorical choice (impl selectors). ``default=None`` means "the
+    kernel computes its own fallback" (e.g. histogram's nbins-dependent
+    impl pick) — resolve then returns None for the default source and
+    the kernel keeps its in-code logic."""
+
+    name: str
+    env: str
+    default: Any
+    values: tuple = ()
+    choice: bool = False  # categorical (string) vs positive-int
+
+    def parse_env(self, raw: str):
+        """Fail-loud env parsing (the TPK_* knob contract)."""
+        if self.choice:
+            if raw not in self.values:
+                raise ValueError(
+                    f"{self.env}={raw!r}: expected one of "
+                    + ", ".join(repr(v) for v in self.values)
+                )
+            return raw
+        try:
+            val = int(raw)
+        except ValueError:
+            val = 0
+        if val <= 0:
+            raise ValueError(
+                f"{self.env}={raw!r}: expected a positive integer"
+            )
+        return val
+
+    def coerce_cached(self, v):
+        """(ok, value) for a cache-sourced candidate value: same rules
+        as parse_env but never raises — see module docstring."""
+        if self.choice:
+            return (v in self.values), v
+        return (isinstance(v, int) and not isinstance(v, bool) and v > 0), v
+
+
+@dataclass(frozen=True)
+class SearchSpace:
+    """Declarative search space for one registry kernel.
+
+    ``sources`` are the repo-relative files whose git history epochs
+    the tuning cache (an entry tuned before the last commit touching
+    them is stale). ``metric``/``bench_shape``/``bench_dtype`` bind the
+    space to its ``bench.py --one`` metric of record and the cache key
+    that metric's kernel call resolves with, so the sweep runner writes
+    the exact entry later dispatches will read. ``vmem_bytes(params,
+    shape)`` is the analytic VMEM model; candidates over
+    ``vmem_budget_bytes`` are pruned (both optional — kernels whose
+    geometry self-adapts, like the stencil slab picker, omit them)."""
+
+    kernel: str
+    tunables: tuple
+    sources: tuple
+    metric: Optional[str] = None
+    bench_shape: Optional[tuple] = None
+    bench_dtype: Optional[str] = None
+    vmem_budget_bytes: Optional[int] = None
+    vmem_bytes: Optional[Callable] = field(default=None, repr=False)
+
+    def defaults(self) -> dict:
+        return {t.name: t.default for t in self.tunables}
+
+    def env_for(self, params: dict) -> dict:
+        """Env-var assignments selecting ``params`` in a subprocess
+        (None values — kernel-computed defaults — are left unset)."""
+        by_name = {t.name: t for t in self.tunables}
+        return {
+            by_name[k].env: str(v)
+            for k, v in params.items()
+            if k in by_name and v is not None
+        }
+
+    def feasible(self, params: dict, shape=None) -> bool:
+        if self.vmem_bytes is None or self.vmem_budget_bytes is None:
+            return True
+        return self.vmem_bytes(params, shape) <= self.vmem_budget_bytes
+
+    def candidates(self, shape=None):
+        """Feasibility-pruned sweep candidates, shipped defaults FIRST
+        (the control row every promotion is judged against), then the
+        cartesian product of sweep values in declaration order.
+        Returns (candidates, n_pruned) — callers must surface n_pruned
+        (no silent caps)."""
+        default = self.defaults()
+        axes = [
+            t.values if t.values else (t.default,) for t in self.tunables
+        ]
+        names = [t.name for t in self.tunables]
+        out, pruned = [], 0
+        seen = set()
+
+        def _add(params):
+            nonlocal pruned
+            key = tuple(sorted(params.items()))
+            if key in seen:
+                return
+            seen.add(key)
+            if self.feasible(params, shape):
+                out.append(params)
+            else:
+                pruned += 1
+
+        _add(default)
+        for combo in itertools.product(*axes):
+            _add(dict(zip(names, combo)))
+        return out, pruned
+
+    def quick_candidates(self, shape=None):
+        """The --quick sweep: the control plus single-axis probes of
+        the FIRST declared tunable, max 3 rows — declare the
+        highest-leverage knob first (for sgemm this reproduces the old
+        sgemm_tune QUICK rows exactly: control, bm=128, bm=512)."""
+        cands, _pruned = self.candidates(shape=shape)
+        if not cands:
+            return []
+        first, rest = self.tunables[0], self.tunables[1:]
+        return (
+            cands[:1]
+            + [
+                c
+                for c in cands[1:]
+                if c[first.name] != first.default
+                and all(c[t.name] == t.default for t in rest)
+            ]
+        )[:3]
+
+
+# once-per-process memo of journaled cache-sourced resolutions, so a
+# kernel wrapper called in a loop doesn't spam the health journal
+_JOURNALED: set = set()
+
+
+def resolve(space: SearchSpace, shape=None, dtype=None) -> dict:
+    """Resolved knob values for one kernel call.
+
+    Per-tunable precedence: a set env var wins (fail-loud parse), else
+    a validated tuning-cache entry for (kernel, shape, dtype,
+    device_kind), else the shipped default. Emits one
+    ``tuning_resolved`` journal event per (kernel, key) per process
+    when the cache contributed at least one value, recording the
+    per-knob sources — the "demonstrably reads it" evidence the
+    acceptance tests key on. ``TPK_TUNING_CACHE=0`` disables the cache
+    layer entirely (env and defaults still apply)."""
+    from tpukernels.tuning import cache as tcache
+
+    cached = tcache.get(space, shape, dtype)
+    params, sources = {}, {}
+    for t in space.tunables:
+        raw = os.environ.get(t.env)
+        if raw is not None:
+            params[t.name] = t.parse_env(raw)
+            sources[t.name] = "env"
+            continue
+        if cached is not None and t.name in cached:
+            ok, v = t.coerce_cached(cached[t.name])
+            if ok:
+                params[t.name] = v
+                sources[t.name] = "cache"
+                continue
+            journal.emit(
+                "tuning_rejected",
+                kernel=space.kernel,
+                reason=f"bad cached value for {t.name}: {cached[t.name]!r}",
+            )
+        params[t.name] = t.default
+        sources[t.name] = "default"
+    if "cache" in sources.values():
+        memo = (space.kernel, repr(shape), repr(dtype))
+        if memo not in _JOURNALED:
+            _JOURNALED.add(memo)
+            journal.emit(
+                "tuning_resolved",
+                kernel=space.kernel,
+                shape=list(shape) if shape else None,
+                dtype=dtype,
+                params=params,
+                sources=sources,
+            )
+    return params
+
+
+def spaces_of(module) -> Sequence[SearchSpace]:
+    """A module's exported TUNABLES as a flat sequence (modules with
+    several registry kernels — stencil — export a tuple)."""
+    tun = getattr(module, "TUNABLES", None)
+    if tun is None:
+        return ()
+    if isinstance(tun, SearchSpace):
+        return (tun,)
+    return tuple(tun)
