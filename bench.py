@@ -48,6 +48,14 @@ ensure_compilation_cache()
 # as a structured health event (docs/RESILIENCE.md).
 from tpukernels.resilience import faults, journal, watchdog
 
+# Observability layer (also stdlib-only, docs/OBSERVABILITY.md):
+# spans are a shared no-op unless TPK_TRACE is set (clean-path stdout
+# stays byte-identical — tests/test_obs.py proves it the same way the
+# fault layer is proven); metric counters are process-local until the
+# end-of-run snapshot lands in the health journal.
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.obs import trace
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -156,10 +164,13 @@ def _slope(make_fn, r_small, r_big, samples=5):
     faults.phase_fault("operand")  # no-op without a TPK_FAULT_PLAN
     f_s, a_s = make_fn(r_small)
     f_b, a_b = make_fn(r_big)
-    print(f"# slope: compiling R={r_small}", file=sys.stderr, flush=True)
-    np.asarray(f_s(*a_s))  # compile + warm
-    print(f"# slope: compiling R={r_big}", file=sys.stderr, flush=True)
-    np.asarray(f_b(*a_b))
+    with trace.span("slope/compile", r_small=r_small, r_big=r_big):
+        print(f"# slope: compiling R={r_small}", file=sys.stderr,
+              flush=True)
+        np.asarray(f_s(*a_s))  # compile + warm
+        print(f"# slope: compiling R={r_big}", file=sys.stderr,
+              flush=True)
+        np.asarray(f_b(*a_b))
     faults.phase_fault("compile")
     if os.environ.get("TPK_BENCH_PREWARM") == "1":
         # --prewarm mode: both R variants are now in the persistent
@@ -180,25 +191,29 @@ def _slope(make_fn, r_small, r_big, samples=5):
              r_big, r_small, r_small, r_big)
     ests = []
     min_valid = min(3, samples)
-    for attempt in range(3 * samples):
-        if len(ests) >= samples:
-            break
-        rows, durs = [], []
-        t_base = time.perf_counter()  # centered time regressor: raw
-        # perf_counter values are ~1e5 s and near-constant across the
-        # sample, which ill-conditions the fit against the intercept
-        for r in octet:
-            f, a = calls[r]
-            t0 = time.perf_counter()
-            np.asarray(f(*a))
-            t1 = time.perf_counter()
-            rows.append((1.0, (t0 + t1) / 2.0 - t_base, float(r)))
-            durs.append(t1 - t0)
-        coef, *_ = np.linalg.lstsq(
-            np.array(rows), np.array(durs), rcond=None
-        )
-        if coef[2] > 0:
-            ests.append(float(coef[2]))
+    with trace.span("slope/execute", samples=samples,
+                    r_small=r_small, r_big=r_big):
+        for attempt in range(3 * samples):
+            if len(ests) >= samples:
+                break
+            rows, durs = [], []
+            t_base = time.perf_counter()  # centered time regressor: raw
+            # perf_counter values are ~1e5 s and near-constant across
+            # the sample, which ill-conditions the fit against the
+            # intercept
+            for r in octet:
+                f, a = calls[r]
+                t0 = time.perf_counter()
+                np.asarray(f(*a))
+                t1 = time.perf_counter()
+                rows.append((1.0, (t0 + t1) / 2.0 - t_base, float(r)))
+                durs.append(t1 - t0)
+            coef, *_ = np.linalg.lstsq(
+                np.array(rows), np.array(durs), rcond=None
+            )
+            if coef[2] > 0:
+                ests.append(float(coef[2]))
+    obs_metrics.inc("bench.slope_samples_valid", len(ests))
     if len(ests) < min_valid:
         # a median of 1-2 surviving samples is just the single-slope
         # jitter problem again; refuse to report it as a median
@@ -738,7 +753,9 @@ def main():
         deadline_s=float(os.environ.get("TPK_BENCH_DEADLINE_S", "4800")),
         fault_plan_active=faults.active(),
     )
-    if not _tpu_alive():
+    with trace.span("probe/liveness"):
+        alive = _tpu_alive()
+    if not alive:
         journal.emit(
             "run_end", outcome="unreachable",
             reason="TPU backend unreachable (tunnel down)",
@@ -875,10 +892,18 @@ def main():
                 reason="skipped (wedged or deadline)",
             )
             continue
-        value, status = _run_one_subprocess(
-            name,
-            min(_BENCH_TIMEOUT_S + _CHILD_GRACE_S,
-                remaining - _CHILD_GRACE_S),
+        # suite/<metric> wraps the whole killable child (spawn +
+        # measure + reap); the child's own measure/<metric> span times
+        # just the measurement, so their difference is isolation cost
+        with trace.span(f"suite/{name}", metric=name):
+            value, status = _run_one_subprocess(
+                name,
+                min(_BENCH_TIMEOUT_S + _CHILD_GRACE_S,
+                    remaining - _CHILD_GRACE_S),
+            )
+        obs_metrics.inc(
+            "bench.metric_ok" if value is not None
+            else "bench.metric_failed"
         )
         ceiling = ceilings.get(name)
         if (
@@ -1249,7 +1274,11 @@ if __name__ == "__main__":
         # opens the operand-setup phase for the wedge-attribution
         # breadcrumbs (closed by _slope's 'entered' line)
         print(f"# one: {sys.argv[2]} starting", file=sys.stderr, flush=True)
-        print(json.dumps({"name": sys.argv[2],
-                          "value": round(_with_timeout(fn), 2)}))
+        obs_metrics.inc(f"bench.measure.{sys.argv[2]}")
+        with trace.span(f"measure/{sys.argv[2]}"):
+            value = round(_with_timeout(fn), 2)
+        print(json.dumps({"name": sys.argv[2], "value": value}))
+        # the final metrics snapshot flushes via obs.metrics' atexit
+        # hook — also on the Timeout/exception paths above
         sys.exit(0)
     main()

@@ -1,0 +1,499 @@
+"""CPU suite for the observability layer (docs/OBSERVABILITY.md).
+
+Covers the tentpole contracts without a TPU: span nesting and the
+TPK_TRACE-unset no-op (including the byte-identical clean bench path,
+proven the same way the fault layer's is), metric counter semantics,
+trend verdicts on fixture series — regression beyond the epsilon
+band, the physically-impossible 72,698-GFLOPS class of error, nulls
+as no-data — the BENCH_r*.json tunnel-down nesting tolerance, the
+probe_failed journal event, health_report's span breakdown, and the
+journal-kind lint that keeps docs/OBSERVABILITY.md's catalog honest.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from test_distributed import _scrubbed_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the invalidated figure of record (BASELINE.md 2026-07-31 07:16 note)
+# and its ceiling — the exact error class trend.py must catch
+SGEMM_DRIFT = 72698.96
+SGEMM_CEILING = 61333
+
+
+def _events(path, kind=None):
+    recs = [
+        json.loads(line)
+        for line in open(path).read().splitlines()
+        if line.strip()
+    ]
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    return recs
+
+
+@pytest.fixture
+def traced(monkeypatch, tmp_path):
+    """TPK_TRACE on + journal routed to a tmp file; always restores
+    the module-level enabled flag (it outlives monkeypatch's env
+    restore, like the fault layer's _PLAN)."""
+    from tpukernels.obs import trace
+
+    journal_path = tmp_path / "health.jsonl"
+    monkeypatch.setenv("TPK_TRACE", "1")
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(journal_path))
+    trace.reload()
+    yield journal_path
+    monkeypatch.delenv("TPK_TRACE")
+    trace.reload()
+
+
+# ---------------------------------------------------------------- #
+# trace: nesting, fields, disable no-op                             #
+# ---------------------------------------------------------------- #
+
+def test_span_nesting_records_paths_and_fields(traced):
+    from tpukernels.obs import trace
+
+    with trace.span("measure/sgemm", m=1024):
+        assert trace.current_path() == "measure/sgemm"
+        with trace.span("slope/compile", r_small=50):
+            assert trace.current_path() == "measure/sgemm/slope/compile"
+    assert trace.current_path() is None
+    spans = _events(traced, "span")
+    # inner exits (and emits) first
+    assert [s["name"] for s in spans] == [
+        "measure/sgemm/slope/compile", "measure/sgemm",
+    ]
+    inner, outer = spans
+    assert inner["depth"] == 2 and outer["depth"] == 1
+    assert inner["r_small"] == 50 and outer["m"] == 1024
+    assert inner["wall_s"] >= 0 and inner["ok"] is True
+
+
+def test_span_reserved_field_names_are_prefixed(traced):
+    """Tuning spans forward arbitrary tunable names via **params; one
+    named like a journal stamp ('t') or an emitter-owned key ('name')
+    must neither raise a duplicate-kwarg TypeError out of __exit__
+    nor clobber the event's own fields."""
+    from tpukernels.obs import trace
+
+    with trace.span("tune/x", name="collides", t=7, bm=256):
+        pass
+    (ev,) = _events(traced, "span")
+    assert ev["name"] == "tune/x"          # emitter wins
+    assert ev["param_name"] == "collides"  # caller value preserved
+    assert ev["param_t"] == 7 and ev["bm"] == 256
+    assert isinstance(ev["t"], float)      # journal stamp intact
+
+
+def test_span_exception_marks_not_ok_and_unwinds(traced):
+    from tpukernels.obs import trace
+
+    with pytest.raises(RuntimeError):
+        with trace.span("boom"):
+            raise RuntimeError("x")
+    (ev,) = _events(traced, "span")
+    assert ev["ok"] is False
+    assert trace.current_path() is None
+
+
+def test_span_disabled_is_shared_noop(monkeypatch, tmp_path):
+    from tpukernels.obs import trace
+
+    journal_path = tmp_path / "health.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(journal_path))
+    monkeypatch.delenv("TPK_TRACE", raising=False)
+    trace.reload()
+    assert not trace.enabled()
+    s1 = trace.span("a", x=1)
+    s2 = trace.span("b")
+    # one shared no-op object: no per-call allocation on the clean path
+    assert s1 is s2 is trace._NOOP
+    with s1:
+        assert trace.current_path() is None
+    assert not journal_path.exists()  # nothing emitted
+    for off in ("0", "off", "none", ""):
+        monkeypatch.setenv("TPK_TRACE", off)
+        assert trace.reload() is False
+    monkeypatch.delenv("TPK_TRACE")
+    trace.reload()
+
+
+# ---------------------------------------------------------------- #
+# metrics: counter/gauge/histogram semantics + snapshot routing     #
+# ---------------------------------------------------------------- #
+
+def test_metrics_counter_gauge_histogram_semantics():
+    from tpukernels.obs import metrics
+
+    metrics.reset()
+    try:
+        metrics.inc("c")
+        metrics.inc("c")
+        metrics.inc("c", 5)
+        metrics.gauge("g", 1.0)
+        metrics.gauge("g", 3.5)  # last write wins
+        for v in (2.0, 0.5, 1.0):
+            metrics.observe("h", v)
+        snap = metrics.snapshot()
+        assert snap["counters"]["c"] == 7
+        assert snap["gauges"]["g"] == 3.5
+        h = snap["histograms"]["h"]
+        assert h == {"count": 3, "sum": 3.5, "min": 0.5, "max": 2.0}
+        # snapshot is a copy, not a view
+        snap["counters"]["c"] = 0
+        assert metrics.snapshot()["counters"]["c"] == 7
+    finally:
+        metrics.reset()
+    assert metrics.snapshot() == {
+        "counters": {}, "gauges": {}, "histograms": {},
+    }
+
+
+def test_metrics_snapshot_routes_to_journal(monkeypatch, tmp_path):
+    from tpukernels.obs import metrics
+
+    journal_path = tmp_path / "health.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(journal_path))
+    metrics.reset()
+    try:
+        metrics.emit_snapshot(site="empty")  # nothing recorded: no-op
+        assert not journal_path.exists()
+        metrics.inc("probe.retries")
+        metrics.emit_snapshot(site="t")
+        (ev,) = _events(journal_path, "metrics")
+        assert ev["site"] == "t"
+        assert ev["counters"] == {"probe.retries": 1}
+    finally:
+        metrics.reset()
+
+
+# ---------------------------------------------------------------- #
+# trend: fixtures for regression / ceiling / null handling          #
+# ---------------------------------------------------------------- #
+
+def _fixture_root(tmp_path, baseline=None, logs=None, rounds=None):
+    root = tmp_path / "repo"
+    (root / "docs" / "logs").mkdir(parents=True)
+    (root / "BASELINE.json").write_text(json.dumps(baseline or {}))
+    for fname, line in (logs or {}).items():
+        (root / "docs" / "logs" / fname).write_text(json.dumps(line))
+    for n, rec in (rounds or {}).items():
+        (root / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
+    return str(root)
+
+
+def _line(details, **extra):
+    return {"metric": "sgemm_gflops_per_chip", "value": None,
+            "unit": "GFLOPS", "details": details, **extra}
+
+
+def test_trend_flags_regression_beyond_eps_band(tmp_path):
+    from tpukernels.obs import trend
+
+    root = _fixture_root(
+        tmp_path,
+        baseline={"measured": {"m": 100.0}},
+        logs={
+            "bench_2026-08-01_000000.json": _line({"m": 100.0}),
+            "bench_2026-08-02_000000.json": _line({"m": 97.0}),
+        },
+    )
+    v = trend.analyze_repo(root)["m"]
+    assert v["verdict"] == "regression"  # 3% drop > 1% band
+    assert v["latest"] == 97.0 and v["best"] == 100.0
+    assert any("REGRESSION" in f for f in v["flags"])
+
+
+def test_trend_within_band_is_ok(tmp_path):
+    from tpukernels.obs import trend
+
+    root = _fixture_root(
+        tmp_path,
+        baseline={"measured": {"m": 100.0}},
+        logs={
+            "bench_2026-08-01_000000.json": _line({"m": 100.0}),
+            "bench_2026-08-02_000000.json": _line({"m": 99.5}),
+        },
+    )
+    assert trend.analyze_repo(root)["m"]["verdict"] == "ok"
+
+
+def test_trend_flags_impossible_sgemm_value(tmp_path):
+    """The acceptance fixture: the invalidated 72,698-GFLOPS capture
+    as a RAW detail value must be flagged against the 61,333 ceiling —
+    the class of error BASELINE.md only caught by hand."""
+    from tpukernels.obs import trend
+
+    root = _fixture_root(
+        tmp_path,
+        baseline={
+            "measured": {"sgemm_gflops": 60834},
+            "ceilings": {"sgemm_gflops": SGEMM_CEILING, "_note": "x"},
+        },
+        logs={
+            "bench_2026-08-01_000000.json": _line(
+                {"sgemm_gflops": SGEMM_DRIFT}
+            ),
+        },
+    )
+    v = trend.analyze_repo(root)["sgemm_gflops"]
+    assert v["verdict"] == "impossible"
+    assert any("IMPOSSIBLE" in f and str(SGEMM_DRIFT) in f
+               for f in v["flags"])
+
+
+def test_trend_invalidated_at_source_is_not_impossible(tmp_path):
+    """A raw value the bench already invalidated (nulled in details,
+    preserved under 'invalidated') was CAUGHT — report it as such,
+    don't fail the verdict for an error the machinery handled."""
+    from tpukernels.obs import trend
+
+    root = _fixture_root(
+        tmp_path,
+        baseline={"ceilings": {"sgemm_gflops": SGEMM_CEILING}},
+        logs={
+            "bench_2026-08-01_000000.json": _line(
+                {"sgemm_gflops": None},
+                invalidated={"sgemm_gflops": [SGEMM_DRIFT, "drift"]},
+            ),
+        },
+    )
+    v = trend.analyze_repo(root)["sgemm_gflops"]
+    assert v["verdict"] == "no_data"
+    assert any("already invalidated" in f for f in v["flags"])
+
+
+def test_trend_tunnel_down_nulls_are_no_data(tmp_path):
+    """The five committed BENCH_r*.json all-null rounds: a down tunnel
+    must read as 'no data', never as a regression."""
+    from tpukernels.obs import trend
+
+    null_round = {
+        "n": 1,
+        "parsed": _line({"error": "TPU backend unreachable"}),
+    }
+    root = _fixture_root(
+        tmp_path,
+        baseline={"measured": {"sgemm_gflops": 60834}},
+        rounds={1: null_round, 2: null_round},
+    )
+    v = trend.analyze_repo(root)["sgemm_gflops"]
+    assert v["verdict"] == "no_data"
+    assert v["valid_points"] == 0
+
+
+def test_trend_nested_artifact_contributes_and_dedupes(tmp_path):
+    """BENCH_r04/r05-style rounds: details.error +
+    last_persisted_artifact nesting must contribute the nested line's
+    surviving metrics (the stencil2d 131,799) exactly once, even when
+    several rounds AND the committed artifact itself all carry it."""
+    from tpukernels.obs import trend
+
+    artifact_line = _line({"stencil2d_mcells_s": 131799.49})
+    nested = {
+        "parsed": _line({
+            "error": "TPU backend unreachable (tunnel down)",
+            "last_persisted_artifact": {
+                "path": "docs/logs/bench_2026-07-31_033318.json",
+                "line": artifact_line,
+            },
+        }),
+    }
+    root = _fixture_root(
+        tmp_path,
+        baseline={"measured": {"stencil2d_mcells_s": 129996}},
+        logs={"bench_2026-07-31_033318.json": artifact_line},
+        rounds={4: nested, 5: nested},
+    )
+    v = trend.analyze_repo(root)["stencil2d_mcells_s"]
+    assert v["valid_points"] == 1  # three copies, one point
+    assert v["latest"] == 131799.49
+    assert v["verdict"] == "ok"
+
+
+def test_trend_round_tail_fallback(tmp_path):
+    """A round file without 'parsed' still contributes via the last
+    JSON line of its 'tail' capture."""
+    from tpukernels.obs import trend
+
+    root = _fixture_root(
+        tmp_path,
+        baseline={"measured": {"m": 100.0}},
+        rounds={1: {"n": 1, "tail": "# noise\n"
+                    + json.dumps(_line({"m": 100.0})) + "\n"}},
+    )
+    v = trend.analyze_repo(root)["m"]
+    assert v["valid_points"] == 1 and v["latest"] == 100.0
+
+
+def test_trend_bands_mirror_bench_constants():
+    """trend.py cannot import bench (jax would leak into a stdlib-only
+    module), so its band constants are mirrors — this is the
+    single-source-of-truth enforcement."""
+    import bench
+    from tpukernels.obs import trend
+
+    assert trend.CEILING_EPS == bench._CEILING_EPS
+    assert trend.REGRESSION_TOL == bench._REGRESSION_TOL
+
+
+# ---------------------------------------------------------------- #
+# tools: obs_report exit codes, journal_kinds lint                  #
+# ---------------------------------------------------------------- #
+
+def _run_tool(script, *args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", script), *args],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+
+
+def test_obs_report_check_exit_codes(tmp_path):
+    bad = _fixture_root(
+        tmp_path,
+        baseline={"ceilings": {"sgemm_gflops": SGEMM_CEILING}},
+        logs={"bench_2026-08-01_000000.json": _line(
+            {"sgemm_gflops": SGEMM_DRIFT})},
+    )
+    r = _run_tool("obs_report.py", "--check", "--root", bad)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "impossible" in r.stdout
+
+    ok = _fixture_root(
+        tmp_path / "ok",
+        baseline={"measured": {"m": 100.0}},
+        logs={"bench_2026-08-01_000000.json": _line({"m": 100.0})},
+    )
+    r = _run_tool("obs_report.py", "--check", "--root", ok)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_journal_kinds_lint_passes_on_this_repo():
+    """The tier-1 enforcement of the satellite: every production
+    journal.emit kind is documented in docs/OBSERVABILITY.md."""
+    r = _run_tool("journal_kinds.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all documented" in r.stdout
+
+
+def test_journal_kinds_lint_catches_undocumented(tmp_path):
+    root = tmp_path / "mini"
+    (root / "docs").mkdir(parents=True)
+    (root / "bench.py").write_text(
+        'journal.emit(\n    "bogus_kind", x=1)\n'
+    )
+    (root / "docs" / "OBSERVABILITY.md").write_text(
+        "| `real_kind` | somewhere | stuff |\n"
+    )
+    r = _run_tool("journal_kinds.py", "--root", str(root))
+    assert r.returncode == 1
+    assert "bogus_kind" in r.stdout
+    assert "bench.py:1" in r.stdout
+    # kinds with digits/uppercase must be linted too, not silently
+    # skipped by a too-narrow character class
+    (root / "bench.py").write_text('journal.emit("phase2_Start")\n')
+    r = _run_tool("journal_kinds.py", "--root", str(root))
+    assert r.returncode == 1
+    assert "phase2_Start" in r.stdout
+
+
+# ---------------------------------------------------------------- #
+# satellites: probe_failed event, health_report breakdown           #
+# ---------------------------------------------------------------- #
+
+def test_patient_probe_emits_probe_failed(monkeypatch, tmp_path):
+    from tpukernels.obs import metrics
+    from tpukernels.resilience import watchdog
+
+    journal_path = tmp_path / "health.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(journal_path))
+    metrics.reset()
+    try:
+        assert (
+            watchdog.patient_probe(
+                lambda a: "retry", attempts=2, retry_wait_s=0,
+                label="TPU liveness probe",
+            )
+            is False
+        )
+        evs = _events(journal_path, "probe_failed")
+        assert [(e["attempt"], e["attempts"]) for e in evs] == [
+            (1, 2), (2, 2),
+        ]
+        assert all(e["label"] == "TPU liveness probe" for e in evs)
+        assert all("backoff_s" in e for e in evs)
+        assert metrics.snapshot()["counters"]["probe.retries"] == 2
+    finally:
+        metrics.reset()
+
+
+def test_health_report_renders_span_breakdown_and_probe_failed(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import health_report
+    finally:
+        sys.path.pop(0)
+    j = tmp_path / "health.jsonl"
+    events = [
+        {"ts": "t0", "t": 1.0, "pid": 1, "kind": "probe_failed",
+         "label": "TPU liveness probe", "attempt": 1, "attempts": 6,
+         "backoff_s": 120},
+        {"ts": "t1", "t": 2.0, "pid": 1, "kind": "span",
+         "name": "measure/sgemm", "wall_s": 2.5, "depth": 1},
+        {"ts": "t2", "t": 3.0, "pid": 1, "kind": "span",
+         "name": "measure/sgemm", "wall_s": 1.5, "depth": 1},
+        {"ts": "t3", "t": 4.0, "pid": 1, "kind": "metrics",
+         "site": "bench.main", "counters": {"c": 1}, "gauges": {},
+         "histograms": {}},
+    ]
+    j.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    loaded, bad = health_report.load([str(j)])
+    out = health_report.summarize(loaded, bad)
+    assert "per-phase wall time" in out
+    assert "measure/sgemm" in out and "n=2" in out and "total=4.000s" in out
+    assert "TPU liveness probe FAILED (attempt 1/6" in out
+    assert "metrics snapshot" in out
+
+
+# ---------------------------------------------------------------- #
+# acceptance: clean bench path byte-identical with TPK_TRACE unset  #
+# ---------------------------------------------------------------- #
+
+def test_clean_bench_path_byte_identical_without_trace(tmp_path):
+    """Same proof style as the fault layer's
+    test_clean_path_output_byte_identical: bench stdout for a fixed
+    seed on CPU must not change with the trace layer present —
+    whether TPK_TRACE is unset, explicitly off, or even ON (spans go
+    to the journal, never stdout). Only the traced run's journal
+    carries span events."""
+    outs, journals = [], []
+    for i, tr in enumerate((None, "0", "1")):
+        env = _scrubbed_env(fake_devices=None)
+        env["TPK_BENCH_SMOKE"] = "1"
+        journal = tmp_path / f"health_{i}.jsonl"
+        journals.append(journal)
+        env["TPK_HEALTH_JOURNAL"] = str(journal)
+        env.pop("TPK_TRACE", None)
+        env.pop("TPK_FAULT_PLAN", None)
+        if tr is not None:
+            env["TPK_TRACE"] = tr
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--one", "saxpy_gb_s"],
+            env=env, capture_output=True, text=True, timeout=420,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        outs.append(proc.stdout)
+    assert outs[0] == outs[1] == outs[2]
+    assert _events(journals[0], "span") == []
+    assert _events(journals[1], "span") == []
+    traced_names = [e["name"] for e in _events(journals[2], "span")]
+    assert "measure/saxpy_gb_s" in traced_names

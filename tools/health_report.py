@@ -5,12 +5,14 @@ Usage:
 
 With no arguments, reads the newest docs/logs/health_*.jsonl. The
 journal (tpukernels/resilience/journal.py, schema in
-docs/RESILIENCE.md) records every probe outcome, watchdog fire,
-slow-vs-wedged classification, partial-result decision, invalidation,
-evidence rejection and injected fault; this report reconstructs what a
-flapping session DID — which metrics were banked before the wedge,
-what the watchdogs killed, what the gate rejected and why — from the
-journal alone, replacing grep-the-stderr postmortems.
+docs/RESILIENCE.md; kind catalog in docs/OBSERVABILITY.md) records
+every probe outcome, watchdog fire, slow-vs-wedged classification,
+partial-result decision, invalidation, evidence rejection, injected
+fault, tuning decision, span and metrics snapshot; this report
+reconstructs what a flapping session DID — which metrics were banked
+before the wedge, what the watchdogs killed, what the gate rejected
+and why, where the wall time went (per-phase span breakdown) — from
+the journal alone, replacing grep-the-stderr postmortems.
 """
 
 from __future__ import annotations
@@ -21,27 +23,17 @@ import os
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpukernels.obs import trace as _trace  # noqa: E402
+from tpukernels.resilience import journal as _journal  # noqa: E402
 
 
 def load(paths):
     """Parse events from JSONL files, in file order then line order.
-    Unparseable lines are counted, not fatal — a journal truncated by
-    a crash is exactly when a postmortem is needed most."""
-    events, bad = [], 0
-    for p in paths:
-        with open(p) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    bad += 1
-                    continue
-                if isinstance(rec, dict):
-                    events.append(rec)
-    return events, bad
+    Thin alias of journal.load_events (the shared tolerant loader) —
+    kept for callers/tests that import this module."""
+    return _journal.load_events(paths)
 
 
 def _fmt(ev):
@@ -68,6 +60,10 @@ def _fmt(ev):
         src = " (injected)" if ev.get("injected") else ""
         return (f"{ts} [pid {pid}] probe attempt {ev.get('attempt')}: "
                 f"{ev.get('outcome')}{src}")
+    if kind == "probe_failed":
+        return (f"{ts} [pid {pid}] {ev.get('label', 'probe')} FAILED "
+                f"(attempt {ev.get('attempt')}/{ev.get('attempts')}, "
+                f"backoff {ev.get('backoff_s')}s)")
     if kind == "watchdog_fire":
         return (f"{ts} [pid {pid}] WATCHDOG FIRED "
                 f"({ev.get('mechanism')}) at {ev.get('site')} after "
@@ -115,7 +111,65 @@ def _fmt(ev):
     if kind == "metrics_restricted":
         return (f"{ts} [pid {pid}] TPK_BENCH_ONLY restricts run to "
                 f"{','.join(ev.get('only', []))}")
+    if kind == "span":
+        # spans are high-volume; the narrative stays readable because
+        # they render only in the aggregate breakdown (_span_breakdown)
+        return None
+    if kind == "metrics":
+        snap = ev.get("counters") or {}
+        return (f"{ts} [pid {pid}] metrics snapshot "
+                f"({ev.get('site')}): {len(snap)} counter(s), "
+                f"{len(ev.get('gauges') or {})} gauge(s), "
+                f"{len(ev.get('histograms') or {})} histogram(s)")
+    if kind == "tuning_resolved":
+        return (f"{ts} [pid {pid}] tuning resolved for "
+                f"{ev.get('kernel')}: {ev.get('params')} "
+                f"(sources {ev.get('sources')})")
+    if kind == "tuning_rejected":
+        return (f"{ts} [pid {pid}] tuning-cache REJECTED "
+                f"{ev.get('key')}: {ev.get('reason')}")
+    if kind == "tuning_cache_put":
+        return (f"{ts} [pid {pid}] tuning-cache put {ev.get('key')} "
+                f"params={ev.get('params')}"
+                + (" (smoke)" if ev.get("smoke") else ""))
+    if kind == "tuning_sweep_start":
+        return (f"{ts} [pid {pid}] autotune sweep: {ev.get('kernel')} "
+                f"({ev.get('candidates')} candidate(s), "
+                f"{ev.get('pruned')} pruned"
+                + (", smoke" if ev.get("smoke") else "") + ")")
+    if kind == "tuning_candidate":
+        shown = ev.get("value")
+        shown = shown if shown is not None else f"FAIL ({ev.get('status')})"
+        return (f"{ts} [pid {pid}] candidate {ev.get('params')} -> "
+                f"{shown}")
+    if kind == "tuning_promoted":
+        return (f"{ts} [pid {pid}] PROMOTED {ev.get('kernel')} "
+                f"{ev.get('params')} (value {ev.get('value')} vs "
+                f"control {ev.get('control')})")
+    if kind == "tuning_sweep_end":
+        return (f"{ts} [pid {pid}] sweep end: {ev.get('measured')} "
+                f"measured, {ev.get('failed')} failed, promoted="
+                f"{ev.get('promoted')}")
     return f"{ts} [pid {pid}] {kind}"
+
+
+def _span_breakdown(events):
+    """Per-phase wall-time table aggregated from `span` events
+    (docs/OBSERVABILITY.md §spans) — where a traced session's wall
+    clock went, without replaying the narrative. The aggregation is
+    shared with tools/obs_report.py (trace.aggregate_spans)."""
+    agg = _trace.aggregate_spans(events)
+    if not agg:
+        return []
+    out = ["per-phase wall time (span events):"]
+    for name in sorted(agg, key=lambda n: -agg[n]["total_s"]):
+        a = agg[name]
+        out.append(
+            f"  {name:<36} n={a['count']:<4} "
+            f"total={a['total_s']:.3f}s "
+            f"mean={a['total_s'] / a['count']:.3f}s"
+        )
+    return out
 
 
 def summarize(events, bad=0) -> str:
@@ -134,6 +188,10 @@ def summarize(events, bad=0) -> str:
         if line:
             out.append(line)
     out.append("-" * 60)
+    breakdown = _span_breakdown(events)
+    if breakdown:
+        out.extend(breakdown)
+        out.append("-" * 60)
     counts = {}
     for ev in events:
         counts[ev.get("kind")] = counts.get(ev.get("kind"), 0) + 1

@@ -1,0 +1,143 @@
+"""Lint: every journal event kind must be documented.
+
+Usage:
+    python tools/journal_kinds.py          # rc 0 clean, rc 1 findings
+
+Scans every production ``journal.emit(kind=...)`` callsite (bench.py,
+``tpukernels/``, ``tools/`` — tests may emit throwaway kinds) and
+asserts each kind literal appears in the event-kind catalog of
+docs/OBSERVABILITY.md. The catalog is the contract consumers key on —
+``tools/health_report.py`` narrative lines, ``tools/obs_report.py``
+aggregation, postmortem greps — so an undocumented kind is a consumer
+silently skipping events, which is exactly the failure mode the
+observability layer exists to remove. Runs in tier-1 via
+``tests/test_obs.py::test_journal_kinds_lint``.
+
+Also warns (without failing) on documented-but-unused kinds — usually
+a callsite that was deleted without its doc row — and fails on
+``journal.emit`` callsites whose kind is not a string literal, which
+this lint cannot check (none exist today; keep it that way).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DOC = os.path.join(_REPO, "docs", "OBSERVABILITY.md")
+
+# \s* spans the newline of a wrapped call; the literal must be the
+# first argument, matching every callsite idiom in the repo. \w+
+# (not [a-z_]+): a kind like "phase2_start" must be linted, not
+# silently skipped by a too-narrow character class.
+_EMIT_RE = re.compile(r"journal\.emit\(\s*([\"']\w+[\"']|[^\s\"'])")
+_DOC_KIND_RE = re.compile(r"^\|\s*`(\w+)`", re.MULTILINE)
+
+
+def production_files(repo=_REPO):
+    files = [os.path.join(repo, "bench.py")]
+    for sub in ("tpukernels", "tools"):
+        files.extend(
+            sorted(
+                glob.glob(
+                    os.path.join(repo, sub, "**", "*.py"), recursive=True
+                )
+            )
+        )
+    # the lint's own docstring mentions journal.emit(kind=...) —
+    # scanning itself would flag that prose as an unlintable callsite
+    return [
+        f for f in files
+        if os.path.isfile(f)
+        and os.path.basename(f) != "journal_kinds.py"
+    ]
+
+
+def emitted_kinds(repo=_REPO):
+    """{kind: [file:line, ...]} over production callsites, plus a list
+    of unlintable (non-literal-kind) callsites."""
+    kinds, unlintable = {}, []
+    for path in production_files(repo):
+        with open(path) as f:
+            text = f.read()
+        rel = os.path.relpath(path, repo)
+        for m in _EMIT_RE.finditer(text):
+            where = f"{rel}:{text.count(chr(10), 0, m.start()) + 1}"
+            tok = m.group(1)
+            if tok[0] in "\"'":
+                kinds.setdefault(tok.strip("\"'"), []).append(where)
+            else:
+                unlintable.append(where)
+    return kinds, unlintable
+
+
+def documented_kinds(doc=_DOC):
+    try:
+        with open(doc) as f:
+            return set(_DOC_KIND_RE.findall(f.read()))
+    except OSError:
+        return set()
+
+
+def main(argv=None):
+    repo = _REPO
+    argv = sys.argv[1:] if argv is None else list(argv)
+    it = iter(argv)
+    for a in it:
+        if a == "--root":
+            try:
+                repo = next(it)
+            except StopIteration:
+                print("journal_kinds: --root requires a value",
+                      file=sys.stderr)
+                return 2
+        else:
+            # an ignored argument must not silently lint the wrong
+            # tree and report OK
+            print(f"journal_kinds: unknown argument {a!r}",
+                  file=sys.stderr)
+            return 2
+    kinds, unlintable = emitted_kinds(repo)
+    documented = documented_kinds(
+        os.path.join(repo, "docs", "OBSERVABILITY.md")
+    )
+    rc = 0
+    if not documented:
+        print("journal_kinds: docs/OBSERVABILITY.md has no kind "
+              "catalog (| `kind` | rows) - nothing to lint against")
+        rc = 1
+    undocumented = {k: v for k, v in kinds.items() if k not in documented}
+    for kind in sorted(undocumented):
+        print(
+            f"journal_kinds: kind {kind!r} is emitted but not in the "
+            "docs/OBSERVABILITY.md catalog:"
+        )
+        for where in undocumented[kind]:
+            print(f"  {where}")
+        rc = 1
+    for where in unlintable:
+        print(
+            f"journal_kinds: non-literal kind at {where} - "
+            "unlintable; pass the kind as a string literal"
+        )
+        rc = 1
+    unused = documented - set(kinds)
+    for kind in sorted(unused):
+        print(
+            f"journal_kinds: WARN documented kind {kind!r} has no "
+            "production callsite (stale doc row?)"
+        )
+    if rc == 0:
+        print(
+            f"journal_kinds: OK - {len(kinds)} kinds across "
+            f"{sum(len(v) for v in kinds.values())} callsites, all "
+            "documented"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,196 @@
+"""Observability report: bench trend + span/metric summary + verdicts.
+
+Usage:
+    python tools/obs_report.py [--check] [--root DIR] [--journal FILE]
+                               [--eps FLOAT]
+
+Three sections (docs/OBSERVABILITY.md):
+
+1. **Trend table** — per-metric time series over ``BENCH_r*.json`` +
+   ``docs/logs/bench_*.json`` (``tpukernels/obs/trend.py``) judged
+   against the BASELINE.json measured medians and physical ceilings.
+2. **Span breakdown** — per-phase wall time aggregated from ``span``
+   events in the health journal (default: the newest
+   ``docs/logs/health_*.jsonl``; spans exist only for runs traced
+   with ``TPK_TRACE=1``).
+3. **Metric snapshots** — the last ``metrics`` event per process:
+   counters (probe retries, watchdog kills, tuning-cache traffic),
+   gauges, latency histograms.
+
+Exit-code signaling (``tools/tpu_revalidate.sh`` runs ``--check``
+non-gating and keys a WARN off it):
+    0 — every metric ``ok`` or ``no_data`` (nothing measurable went
+        backwards; tunnel-down nulls are retryable, not failures);
+    1 — at least one ``regression`` or ``impossible`` verdict.
+
+``--check`` prints only the non-ok verdict lines (machine/CI mode);
+the default mode prints the full report. ``--eps`` widens/narrows the
+trend band (default: the ceiling epsilon, ``trend.CEILING_EPS``).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpukernels.obs import trace, trend  # noqa: E402
+from tpukernels.resilience import journal as _journal  # noqa: E402
+
+
+def _fmt_val(v):
+    if v is None:
+        return "-"
+    return f"{v:,.2f}" if isinstance(v, float) else f"{v:,}"
+
+
+def trend_section(verdicts, out):
+    out.append("== bench trend "
+               "(BENCH_r*.json + docs/logs/bench_*.json) ==")
+    hdr = (f"{'metric':<22} {'pts':>3} {'latest':>13} {'best':>13} "
+           f"{'baseline':>13}  verdict")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for name, v in verdicts.items():
+        out.append(
+            f"{name:<22} {v['valid_points']:>3} "
+            f"{_fmt_val(v['latest']):>13} {_fmt_val(v['best']):>13} "
+            f"{_fmt_val(v['baseline']):>13}  {v['verdict']}"
+        )
+        for flag in v["flags"]:
+            out.append(f"    {flag}")
+
+
+def span_section(events, out):
+    agg = trace.aggregate_spans(events)
+    n = sum(a["count"] for a in agg.values())
+    out.append("")
+    out.append(f"== span breakdown ({n} span events) ==")
+    if not agg:
+        out.append("(no spans - run with TPK_TRACE=1 to record them)")
+        return
+    hdr = (f"{'span':<34} {'count':>5} {'total_s':>10} {'mean_s':>9} "
+           f"{'max_s':>9}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for name in sorted(agg, key=lambda k: -agg[k]["total_s"]):
+        a = agg[name]
+        out.append(
+            f"{name:<34} {a['count']:>5} {a['total_s']:>10.3f} "
+            f"{a['total_s'] / a['count']:>9.3f} {a['max_s']:>9.3f}"
+        )
+
+
+def metrics_section(events, out):
+    snaps = [e for e in events if e.get("kind") == "metrics"]
+    out.append("")
+    out.append(f"== metric snapshots ({len(snaps)} in journal) ==")
+    if not snaps:
+        out.append("(no metrics events in the journal)")
+        return
+    # last snapshot per pid: each process's final state supersedes its
+    # own earlier emissions; distinct processes (parent + children)
+    # all contribute
+    last = {}
+    for e in snaps:
+        last[e.get("pid")] = e
+    for pid, e in sorted(last.items(), key=lambda kv: str(kv[0])):
+        out.append(f"[pid {pid}] site={e.get('site')}")
+        for k, v in sorted((e.get("counters") or {}).items()):
+            out.append(f"  counter   {k} = {v}")
+        for k, v in sorted((e.get("gauges") or {}).items()):
+            out.append(f"  gauge     {k} = {v}")
+        for k, h in sorted((e.get("histograms") or {}).items()):
+            out.append(
+                f"  histogram {k}: count={h.get('count')} "
+                f"sum={h.get('sum')} min={h.get('min')} "
+                f"max={h.get('max')}"
+            )
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    check = "--check" in argv
+    root, journal_paths, eps = _REPO, None, trend.CEILING_EPS
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--root":
+                root = next(it)
+            elif a == "--journal":
+                journal_paths = [next(it)]
+            elif a == "--eps":
+                eps = float(next(it))
+            elif a != "--check":
+                print(__doc__, file=sys.stderr)
+                print(f"obs_report: unknown argument {a!r}",
+                      file=sys.stderr)
+                return 2
+    except StopIteration:
+        # a flag without its value is a usage error (rc 2), never the
+        # rc 1 the exit-code contract reserves for a real regression
+        print(f"obs_report: {a} requires a value", file=sys.stderr)
+        return 2
+    except ValueError:
+        # same contract for a malformed value (--eps abc)
+        print(f"obs_report: {a} needs a numeric value", file=sys.stderr)
+        return 2
+    if journal_paths is None:
+        found = sorted(
+            glob.glob(os.path.join(root, "docs", "logs",
+                                   "health_*.jsonl")),
+            key=os.path.basename,
+        )
+        journal_paths = found[-1:] if found else []
+
+    verdicts = trend.analyze_repo(root, eps=eps)
+    bad = {
+        n: v for n, v in verdicts.items()
+        if v["verdict"] in ("regression", "impossible")
+    }
+
+    if check:
+        for name, v in bad.items():
+            print(f"{name}: {v['verdict']}")
+            for flag in v["flags"]:
+                print(f"  {flag}")
+        ok = sum(1 for v in verdicts.values() if v["verdict"] == "ok")
+        nodata = sum(
+            1 for v in verdicts.values() if v["verdict"] == "no_data"
+        )
+        print(
+            f"obs_report --check: {len(bad)} failing, {ok} ok, "
+            f"{nodata} no-data (no-data is retryable, not a failure)"
+        )
+        return 1 if bad else 0
+
+    out = []
+    events, _bad = _journal.load_events(journal_paths)
+    trend_section(verdicts, out)
+    span_section(events, out)
+    metrics_section(events, out)
+    out.append("")
+    if bad:
+        out.append(
+            "VERDICT: " + "; ".join(
+                f"{n} {v['verdict']}" for n, v in bad.items()
+            )
+        )
+    else:
+        out.append("VERDICT: trend clean (no regression, no "
+                   "impossible value)")
+    if journal_paths:
+        out.append(
+            "journal: " + ", ".join(
+                os.path.relpath(p) for p in journal_paths
+            )
+        )
+    print("\n".join(out))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,6 +78,20 @@ printf '%s\n' "$bench_out"
 printf '%s\n' "$bench_out" | tail -1 > "docs/logs/bench_$(date +%Y-%m-%d_%H%M%S).json"
 printf '%s\n' "$bench_out" | tail -1 | python bench.py --check-regression $union_flag
 
+# 1b. Observability trend check (docs/OBSERVABILITY.md): machine-reads
+#     the whole artifact history (BENCH_r*.json + docs/logs/bench_*)
+#     just persisted above and flags >1%-band regressions and
+#     physically-impossible captures. Non-gating: the 15% gate in 1 is
+#     the pass/fail authority; this is the early-drift tripwire, and a
+#     WARN here is a prompt to read `python tools/obs_report.py`
+#     before promoting any baseline.
+if python tools/obs_report.py --check; then
+  echo "obs trend check: OK"
+else
+  echo "WARN: obs_report --check flagged the bench trend (rc=$?," \
+       "non-gating) - run 'python tools/obs_report.py' for the story"
+fi
+
 # 2. C acceptance gate: serial/omp + real TPU rows + fake-device mesh
 c_gate_step() {
   make -C c -s

@@ -16,6 +16,7 @@ import functools
 import json
 import math
 import os
+import time
 
 import numpy as np
 
@@ -30,6 +31,11 @@ ensure_compilation_cache()
 # Resilience layer (stdlib-only, honors the env-before-jax-import
 # rule): fault-injection point + health journal for the C entry.
 from tpukernels.resilience import faults, journal
+
+# Observability (stdlib-only too, docs/OBSERVABILITY.md): per-kernel
+# dispatch spans/counters/latency histograms for the C entry.
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.obs import trace
 
 _PROFILE_DIR = os.environ.get("TPU_KERNELS_PROFILE")
 _profiling = False
@@ -67,8 +73,11 @@ def stop_profiler():
 
 def shutdown_from_c() -> int:
     """Called by the shim's tpu_shutdown (C atexit): flush anything
-    that only flushes on clean teardown — today, the profiler trace."""
+    that only flushes on clean teardown — the profiler trace and the
+    final metrics snapshot (C hosts never finalize the interpreter,
+    so obs.metrics' own atexit hook would never fire there)."""
     stop_profiler()
+    obs_metrics.emit_snapshot(site="capi.shutdown")
     return 0
 
 # Exactly the dtypes the C drivers emit in their buffer specs (grep
@@ -428,12 +437,19 @@ def run_from_c(kernel: str, params_json: str, addrs) -> int:
         raise KeyError(
             f"no C adapter for kernel {kernel!r}; known: {sorted(_ADAPTERS)}"
         ) from None
+    t0 = time.perf_counter()
     try:
-        fn(p, arrs)
+        with trace.span(f"capi/{kernel}", kernel=kernel):
+            fn(p, arrs)
     except Exception as e:  # noqa: BLE001 — journaled, then re-raised
         # the C host sees the exception through the shim; the journal
         # keeps a structured record even when the host's stderr is
         # lost (opt-in: no-op unless TPK_HEALTH_JOURNAL is set)
+        obs_metrics.inc(f"capi.errors.{kernel}")
         journal.emit("capi_error", kernel=kernel, error=repr(e))
         raise
+    # wall time includes H2D + compute + D2H — the same window the C
+    # driver's timing loop sees (module docstring "honest timing")
+    obs_metrics.inc(f"capi.calls.{kernel}")
+    obs_metrics.observe(f"capi.wall_s.{kernel}", time.perf_counter() - t0)
     return 0

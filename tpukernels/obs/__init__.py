@@ -1,0 +1,24 @@
+"""Observability layer: tracing, metrics, bench-trend analysis.
+
+Three stdlib-only modules (safe before the jax import, like the
+resilience layer they build on — docs/OBSERVABILITY.md):
+
+- ``trace``   — nested span context manager (``with span("measure/
+  sgemm")``) recording wall time, phase and kernel params into the
+  resilience health journal; a zero-cost no-op when ``TPK_TRACE`` is
+  unset, proven byte-identical on the clean bench path.
+- ``metrics`` — process-local counters/gauges/histograms (per-kernel
+  call counts and latencies, probe retries, watchdog kills,
+  tuning-cache hits/misses/rejections), snapshot-emitted through the
+  same journal so one JSONL file stays the source of truth.
+- ``trend``   — loads ``BENCH_r*.json`` + ``docs/logs/bench_*.json``
+  into per-metric time series and machine-checks the perf trajectory:
+  regressions beyond the ceiling-epsilon band, physically-impossible
+  values (the 72,698-GFLOPS class of error), tunnel-down nulls as
+  "no data" — never as a regression.
+
+CLI: ``python tools/obs_report.py`` renders the trend table, span and
+metric summaries and the regression verdicts.
+"""
+
+from tpukernels.obs import metrics, trace, trend  # noqa: F401

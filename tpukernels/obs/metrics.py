@@ -1,0 +1,114 @@
+"""Process-local metrics: counters, gauges, histograms.
+
+The catalog lives in docs/OBSERVABILITY.md §metrics — per-kernel call
+counts and wall-time histograms (capi dispatch), bench probe retry
+counts, watchdog fires/kills, tuning-cache hits/misses/rejections.
+
+Design constraints, in order:
+
+1. **Recording must be allowed on the clean path.** Unlike spans
+   (gated on ``TPK_TRACE``), a counter bump is a dict update with no
+   I/O and no output — it cannot perturb stdout or timing at any
+   observable scale, so the instrumented callsites increment
+   unconditionally and the byte-identical clean-path proof still
+   holds (``tests/test_obs.py``).
+2. **Emission is journal-routed and survives failures.** Nothing
+   leaves the process unless :func:`emit_snapshot` runs AND the
+   resilience journal is enabled (``TPK_HEALTH_JOURNAL``); the
+   snapshot lands as one ``metrics`` event in the same JSONL stream
+   as spans and health events. An atexit hook (registered at import)
+   flushes the final state of every process automatically — a bench
+   child dying on a watchdog Timeout, a failing autotune sweep —
+   because the failing run is exactly the one a postmortem reads.
+   C hosts never finalize the interpreter, so ``capi.shutdown_from_c``
+   calls :func:`emit_snapshot` explicitly (the same split the
+   profiler-flush uses). Only a hard SIGKILL loses the snapshot.
+3. **Histograms are summaries, not buckets.** count/sum/min/max per
+   name (mean derivable) — enough for "where did the wall time go"
+   without inventing bucket boundaries per metric.
+
+State is per-process (bench ``--one`` children snapshot their own);
+:func:`reset` exists for tests.
+"""
+
+from __future__ import annotations
+
+from tpukernels.resilience import journal
+
+_COUNTERS: dict = {}
+_GAUGES: dict = {}
+_HISTS: dict = {}  # name -> [count, sum, min, max]
+
+
+def inc(name: str, n: float = 1):
+    """Add ``n`` (default 1) to counter ``name``, creating it at 0."""
+    _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def gauge(name: str, value: float):
+    """Set gauge ``name`` to ``value`` (last write wins)."""
+    _GAUGES[name] = value
+
+
+def observe(name: str, value: float):
+    """Record one sample into histogram ``name``."""
+    h = _HISTS.get(name)
+    if h is None:
+        _HISTS[name] = [1, value, value, value]
+    else:
+        h[0] += 1
+        h[1] += value
+        if value < h[2]:
+            h[2] = value
+        if value > h[3]:
+            h[3] = value
+
+
+def snapshot() -> dict:
+    """Copy of the current state: ``{"counters": {...}, "gauges":
+    {...}, "histograms": {name: {count, sum, min, max}}}``."""
+    return {
+        "counters": dict(_COUNTERS),
+        "gauges": dict(_GAUGES),
+        "histograms": {
+            k: {
+                "count": v[0],
+                "sum": round(v[1], 6),
+                "min": round(v[2], 6),
+                "max": round(v[3], 6),
+            }
+            for k, v in _HISTS.items()
+        },
+    }
+
+
+def emit_snapshot(site: str | None = None):
+    """Emit one ``metrics`` journal event holding the full snapshot.
+    No-op when nothing was recorded or journaling is off — a library
+    import must never create a journal file just by exiting."""
+    if not (_COUNTERS or _GAUGES or _HISTS):
+        return
+    if not journal.enabled():
+        return
+    journal.emit("metrics", site=site, **snapshot())
+
+
+def reset():
+    """Drop all recorded state (tests; never called on real paths)."""
+    _COUNTERS.clear()
+    _GAUGES.clear()
+    _HISTS.clear()
+
+
+def _atexit_flush():
+    import os
+    import sys
+
+    emit_snapshot(
+        site="atexit:" + os.path.basename(sys.argv[0] or "?")
+    )
+
+
+import atexit  # noqa: E402 — placed with its registration on purpose
+
+atexit.register(_atexit_flush)

@@ -1,0 +1,161 @@
+"""Nested span tracing for the bench hot path (docs/OBSERVABILITY.md).
+
+``with span("measure/sgemm", m=1024):`` records the span's wall time,
+its position in the enclosing span stack and any keyword params, and
+emits one ``span`` event into the resilience health journal on exit —
+one JSONL stream stays the single source of truth for a session
+(artifacts, health events and spans all correlate by ``t``/``pid``/
+``git_head``).
+
+``TPK_TRACE`` routing, mirroring the fault layer's clean-path
+contract (``TPK_FAULT_PLAN``): unset — or ``0``/``off``/``none`` —
+makes ``span()`` a single module-global check returning a shared
+no-op object, so the production bench path pays nothing and its
+stdout is byte-identical (``tests/test_obs.py`` proves it the same
+way ``test_clean_path_output_byte_identical`` proves the fault
+layer's). Any other value enables tracing. The flag is read once at
+import (children inherit it through the environment, exactly like
+fault plans); tests that flip it mid-process call :func:`reload`.
+
+Span naming scheme (docs/OBSERVABILITY.md §spans): slash-separated,
+``<area>/<detail>`` — ``suite/<metric>`` (bench parent, one per
+killable child), ``measure/<metric>`` (bench ``--one`` child, whole
+measurement), ``slope/compile`` / ``slope/execute`` (the ``_slope``
+phases inside it), ``probe/liveness``, ``registry/populate``,
+``capi/<kernel>``, ``tune/<kernel>``. Nested spans join their names
+onto the enclosing path: ``measure/sgemm`` > ``slope/compile`` lands
+as ``measure/sgemm/slope/compile``. State is per-process (the span
+stack is not thread-safe by design — the instrumented paths are
+single-threaded measurement loops).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from tpukernels.resilience import journal
+
+_DISABLED = ("", "0", "off", "none")
+
+
+def _read_enabled() -> bool:
+    raw = os.environ.get("TPK_TRACE")
+    return raw is not None and raw.strip().lower() not in _DISABLED
+
+
+_ENABLED = _read_enabled()
+_STACK: list = []  # enclosing span names, innermost last (per process)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def reload() -> bool:
+    """Re-read TPK_TRACE (tests flip the env mid-process; real runs
+    load once at import, like the fault layer). Clears the span stack:
+    a stale parent path must not prefix spans from the new regime."""
+    global _ENABLED
+    _ENABLED = _read_enabled()
+    _STACK.clear()
+    return _ENABLED
+
+
+def current_path() -> str | None:
+    """Slash-joined path of the innermost open span, or None."""
+    return "/".join(_STACK) if _STACK else None
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager for the disabled path — no
+    allocation, no clock read, no stack touch per ``span()`` call."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+# span-event keys the emitter owns (plus the journal's own stamps): a
+# caller field with one of these names — tuning spans forward
+# arbitrary tunable names via **params — is prefixed instead of being
+# allowed to raise a duplicate-kwarg TypeError out of __exit__ or to
+# clobber the journal's timestamp/pid stamps
+_RESERVED = ("kind", "ts", "t", "pid", "git_head",
+             "name", "wall_s", "depth", "ok")
+
+
+class _Span:
+    __slots__ = ("name", "fields", "path", "t0", "depth")
+
+    def __init__(self, name: str, fields: dict):
+        self.name = name
+        self.fields = fields
+
+    def __enter__(self):
+        _STACK.append(self.name)
+        self.depth = len(_STACK)
+        self.path = "/".join(_STACK)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        wall = time.perf_counter() - self.t0
+        # unwind by identity, tolerating a stack corrupted by an
+        # earlier non-LIFO exit: observability must not mask (or
+        # worsen) the failure it is observing
+        if _STACK and _STACK[-1] == self.name:
+            _STACK.pop()
+        payload = {
+            ("param_" + k if k in _RESERVED else k): v
+            for k, v in self.fields.items()
+        }
+        payload.update(
+            name=self.path,
+            wall_s=round(wall, 6),
+            depth=self.depth,
+            ok=exc_type is None,
+        )
+        journal.emit("span", **payload)
+        return False
+
+
+def aggregate_spans(events) -> dict:
+    """``{name: {"count", "total_s", "max_s"}}`` over ``span`` journal
+    events — the one aggregation behind tools/health_report.py's
+    per-phase breakdown and tools/obs_report.py's span section, so a
+    span-schema change cannot drift the two reports apart."""
+    agg: dict = {}
+    for ev in events:
+        if ev.get("kind") != "span":
+            continue
+        name = ev.get("name", "?")
+        wall = ev.get("wall_s") or 0.0
+        a = agg.get(name)
+        if a is None:
+            agg[name] = {"count": 1, "total_s": wall, "max_s": wall}
+        else:
+            a["count"] += 1
+            a["total_s"] += wall
+            if wall > a["max_s"]:
+                a["max_s"] = wall
+    return agg
+
+
+def span(name: str, /, **fields):
+    """Context manager timing one named phase. ``fields`` (kernel
+    params, shapes, repeat counts) ride along on the emitted event;
+    ``name`` is positional-only so a caller field named ``name`` (the
+    tuning runner forwards arbitrary tunable names) stays a field.
+    With TPK_TRACE unset this is one global check and a shared no-op
+    object — nothing else runs."""
+    if not _ENABLED:
+        return _NOOP
+    return _Span(name, fields)

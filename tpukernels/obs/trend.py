@@ -1,0 +1,289 @@
+"""Bench-trend time series + regression verdicts (docs/OBSERVABILITY.md).
+
+Loads every committed bench artifact — the driver's ``BENCH_r*.json``
+round files at the repo root plus ``docs/logs/bench_*.json`` — into a
+per-metric time series and machine-checks the perf trajectory, so the
+drift-inflated sgemm figure that BASELINE.md caught BY HAND (72,698
+GFLOPS against the ~61 TFLOPS physical ceiling) is caught by machine
+the next time, and a down tunnel's all-null rounds read as "no data",
+never as a regression.
+
+Parsing rules (the evidence formats in the wild, all tolerated):
+
+- ``docs/logs/bench_*.json`` — one bench JSON line per file, ordered
+  by the filename timestamp (git does not preserve mtimes).
+- ``BENCH_r*.json`` — driver round files: the bench line sits under
+  ``"parsed"`` (fallback: last line of ``"tail"``), ordered by round
+  number after the dated artifacts.
+- A tunnel-down line nests earlier evidence under
+  ``details.last_persisted_artifact`` (``{"path", "line"}``) next to
+  the string ``details.error`` — the nested line's surviving metrics
+  (e.g. the stencil2d 131,799 Mcells/s inside ``BENCH_r04``) are
+  pulled into the series at the NESTED artifact's own position,
+  deduplicated by path so five rounds pointing at one artifact count
+  it once. String detail values (the error text) are never evidence.
+- ``invalidated`` blocks (``{metric: [raw, reason]}``) contribute
+  their raw value to the ceiling check only — already caught at the
+  source, they are reported as such, and never count as measurements.
+
+Verdicts per metric (:func:`analyze`):
+
+- ``impossible`` — a RAW detail value exceeds the metric's physical
+  ceiling (BASELINE.json ``ceilings``) beyond the ceiling-epsilon
+  band; dominates everything else.
+- ``regression`` — the newest valid value sits more than the epsilon
+  band below the best earlier valid value or below the BASELINE.json
+  measured median. Deliberately tighter than the revalidate queue's
+  15% hard gate: this is a non-gating trend REPORT, so it flags at
+  the same 1% epsilon the ceiling logic uses.
+- ``no_data`` — no valid measurement anywhere in the series (all
+  nulls / tunnel-down / invalidated). Retryable, never a failure.
+- ``ok`` — otherwise.
+
+The bands mirror bench.py's constants — ``CEILING_EPS`` must equal
+``bench._CEILING_EPS`` and ``REGRESSION_TOL`` ``bench._REGRESSION_TOL``
+(asserted by ``tests/test_obs.py``; importing bench from here would
+drag jax into a stdlib-only module).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+CEILING_EPS = 0.01   # == bench._CEILING_EPS (test-enforced mirror)
+REGRESSION_TOL = 0.15  # == bench._REGRESSION_TOL (ditto; the hard gate)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _is_measurement(v) -> bool:
+    """Mirror of bench._is_measurement: numeric, not bool, not the
+    string payloads of a tunnel-down error line."""
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def load_baseline(root) -> dict:
+    try:
+        with open(os.path.join(root, "BASELINE.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _numeric_table(d) -> dict:
+    """Numeric-valued entries of a BASELINE.json block (drops the
+    ``_note``/``measured_on`` prose keys)."""
+    return {
+        k: v for k, v in (d or {}).items() if _is_measurement(v)
+    }
+
+
+def _bench_line(rec):
+    """The bench JSON line inside an artifact record, or None.
+
+    Accepts a bare line (docs/logs files), a driver round file
+    (``parsed`` holds the line; fallback: last line of ``tail``), and
+    rejects anything without a ``details`` dict."""
+    if not isinstance(rec, dict):
+        return None
+    if isinstance(rec.get("details"), dict):
+        return rec
+    parsed = rec.get("parsed")
+    if isinstance(parsed, dict) and isinstance(parsed.get("details"), dict):
+        return parsed
+    tail = rec.get("tail")
+    if isinstance(tail, str):
+        for raw in reversed(tail.strip().splitlines()):
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    line = json.loads(raw)
+                except ValueError:
+                    return None
+                if isinstance(line.get("details"), dict):
+                    return line
+                return None
+    return None
+
+
+def _points_from_line(line, source, order, out):
+    """Append this line's evidence to the series dict ``out``:
+    measured details as valid points, invalidated raws as
+    ceiling-check-only points. Returns the nested
+    ``last_persisted_artifact`` dict (or None) for the caller to
+    resolve — resolution needs the dedupe state this helper lacks."""
+    details = line.get("details") or {}
+    for name, v in details.items():
+        if _is_measurement(v):
+            out.setdefault(name, []).append(
+                {"value": v, "raw": v, "source": source, "order": order,
+                 "invalidated": None}
+            )
+    for name, iv in (line.get("invalidated") or {}).items():
+        raw = iv[0] if isinstance(iv, (list, tuple)) and iv else None
+        if _is_measurement(raw):
+            out.setdefault(name, []).append(
+                {"value": None, "raw": raw, "source": source,
+                 "order": order,
+                 "invalidated": str(iv[1]) if len(iv) > 1 else "?"}
+            )
+    nested = details.get("last_persisted_artifact")
+    return nested if isinstance(nested, dict) else None
+
+
+def load_series(root) -> dict:
+    """{metric: [point, ...]} over every committed bench artifact
+    under ``root``, each series ordered oldest → newest. Unparseable
+    files are skipped (a truncated artifact must not take down the
+    report that would explain it)."""
+    out: dict = {}
+    seen_paths: set = set()
+
+    def _read(p):
+        try:
+            with open(p) as f:
+                return json.loads(f.read().strip() or "null")
+        except (OSError, ValueError):
+            return None
+
+    def _nested(nest):
+        # pull the pointed-at line's metrics in at the NESTED
+        # artifact's own position; dedupe by path across rounds (and
+        # against the dated files loaded directly above)
+        relp = nest.get("path")
+        line = _bench_line(nest.get("line"))
+        if not isinstance(relp, str) or line is None:
+            return
+        key = os.path.normpath(relp)
+        if key in seen_paths:
+            return
+        seen_paths.add(key)
+        deeper = _points_from_line(
+            line, relp, (0, os.path.basename(relp)), out
+        )
+        if deeper is not None:
+            _nested(deeper)
+
+    for p in sorted(
+        glob.glob(os.path.join(root, "docs", "logs", "bench_*.json")),
+        key=os.path.basename,
+    ):
+        line = _bench_line(_read(p))
+        if line is None:
+            continue
+        rel = os.path.relpath(p, root)
+        seen_paths.add(os.path.normpath(rel))
+        nest = _points_from_line(
+            line, rel, (0, os.path.basename(p)), out
+        )
+        if nest is not None:
+            _nested(nest)
+
+    rounds = []
+    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(p))
+        if m:
+            rounds.append((int(m.group(1)), p))
+    for n, p in sorted(rounds):
+        line = _bench_line(_read(p))
+        if line is None:
+            continue
+        nest = _points_from_line(
+            line, os.path.relpath(p, root), (1, n), out
+        )
+        if nest is not None:
+            _nested(nest)
+
+    for pts in out.values():
+        pts.sort(key=lambda pt: pt["order"])
+    return out
+
+
+def analyze(series, baseline=None, eps=CEILING_EPS) -> dict:
+    """Per-metric verdicts over :func:`load_series` output. See the
+    module docstring for the verdict rules; ``flags`` carries one
+    human-readable line per finding so the report needs no re-derive.
+    Metrics the baseline knows but the series lacks report ``no_data``
+    too — coverage holes are part of the trend story."""
+    baseline = baseline or {}
+    ceilings = _numeric_table(baseline.get("ceilings"))
+    measured = _numeric_table(baseline.get("measured"))
+    verdicts = {}
+    for metric in sorted(set(series) | set(measured)):
+        pts = series.get(metric, [])
+        ceiling = ceilings.get(metric)
+        flags, valid = [], []
+        impossible = False
+        for pt in pts:
+            raw = pt["raw"]
+            if (
+                ceiling is not None
+                and raw is not None
+                and raw > ceiling * (1.0 + eps)
+            ):
+                if pt["invalidated"]:
+                    flags.append(
+                        f"{raw} from {pt['source']} exceeds ceiling "
+                        f"{ceiling} - already invalidated at source "
+                        f"({pt['invalidated']})"
+                    )
+                else:
+                    impossible = True
+                    flags.append(
+                        f"IMPOSSIBLE: {raw} from {pt['source']} exceeds "
+                        f"physical ceiling {ceiling} (+{eps:.0%}) and was "
+                        "never invalidated"
+                    )
+                continue
+            if pt["value"] is not None:
+                valid.append(pt)
+        base = measured.get(metric)
+        info = {
+            "valid_points": len(valid),
+            "latest": valid[-1]["value"] if valid else None,
+            "latest_source": valid[-1]["source"] if valid else None,
+            "best": max((p["value"] for p in valid), default=None),
+            "baseline": base,
+            "flags": flags,
+        }
+        if impossible:
+            info["verdict"] = "impossible"
+        elif not valid:
+            info["verdict"] = "no_data"
+            flags.append(
+                "no valid measurement in any artifact (tunnel-down "
+                "nulls are no data, not a regression)"
+            )
+        else:
+            latest = info["latest"]
+            regressed = False
+            prior_best = max(
+                (p["value"] for p in valid[:-1]), default=None
+            )
+            if prior_best and latest < prior_best * (1.0 - eps):
+                regressed = True
+                flags.append(
+                    f"REGRESSION: latest {latest} "
+                    f"({info['latest_source']}) is "
+                    f"{latest / prior_best:.3f}x of prior best "
+                    f"{prior_best} (band {eps:.0%})"
+                )
+            if base and latest < base * (1.0 - eps):
+                regressed = True
+                flags.append(
+                    f"REGRESSION: latest {latest} is "
+                    f"{latest / base:.3f}x of the BASELINE.json "
+                    f"measured median {base} (band {eps:.0%}; hard "
+                    f"gate fails below {1.0 - REGRESSION_TOL:.2f}x)"
+                )
+            info["verdict"] = "regression" if regressed else "ok"
+        verdicts[metric] = info
+    return verdicts
+
+
+def analyze_repo(root, eps=CEILING_EPS) -> dict:
+    """One-call path for tools: series + baseline + verdicts."""
+    return analyze(load_series(root), load_baseline(root), eps=eps)

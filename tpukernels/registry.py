@@ -33,7 +33,10 @@ from typing import Callable, Dict
 # stdlib-only (no jax), so importing it here keeps `import tpukernels`
 # jax-free; gives _populate its fault-injection point and journals
 # real import failures as health events (docs/RESILIENCE.md).
-# tuning.space is likewise stdlib-only at import time.
+# tuning.space and the observability layer (docs/OBSERVABILITY.md)
+# are likewise stdlib-only at import time.
+from tpukernels.obs import metrics as _obs_metrics
+from tpukernels.obs import trace as _trace
 from tpukernels.resilience import faults, journal
 from tpukernels.tuning import space as _tuning_space
 
@@ -157,8 +160,14 @@ def _populate():
         _REGISTRY["nbody"] = _nbody.nbody_step
         _spaces(_nbody)
 
-    _group(("vector_add", "sgemm"), _load_core, required=True)
-    _group(("stencil2d", "stencil3d"), _load_stencil)
-    _group(("scan", "scan_exclusive", "histogram"), _load_scan_hist)
-    _group(("nbody",), _load_nbody)
+    # the populate span brackets the first-lookup cost — kernel module
+    # imports, and with them jax + the TPU runtime — the lazy-import
+    # design exists to defer; the counter proves laziness held (one
+    # populate per process, not one per lookup)
+    _obs_metrics.inc("registry.populates")
+    with _trace.span("registry/populate"):
+        _group(("vector_add", "sgemm"), _load_core, required=True)
+        _group(("stencil2d", "stencil3d"), _load_stencil)
+        _group(("scan", "scan_exclusive", "histogram"), _load_scan_hist)
+        _group(("nbody",), _load_nbody)
     _POPULATED = True

@@ -96,6 +96,34 @@ def enabled() -> bool:
     return path() is not None
 
 
+def load_events(paths):
+    """Parse events from JSONL journal files, in file order then line
+    order; returns (events, bad_line_count). Tolerant by design —
+    blank lines skipped, unparseable lines counted not fatal, missing
+    files skipped — because a journal truncated by a crash is exactly
+    when a postmortem reader needs whatever survives. The one loader
+    behind tools/health_report.py and tools/obs_report.py."""
+    events, bad = [], 0
+    for p in paths:
+        try:
+            f = open(p)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                if isinstance(rec, dict):
+                    events.append(rec)
+    return events, bad
+
+
 def emit(kind: str, **fields):
     """Append one health event; never raises (observability must not
     become a new failure mode of the path it observes)."""

@@ -32,6 +32,7 @@ import subprocess
 import sys
 import time
 
+from tpukernels.obs import metrics
 from tpukernels.resilience import journal
 
 
@@ -46,6 +47,7 @@ def run_with_alarm(fn, seconds: int, site: str | None = None):
     innocent caller."""
 
     def handler(signum, frame):
+        metrics.inc("watchdog.sigalrm_fires")
         journal.emit(
             "watchdog_fire", mechanism="sigalrm", site=site,
             timeout_s=seconds,
@@ -69,6 +71,7 @@ def kill_after(argv, timeout_s: float, site: str | None = None, **run_kw):
     try:
         proc = subprocess.run(argv, timeout=timeout_s, **run_kw)
     except subprocess.TimeoutExpired:
+        metrics.inc("watchdog.kills")
         journal.emit(
             "watchdog_fire", mechanism="subprocess-kill", site=site,
             timeout_s=timeout_s, argv=[str(a) for a in argv[:4]],
@@ -98,6 +101,15 @@ def patient_probe(
             f"# {label} failed (attempt {attempt + 1}/{attempts})",
             file=sys.stderr,
         )
+        # structured twin of the stderr line: trend analysis needs to
+        # separate "tunnel down" (probe retries, then nulls) from
+        # "kernel slow" (clean probes, bad slope) without grepping
+        backoff = retry_wait_s if attempt + 1 < attempts else 0.0
+        metrics.inc("probe.retries")
+        journal.emit(
+            "probe_failed", label=label, attempt=attempt + 1,
+            attempts=attempts, backoff_s=backoff,
+        )
         if attempt + 1 < attempts:
             time.sleep(retry_wait_s)
     return False
@@ -110,5 +122,6 @@ def classify_timeout(probe_alive: bool, **ctx) -> str:
     name etc.) so a postmortem reads the decision, not just its
     side effects."""
     verdict = "slow" if probe_alive else "wedged"
+    metrics.inc(f"watchdog.classified_{verdict}")
     journal.emit("wedge_classification", verdict=verdict, **ctx)
     return verdict

@@ -39,6 +39,7 @@ import sys
 import time
 
 from tpukernels import _cachedir
+from tpukernels.obs import metrics as obs_metrics
 from tpukernels.resilience import journal
 
 _REPO = os.path.dirname(
@@ -138,6 +139,9 @@ def _reject(key, reason, **fields):
     """Loud-rejection contract (same as bench's epoch rejections): a
     stale entry's dismissal must be reconstructable from stderr and
     the journal, but only once per process per cause."""
+    # counted per occurrence (a hot dispatch loop re-hitting a stale
+    # entry shows up as volume), noted/journaled once per cause
+    obs_metrics.inc("tuning.cache.rejections")
     memo = (key, reason)
     if memo in _REJECT_NOTED:
         return
@@ -154,11 +158,10 @@ def get(space, shape=None, dtype=None, kind=None):
         return None
     data = _load(path())
     entries = data.get("entries")
-    if not isinstance(entries, dict):
-        return None
     key = key_str(space.kernel, shape, dtype, kind)
-    entry = entries.get(key)
+    entry = entries.get(key) if isinstance(entries, dict) else None
     if not isinstance(entry, dict):
+        obs_metrics.inc("tuning.cache.misses")
         return None
     if entry.get("smoke") and os.environ.get("TPK_BENCH_SMOKE") != "1":
         # smoke entries prove the sweep->cache->dispatch pipeline;
@@ -190,7 +193,11 @@ def get(space, shape=None, dtype=None, kind=None):
         )
         return None
     params = entry.get("params")
-    return params if isinstance(params, dict) else None
+    if isinstance(params, dict):
+        obs_metrics.inc("tuning.cache.hits")
+        return params
+    obs_metrics.inc("tuning.cache.misses")
+    return None
 
 
 def put(

@@ -32,6 +32,8 @@ import subprocess
 import sys
 import time
 
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.obs import trace
 from tpukernels.resilience import journal, watchdog
 
 _REPO = os.path.dirname(
@@ -191,8 +193,15 @@ def tune(
         env = dict(env0)
         env.update(space.env_for(params))
         t0 = time.monotonic()
-        value, status = run_candidate(space.metric, env, timeout_s)
+        # candidate params ride on the span so a trace of the sweep
+        # shows where the sweep's wall clock went per configuration
+        with trace.span(f"tune/{kernel}", **params):
+            value, status = run_candidate(space.metric, env, timeout_s)
         elapsed = round(time.monotonic() - t0, 2)
+        obs_metrics.inc(
+            "tuning.candidates_ok" if value is not None
+            else "tuning.candidates_failed"
+        )
         journal.emit(
             "tuning_candidate",
             kernel=kernel,
