@@ -1,12 +1,14 @@
 """Stamp/resume logic of the revalidation queue, proven on CPU.
 
-tools/tpu_revalidate.sh resumes across tunnel flaps via per-day step
-stamps; that logic was previously inline (testable only by running the
-whole chip-bound queue) and is now sourced from
-tools/revalidate_lib.sh, so a stubbed queue here drives the EXACT
-step_done/stamp/run_step implementation the real queue runs:
-a failed step never stamps, a stamped step is skipped on retry, and
-TPK_REVALIDATE_FORCE=1 re-runs everything.
+tools/revalidate_lib.sh is the shell face of the per-day step-stamp
+contract (the python supervisor reads/writes the same files —
+tests/test_supervisor.py proves the cross-equivalence): a failed step
+never stamps, a stamped step is skipped on retry, and
+TPK_REVALIDATE_FORCE=1 re-runs everything. Since the supervisor PR the
+stamps are also GIT-AWARE: each stamp records the HEAD sha, and a
+later commit touching the step's inputs re-runs the step
+automatically — retiring the documented same-day-code-change footgun
+(FORCE survives as the explicit manual override).
 """
 
 import datetime
@@ -117,10 +119,11 @@ def test_stamps_are_per_day(queue):
     assert ran() == ["a", "b", "c"]   # yesterday's stamp ignored
 
 
-def test_real_queue_scripts_parse_and_source_the_lib():
-    """bash -n both scripts (the queue is unattended — a syntax error
-    would surface mid-recovery) and pin the queue to the sourced lib
-    so these tests keep covering the deployed logic."""
+def test_real_queue_scripts_parse_and_delegate():
+    """bash -n all scripts (the queue is unattended — a syntax error
+    would surface mid-recovery) and pin the wrappers to the python
+    supervisor: the queue logic these tests cover must not silently
+    grow a drifted inline copy in shell again."""
     for script in ("tools/tpu_revalidate.sh", "tools/revalidate_lib.sh",
                    "tools/tpu_wait_and_revalidate.sh"):
         r = subprocess.run(
@@ -130,5 +133,94 @@ def test_real_queue_scripts_parse_and_source_the_lib():
         assert r.returncode == 0, (script, r.stderr)
     with open(os.path.join(REPO, "tools", "tpu_revalidate.sh")) as f:
         body = f.read()
-    assert "source tools/revalidate_lib.sh" in body
+    assert "exec python tools/revalidate.py" in body
     assert "step_done()" not in body  # no drifted inline copy
+
+
+@pytest.fixture
+def stamp_git_repo(tmp_path):
+    """A throwaway git repo for the git-awareness tests (the repo the
+    queue runs in is the input source, so the tests need commits)."""
+    repo = tmp_path / "gitrepo"
+    repo.mkdir()
+    (repo / "bench.py").write_text("# v1\n")
+    (repo / "README").write_text("r\n")
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-C", str(repo), *args], check=True, timeout=30,
+            capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t",
+                 "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    return repo, git
+
+
+def _lib_call(repo, stamps, snippet, inputs="bench.py"):
+    r = subprocess.run(
+        ["bash", "-c",
+         f'stamp_dir="{stamps}"; step_inputs="{inputs}"; '
+         f'source "{LIB}"; {snippet}'],
+        capture_output=True, text=True, timeout=30, cwd=str(repo),
+        env={k: v for k, v in os.environ.items()
+             if k != "TPK_REVALIDATE_FORCE"},
+    )
+    return r
+
+
+def test_stamp_records_head_and_commit_touching_inputs_reruns(
+        tmp_path, stamp_git_repo):
+    """The retired footgun: a same-day commit touching a step's
+    inputs used to leave a stale stamp unless the operator remembered
+    TPK_REVALIDATE_FORCE=1. Now the stamp records the HEAD sha and
+    step_done goes stale by itself."""
+    repo, git = stamp_git_repo
+    stamps = tmp_path / "stamps"
+    stamps.mkdir()
+    assert _lib_call(repo, stamps, "stamp s1").returncode == 0
+    day = datetime.date.today().isoformat()
+    sha = (stamps / f"s1_{day}.done").read_text().strip()
+    assert len(sha) == 40                  # the stamp carries HEAD
+    assert _lib_call(repo, stamps, "step_done s1").returncode == 0
+    # unrelated commit: stamp stays good
+    (repo / "README").write_text("r2\n")
+    git("commit", "-qam", "unrelated")
+    assert _lib_call(repo, stamps, "step_done s1").returncode == 0
+    # commit touching the inputs: stale, loud, re-runs
+    (repo / "bench.py").write_text("# v2\n")
+    git("commit", "-qam", "touch bench")
+    r = _lib_call(repo, stamps, "step_done s1")
+    assert r.returncode != 0
+    assert "predates commits touching" in r.stderr
+
+
+def test_legacy_empty_stamp_stays_wall_clock_only(tmp_path,
+                                                  stamp_git_repo):
+    """A pre-git-aware (sha-less) stamp from earlier today must keep
+    skipping — upgrading the lib mid-day must not re-run a morning's
+    green steps."""
+    repo, git = stamp_git_repo
+    stamps = tmp_path / "stamps"
+    stamps.mkdir()
+    day = datetime.date.today().isoformat()
+    (stamps / f"legacy_{day}.done").write_text("")
+    (repo / "bench.py").write_text("# v2\n")
+    git("commit", "-qam", "touch bench")
+    assert _lib_call(repo, stamps, "step_done legacy").returncode == 0
+
+
+def test_force_still_overrides_fresh_git_stamp(tmp_path,
+                                               stamp_git_repo):
+    repo, _git = stamp_git_repo
+    stamps = tmp_path / "stamps"
+    stamps.mkdir()
+    assert _lib_call(repo, stamps, "stamp s1").returncode == 0
+    r = _lib_call(repo, stamps,
+                  "TPK_REVALIDATE_FORCE=1 step_done s1")
+    assert r.returncode != 0               # the explicit override

@@ -1,0 +1,765 @@
+"""CPU chaos suite for the checkpointed revalidation supervisor.
+
+docs/RESILIENCE.md §supervisor: the queue logic that used to live in
+~300 lines of bash (and was testable only against a live chip) now
+runs in tpukernels/resilience/supervisor.py behind tools/revalidate.py
+and is proven here without a second of chip time:
+
+- crash-safe resume: SIGKILL the supervisor mid-step (fault-plan
+  injected) and a re-run converges to the same green queue without
+  redoing green steps;
+- step quarantine: a step that wedges twice in one day is demoted to
+  non-gating and the third healthy window goes to the NEXT step;
+- flap-aware admission: chip steps whose cost exceeds the estimated
+  healthy window are deferred (rc 2, retryable) — unless nothing at
+  all fits, where the best-density step is forced;
+- deterministic backoff schedule, thin-wrapper exit-code
+  compatibility (0 green / 3 lock-held), shell<->python stamp
+  equivalence, and a byte-identical clean-path stdout proof in the
+  PR 1 / PR 3 style.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpukernels.resilience import supervisor  # noqa: E402
+
+CLI = os.path.join(REPO, "tools", "revalidate.py")
+LIB = os.path.join(REPO, "tools", "revalidate_lib.sh")
+
+
+def _specs(*dicts):
+    return [supervisor.StepSpec.from_dict(d) for d in dicts]
+
+
+def _queue_env(tmp_path, plan=None, **extra):
+    env = dict(os.environ)
+    for var in ("TPK_FAULT_PLAN", "TPK_REVALIDATE_FORCE",
+                "TPK_SUPERVISOR_WINDOW_MIN", "TPK_TRACE"):
+        env.pop(var, None)
+    env.update(
+        TPK_SUPERVISOR_CHECKPOINT=str(tmp_path / "checkpoint.jsonl"),
+        TPK_REVALIDATE_STAMP_DIR=str(tmp_path / "stamps"),
+        TPK_HEALTH_JOURNAL=str(tmp_path / "health.jsonl"),
+    )
+    if plan is not None:
+        env["TPK_FAULT_PLAN"] = json.dumps(plan)
+    for k, v in extra.items():
+        env[k] = str(v)
+    return env
+
+
+def _run_cli(env, queue_file, args=(), timeout=120):
+    return subprocess.run(
+        [sys.executable, CLI, "--queue", str(queue_file), *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO,
+    )
+
+
+def _events(path, kind=None):
+    if not os.path.exists(path):
+        return []
+    recs = [json.loads(line)
+            for line in open(path) if line.strip()]
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    return recs
+
+
+@pytest.fixture
+def stub_queue(tmp_path):
+    """A 3-step stub queue whose steps append to a runlog — execution
+    (vs skip) is observable from the log, like the old stamp tests."""
+    runlog = tmp_path / "runlog"
+    runlog.write_text("")
+
+    def make(steps):
+        qf = tmp_path / "queue.json"
+        qf.write_text(json.dumps(steps))
+        return qf
+
+    def ran():
+        return runlog.read_text().split()
+
+    default = make([
+        {"name": "a", "shell": f"echo a >> {runlog}", "cost_min": 1,
+         "value": 10, "needs_chip": False},
+        {"name": "b", "shell": f"echo b >> {runlog}", "cost_min": 1,
+         "value": 5, "needs_chip": False},
+        {"name": "c", "shell": f"echo c >> {runlog}", "cost_min": 1,
+         "value": 1, "needs_chip": False},
+    ])
+    return default, make, ran, runlog
+
+
+# ---------------------------------------------------------------- #
+# chaos proof 1: kill -9 mid-step, resume without redoing greens    #
+# ---------------------------------------------------------------- #
+
+def test_sigkill_mid_step_resume_skips_green_steps(tmp_path,
+                                                   stub_queue):
+    """The acceptance-criteria chaos proof: SIGKILL the supervisor at
+    the worst instant (step_start durably checkpointed, no outcome
+    yet), re-run, and the checkpoint resumes — green steps are NOT
+    re-executed, the interrupted step is."""
+    qf, _make, ran, _log = stub_queue
+    env = _queue_env(tmp_path, plan={"kill_supervisor": {"step": "b"}})
+    proc = _run_cli(env, qf)
+    assert proc.returncode == -signal.SIGKILL.value or \
+        proc.returncode == 128 + signal.SIGKILL.value
+    assert ran() == ["a"]                 # died before b executed
+    cp = tmp_path / "checkpoint.jsonl"
+    starts = _events(cp, "step_start")
+    dones = _events(cp, "step_done")
+    assert [s["step"] for s in starts] == ["a", "b"]
+    assert [d["step"] for d in dones] == ["a"]    # b has NO outcome
+
+    env2 = _queue_env(tmp_path)           # plan dropped: clean re-run
+    proc2 = _run_cli(env2, qf)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "queue GREEN" in proc2.stdout
+    assert ran() == ["a", "b", "c"]       # a NOT redone; b, c ran
+    resumes = _events(cp, "supervisor_resume")
+    assert resumes and resumes[-1]["interrupted"] == ["b"]
+    assert resumes[-1]["green"] == ["a"]
+    # convergence: a third run executes nothing at all
+    assert _run_cli(_queue_env(tmp_path), qf).returncode == 0
+    assert ran() == ["a", "b", "c"]
+
+
+# ---------------------------------------------------------------- #
+# chaos proof 2: quarantine after repeated wedges                   #
+# ---------------------------------------------------------------- #
+
+def test_wedged_twice_is_quarantined_third_window_moves_on(
+        tmp_path, stub_queue):
+    """A step that wedges twice in a day (watchdog kill + dead
+    re-probe, fault-plan driven) is demoted to non-gating with a loud
+    step_quarantined event; the third healthy window goes to the next
+    step instead of re-eating the flap window."""
+    _qf, make, ran, runlog = stub_queue
+    qf = make([
+        {"name": "w", "shell": "sleep 60", "timeout_s": 1,
+         "cost_min": 1, "value": 10, "quarantine_after": 2},
+        {"name": "x", "shell": f"echo x >> {runlog}", "cost_min": 1,
+         "value": 5, "needs_chip": False},
+    ])
+    plan = {"probe": ["dead"]}            # post-kill re-probe: tunnel gone
+    for attempt in (1, 2):
+        proc = _run_cli(
+            _queue_env(tmp_path, plan=plan,
+                       TPK_SUPERVISOR_WINDOW_MIN=30), qf)
+        assert proc.returncode == supervisor.RC_WEDGE
+        assert ran() == []                # x deferred: window is gone
+    cp = tmp_path / "checkpoint.jsonl"
+    q = _events(cp, "step_quarantined")
+    assert [e["step"] for e in q] == ["w"] and q[0]["wedges"] == 2
+    # third window: w skipped loudly, x runs, queue goes green
+    proc3 = _run_cli(
+        _queue_env(tmp_path, TPK_SUPERVISOR_WINDOW_MIN=30), qf)
+    assert proc3.returncode == 0, proc3.stdout + proc3.stderr
+    assert ran() == ["x"]
+    assert "skipped (quarantined)" in proc3.stdout
+    assert "QUARANTINED" in proc3.stderr
+    wedge_dones = [e for e in _events(cp, "step_done")
+                   if e["outcome"] == "wedged"]
+    assert len(wedge_dones) == 2          # quarantine stopped attempt 3
+
+
+def test_stamp_never_reruns_every_attempt(tmp_path, stub_queue):
+    """The bench contract survives the rewrite: a stamp="never" step
+    (sgemm canary + union gate) re-runs on every queue attempt, even
+    after a same-day green, while its daily-stamped sibling skips."""
+    _qf, make, ran, runlog = stub_queue
+    qf = make([
+        {"name": "canary", "shell": f"echo canary >> {runlog}",
+         "stamp": "never", "cost_min": 1, "value": 10,
+         "needs_chip": False},
+        {"name": "daily", "shell": f"echo daily >> {runlog}",
+         "cost_min": 1, "value": 5, "needs_chip": False},
+    ])
+    assert _run_cli(_queue_env(tmp_path), qf).returncode == 0
+    assert _run_cli(_queue_env(tmp_path), qf).returncode == 0
+    assert ran() == ["canary", "daily", "canary"]
+
+
+def test_gating_failure_propagates_rc_nongating_continues(
+        tmp_path, stub_queue):
+    _qf, make, ran, runlog = stub_queue
+    qf = make([
+        {"name": "soft", "shell": "exit 9", "gating": False,
+         "cost_min": 1, "value": 10, "needs_chip": False},
+        {"name": "hard", "shell": "exit 7", "cost_min": 1,
+         "value": 5, "needs_chip": False},
+        {"name": "after", "shell": f"echo z >> {runlog}",
+         "cost_min": 1, "value": 1, "needs_chip": False},
+    ])
+    proc = _run_cli(_queue_env(tmp_path), qf)
+    assert proc.returncode == 7           # the gating step's own rc
+    assert "FAILED" in proc.stderr
+    assert ran() == []                    # "after" never reached
+    dones = {e["step"]: e for e in
+             _events(tmp_path / "checkpoint.jsonl", "step_done")}
+    assert dones["soft"]["outcome"] == "failed"   # recorded, not fatal
+    assert dones["hard"]["outcome"] == "failed"
+
+
+# ---------------------------------------------------------------- #
+# flap-aware admission                                              #
+# ---------------------------------------------------------------- #
+
+def test_window_deferral_and_density_preference(tmp_path, stub_queue):
+    """window=5: the big high-density step is deferred (doesn't fit),
+    the small one runs, queue reports incomplete (rc 2, retryable)."""
+    _qf, make, ran, runlog = stub_queue
+    qf = make([
+        {"name": "big", "shell": f"echo big >> {runlog}",
+         "cost_min": 20, "value": 100},       # density 5, doesn't fit
+        {"name": "small", "shell": f"echo small >> {runlog}",
+         "cost_min": 3, "value": 10},         # density 3.3, fits
+    ])
+    proc = _run_cli(
+        _queue_env(tmp_path, plan={"probe": ["ok"]},
+                   TPK_SUPERVISOR_WINDOW_MIN=5), qf)
+    assert proc.returncode == supervisor.RC_INCOMPLETE
+    assert ran() == ["small"]
+    skips = _events(tmp_path / "checkpoint.jsonl", "step_skipped")
+    assert [(e["step"], e["reason"]) for e in skips] == [
+        ("big", "deferred-window")]
+
+
+def test_dependent_of_deferred_step_defers_with_it(tmp_path,
+                                                   stub_queue):
+    """An `after` edge means "ran first": when c_gate-style work is
+    deferred past the window, its c_scan_timing-style dependent must
+    NOT run (and stamp green) in the same window."""
+    _qf, make, ran, runlog = stub_queue
+    qf = make([
+        {"name": "small", "shell": f"echo small >> {runlog}",
+         "cost_min": 3, "value": 1},
+        {"name": "gate", "shell": f"echo gate >> {runlog}",
+         "cost_min": 18, "value": 60},
+        {"name": "timing", "shell": f"echo timing >> {runlog}",
+         "cost_min": 1, "value": 25, "after": ["gate"]},
+    ])
+    proc = _run_cli(
+        _queue_env(tmp_path, TPK_SUPERVISOR_WINDOW_MIN=12), qf)
+    assert proc.returncode == supervisor.RC_INCOMPLETE
+    assert ran() == ["small"]             # neither gate NOR timing
+    skips = {e["step"]: e["reason"] for e in
+             _events(tmp_path / "checkpoint.jsonl", "step_skipped")}
+    assert skips == {"gate": "deferred-window",
+                     "timing": "dependency-deferred"}
+
+
+def test_step_children_inherit_the_watcher_lock_fd(tmp_path,
+                                                   stub_queue):
+    """The old queue's orphan-exclusion invariant survives the
+    rewrite: when the supervisor runs under the wrapper's flock on
+    fd 9, STEP children inherit the fd (a step orphaned by a dying
+    watcher keeps holding the machine-wide chip lock) — but a plain
+    supervisor run passes nothing through."""
+    _qf, make, ran, runlog = stub_queue
+    qf = make([
+        {"name": "probe_fd", "shell":
+         f"readlink /proc/$$/fd/9 >> {runlog} 2>/dev/null"
+         f" || echo none >> {runlog}",
+         "cost_min": 1, "value": 1, "needs_chip": False},
+    ])
+    home = tmp_path / "home"
+    home.mkdir()
+    env = _queue_env(tmp_path, HOME=str(home))
+    # under the wrapper: fd 9 is flocked on $HOME/.tpk_tpu_wait.lock
+    lock = home / ".tpk_tpu_wait.lock"
+    wrapped = subprocess.run(
+        ["bash", "-c",
+         f'exec 9>"{lock}"; flock -n 9 || exit 99; '
+         f'exec {sys.executable} "{CLI}" --queue "{qf}"'],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=REPO)
+    assert wrapped.returncode == 0, wrapped.stdout + wrapped.stderr
+    assert ran() == [str(lock)]
+    # without the wrapper: nothing rides along (fresh state dirs —
+    # the first run's same-day green would otherwise skip the step)
+    runlog.write_text("")
+    fresh = tmp_path / "plain"
+    fresh.mkdir()
+    env2 = _queue_env(fresh, HOME=str(home))
+    plain = subprocess.run(
+        [sys.executable, CLI, "--queue", str(qf)],
+        env=env2, capture_output=True, text=True, timeout=120,
+        cwd=REPO)
+    assert plain.returncode == 0, plain.stdout + plain.stderr
+    assert ran() == ["none"]
+
+
+def test_nothing_fits_forces_best_density_step(tmp_path, stub_queue):
+    """A window estimate smaller than every step must not livelock
+    the queue: the best value-per-chip-minute step is force-admitted
+    and the step_start records forced=true."""
+    _qf, make, ran, runlog = stub_queue
+    qf = make([
+        {"name": "only", "shell": f"echo only >> {runlog}",
+         "cost_min": 20, "value": 10}])
+    proc = _run_cli(
+        _queue_env(tmp_path, TPK_SUPERVISOR_WINDOW_MIN=2), qf)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert ran() == ["only"]
+    starts = _events(tmp_path / "checkpoint.jsonl", "step_start")
+    assert starts[0]["forced"] is True
+
+
+def test_estimate_window_from_health_events():
+    """alive-probe -> wedge pairs become observed windows; the median
+    is the estimate; no pairs -> the documented default."""
+    t0 = time.time()
+    mk = lambda kind, dt, **kw: dict(kind=kind, t=t0 + dt, **kw)
+    events = [
+        mk("probe", 0, outcome="alive"),
+        mk("wedge_classification", 4 * 60, verdict="wedged"),
+        mk("probe", 10 * 60, outcome="alive"),
+        mk("step_done", 22 * 60, outcome="wedged"),   # 12-min window
+        mk("probe", 30 * 60, outcome="alive"),
+        mk("wedge_classification", 50 * 60, verdict="wedged"),
+    ]
+    est = supervisor.estimate_window_minutes(events, now=t0 + 51 * 60)
+    assert est["basis"] == "observed" and est["windows"] == 3
+    assert est["minutes"] == pytest.approx(12.0)      # median of 4/12/20
+    empty = supervisor.estimate_window_minutes([], now=t0)
+    assert empty == {"minutes": 25.0, "basis": "default", "windows": 0}
+    # events older than 24h never count
+    stale = supervisor.estimate_window_minutes(
+        events, now=t0 + 25 * 3600)
+    assert stale["basis"] == "default"
+
+
+def test_window_history_spans_the_daily_journal_rotation(
+        tmp_path, stub_queue, monkeypatch):
+    """A run just after midnight must still see yesterday evening's
+    flap evidence: when the journal is the dated per-day file, the
+    estimator also reads yesterday's sibling."""
+    import datetime as dt
+
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    today = dt.date.today().isoformat()
+    yday = (dt.date.today() - dt.timedelta(days=1)).isoformat()
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL",
+                       str(logs / f"health_{today}.jsonl"))
+    monkeypatch.setenv("TPK_SUPERVISOR_CHECKPOINT",
+                       str(tmp_path / "cp.jsonl"))
+    sup = supervisor.Supervisor([], checkpoint=supervisor.Checkpoint(
+        str(tmp_path / "cp.jsonl")))
+    paths = sup._history_paths()
+    assert [os.path.basename(p) for p in paths] == [
+        f"health_{yday}.jsonl", f"health_{today}.jsonl"]
+    # an explicitly-named journal (tests, operators) stays single-file
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL",
+                       str(logs / "custom.jsonl"))
+    assert [os.path.basename(p) for p in sup._history_paths()] == [
+        "custom.jsonl"]
+
+
+def test_dependency_edges_hold_under_density(tmp_path, stub_queue):
+    """`after` edges beat density: bench-style high-value steps wait
+    for their prewarm-style dependency even when it has lower value
+    per chip-minute."""
+    _qf, make, ran, runlog = stub_queue
+    qf = make([
+        {"name": "pre", "shell": f"echo pre >> {runlog}",
+         "cost_min": 10, "value": 1},         # density 0.1
+        {"name": "main", "shell": f"echo main >> {runlog}",
+         "cost_min": 1, "value": 100, "after": ["pre"]},
+    ])
+    proc = _run_cli(
+        _queue_env(tmp_path, TPK_SUPERVISOR_WINDOW_MIN=30), qf)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert ran() == ["pre", "main"]
+
+
+# ---------------------------------------------------------------- #
+# backoff schedule                                                  #
+# ---------------------------------------------------------------- #
+
+def test_probe_backoff_deterministic_capped_with_jitter():
+    seq = [supervisor.probe_delay_s(n, base_s=30, cap_s=600)
+           for n in range(12)]
+    # deterministic: the schedule replays identically (a resumed
+    # watcher reproduces it)
+    assert seq == [supervisor.probe_delay_s(n, base_s=30, cap_s=600)
+                   for n in range(12)]
+    # exponential-ish rise, never above the cap, jitter <= 25%
+    for n, d in enumerate(seq):
+        raw = min(600, 30 * 2 ** n)
+        assert 0.75 * raw <= d <= raw
+    assert seq[0] < 31 and max(seq) <= 600
+    # attempts decorrelate (jitter actually varies)
+    assert len({round(d / min(600, 30 * 2 ** n), 6)
+                for n, d in enumerate(seq)}) > 1
+
+
+# ---------------------------------------------------------------- #
+# watch loop                                                        #
+# ---------------------------------------------------------------- #
+
+def test_watch_green_first_probe(tmp_path, stub_queue, monkeypatch):
+    qf, _make, ran, _log = stub_queue
+    env = _queue_env(tmp_path, plan={"probe": ["ok"]})
+    proc = _run_cli(env, qf, args=("--wait", "--max-hours", "0.01"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tunnel ALIVE" in proc.stdout
+    assert ran() == ["a", "b", "c"]
+
+
+def test_watch_surfaces_deterministic_failure(tmp_path, stub_queue):
+    """Queue fails loudly while the tunnel still answers: the watch
+    must exit with that rc instead of re-running the expensive queue
+    against a reproducible failure for hours."""
+    _qf, make, _ran, _log = stub_queue
+    qf = make([{"name": "boom", "shell": "exit 7", "cost_min": 1,
+                "value": 1, "needs_chip": False}])
+    env = _queue_env(tmp_path, plan={"probe": ["ok"]})
+    proc = _run_cli(env, qf, args=("--wait", "--max-hours", "0.01"))
+    assert proc.returncode == 7
+    assert "deterministic failure" in proc.stderr
+
+
+def test_watch_rides_out_dead_tunnel_until_deadline(tmp_path,
+                                                    stub_queue):
+    qf, _make, ran, _log = stub_queue
+    env = _queue_env(tmp_path, plan={"probe": ["dead"]},
+                     TPK_SUPERVISOR_PROBE_BASE_S="0.02",
+                     TPK_SUPERVISOR_PROBE_CAP_S="0.05")
+    proc = _run_cli(env, qf,
+                    args=("--wait", "--max-hours", "0.0001"))
+    assert proc.returncode == 1           # deadline, like the old loop
+    assert "gave up" in proc.stdout
+    assert ran() == []
+    sched = _events(tmp_path / "health.jsonl", "probe_scheduled")
+    assert sched and all(e["delay_s"] <= 0.05 for e in sched)
+    assert sched[0]["reason"] == "tunnel-dead"
+
+
+# ---------------------------------------------------------------- #
+# stamps: shell <-> python equivalence, git-awareness               #
+# ---------------------------------------------------------------- #
+
+@pytest.fixture
+def git_repo(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    (repo / "bench.py").write_text("# v1\n")
+    (repo / "other.txt").write_text("x\n")
+
+    def git(*args):
+        return subprocess.run(
+            ["git", "-C", str(repo), *args], capture_output=True,
+            text=True, timeout=30, check=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t",
+                 "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    return repo, git
+
+
+def test_stamp_shell_python_equivalence(tmp_path, git_repo,
+                                        monkeypatch):
+    """A stamp written by the bash lib is honored by the python
+    supervisor and vice versa; a commit touching the step's inputs
+    invalidates it for BOTH drivers."""
+    repo, git = git_repo
+    stamps = tmp_path / "stamps"
+    stamps.mkdir()
+    monkeypatch.setenv("TPK_REVALIDATE_STAMP_DIR", str(stamps))
+    monkeypatch.delenv("TPK_REVALIDATE_FORCE", raising=False)
+    spec = supervisor.StepSpec("s1", "true", inputs=("bench.py",))
+
+    def shell_step_done(extra=""):
+        r = subprocess.run(
+            ["bash", "-c",
+             f'stamp_dir="{stamps}"; step_inputs="bench.py"; '
+             f'source "{LIB}"; {extra} step_done s1'],
+            capture_output=True, text=True, timeout=30, cwd=str(repo),
+        )
+        return r.returncode == 0
+
+    # bash writes -> both honor
+    subprocess.run(
+        ["bash", "-c",
+         f'stamp_dir="{stamps}"; source "{LIB}"; stamp s1'],
+        check=True, timeout=30, cwd=str(repo))
+    assert shell_step_done()
+    assert supervisor.stamp_fresh(spec, repo=str(repo))
+    # a commit NOT touching the inputs leaves the stamp fresh
+    (repo / "other.txt").write_text("y\n")
+    git("commit", "-qam", "unrelated")
+    assert shell_step_done()
+    assert supervisor.stamp_fresh(spec, repo=str(repo))
+    # a commit touching bench.py goes stale for BOTH
+    (repo / "bench.py").write_text("# v2\n")
+    git("commit", "-qam", "touch bench")
+    assert not shell_step_done()
+    assert not supervisor.stamp_fresh(spec, repo=str(repo))
+    # python writes -> bash honors (and FORCE still overrides)
+    supervisor.write_stamp("s1", repo=str(repo))
+    assert shell_step_done()
+    assert supervisor.stamp_fresh(spec, repo=str(repo))
+    assert not shell_step_done("TPK_REVALIDATE_FORCE=1;")
+    monkeypatch.setenv("TPK_REVALIDATE_FORCE", "1")
+    assert not supervisor.stamp_fresh(spec, repo=str(repo))
+
+
+# ---------------------------------------------------------------- #
+# thin wrappers + lock diagnosis                                    #
+# ---------------------------------------------------------------- #
+
+def test_thin_wrappers_parse_and_delegate():
+    for script in ("tools/tpu_revalidate.sh",
+                   "tools/tpu_wait_and_revalidate.sh",
+                   "tools/revalidate_lib.sh"):
+        r = subprocess.run(["bash", "-n", os.path.join(REPO, script)],
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, (script, r.stderr)
+    for script, arg in (("tpu_revalidate.sh", "revalidate.py"),
+                        ("tpu_wait_and_revalidate.sh",
+                         "revalidate.py --wait")):
+        with open(os.path.join(REPO, "tools", script)) as f:
+            body = f.read()
+        assert f"exec python tools/{arg}" in body
+        assert "step_done()" not in body  # queue logic lives in python
+
+
+def test_wrapper_green_exit_code(tmp_path, stub_queue):
+    qf, _make, ran, _log = stub_queue
+    env = _queue_env(tmp_path, TPK_SUPERVISOR_QUEUE=str(qf))
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "tpu_revalidate.sh")],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert ran() == ["a", "b", "c"]
+
+
+def test_wrapper_lock_held_exits_3(tmp_path, stub_queue):
+    """The watcher wrapper's exit-3 lock contract survives the
+    rewrite, and now points at --whos-holding instead of raw pgrep."""
+    qf, _make, _ran, _log = stub_queue
+    home = tmp_path / "home"
+    home.mkdir()
+    lock = home / ".tpk_tpu_wait.lock"
+    holder = subprocess.Popen(
+        ["bash", "-c",
+         f'exec 9>>"{lock}"; flock 9; echo 12345 > "{lock}"; '
+         'sleep 60'])
+    try:
+        time.sleep(0.3)                   # let the holder take it
+        env = _queue_env(tmp_path, TPK_SUPERVISOR_QUEUE=str(qf),
+                         HOME=str(home), TPK_LOCK_WAIT_S="1")
+        proc = subprocess.run(
+            ["bash", os.path.join(REPO, "tools",
+                                  "tpu_wait_and_revalidate.sh")],
+            env=env, capture_output=True, text=True, timeout=120,
+            cwd=REPO)
+        assert proc.returncode == 3, proc.stdout + proc.stderr
+        assert "whos-holding" in proc.stdout
+        # the LOSING contender must not have truncated the live
+        # holder's recorded pid (the 9>> open) — --whos-holding
+        # depends on it in exactly this contention case
+        assert lock.read_text().strip() == "12345"
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+def test_whos_holding_diagnosis(tmp_path):
+    lock = tmp_path / ".tpk_tpu_wait.lock"
+    # no lock file at all
+    assert supervisor is not None
+    import tools.revalidate as cli
+    assert cli.whos_holding(str(lock)) == 0
+    # stale: pid recorded but nobody holds the flock
+    lock.write_text("99999999\n")
+    assert cli.whos_holding(str(lock)) == 0
+    # held by a live "watcher" (argv carries the watcher marker)
+    holder = subprocess.Popen(
+        ["bash", "-c",
+         f'exec 9>"{lock}"; echo $$ > "{lock}"; flock 9; '
+         'exec sleep 60'])
+    try:
+        time.sleep(0.3)
+        assert cli.whos_holding(str(lock)) == 3
+    finally:
+        holder.kill()
+        holder.wait()
+    assert cli.classify_holder(
+        "python tools/revalidate.py --wait --max-hours 10"
+    ) == "live-watcher"
+    assert cli.classify_holder(
+        "python bench.py --one sgemm_gflops") == "orphaned-queue"
+    assert cli.classify_holder("sleep 60") == "unknown"
+
+
+# ---------------------------------------------------------------- #
+# clean-path proof + queue definitions                              #
+# ---------------------------------------------------------------- #
+
+def test_clean_path_stdout_byte_identical(tmp_path, stub_queue):
+    """Journaling/checkpointing must not change what the operator
+    sees: the same stub queue run with the health journal disabled
+    and enabled produces byte-identical stdout (the PR 1 / PR 3
+    clean-path proof, supervisor edition)."""
+    _qf, make, _ran, _log = stub_queue
+    outs = []
+    for i, journal_val in enumerate(("0", str(tmp_path / "h.jsonl"))):
+        sub = tmp_path / f"run{i}"
+        sub.mkdir()
+        runlog = sub / "runlog"
+        runlog.write_text("")
+        qf = sub / "queue.json"
+        qf.write_text(json.dumps([
+            {"name": "a", "shell": f"echo out-a", "cost_min": 1,
+             "value": 10, "needs_chip": False},
+            {"name": "b", "shell": f"echo out-b", "cost_min": 1,
+             "value": 5, "needs_chip": False},
+        ]))
+        env = _queue_env(sub, TPK_SUPERVISOR_WINDOW_MIN=10)
+        env["TPK_HEALTH_JOURNAL"] = journal_val
+        proc = _run_cli(env, qf)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        outs.append(proc.stdout)
+    assert outs[0] == outs[1]
+
+
+def test_reports_render_supervisor_session(tmp_path, stub_queue):
+    """tools/health_report.py and tools/obs_report.py must render the
+    new kinds: the per-step attempt/quarantine table and the step
+    wall-time breakdown (from the nested step/<name> spans)."""
+    _qf, make, _ran, runlog = stub_queue
+    qf = make([
+        {"name": "w", "shell": "sleep 60", "timeout_s": 1,
+         "cost_min": 1, "value": 10, "quarantine_after": 1},
+        {"name": "x", "shell": f"echo x >> {runlog}", "cost_min": 1,
+         "value": 5, "needs_chip": False},
+    ])
+    env = _queue_env(tmp_path, plan={"probe": ["dead"]},
+                     TPK_SUPERVISOR_WINDOW_MIN=30, TPK_TRACE="1")
+    assert _run_cli(env, qf).returncode == supervisor.RC_WEDGE
+    env2 = _queue_env(tmp_path, TPK_SUPERVISOR_WINDOW_MIN=30)
+    assert _run_cli(env2, qf).returncode == 0
+    journal = str(tmp_path / "health.jsonl")
+    hr = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "health_report.py"), journal],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert hr.returncode == 0, hr.stderr
+    assert "supervisor steps (attempts / outcomes / quarantine):" \
+        in hr.stdout
+    assert "QUARANTINED" in hr.stdout
+    assert "timeout on w classified WEDGED" in hr.stdout
+    assert "healthy-window estimate" in hr.stdout
+    obs = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--journal", journal],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert "supervisor step breakdown" in obs.stdout
+    # the traced run's step/w span survived the queue/run nesting
+    step_lines = [ln for ln in obs.stdout.splitlines()
+                  if ln.startswith("w ")]
+    assert step_lines and "QUARANTINED" in step_lines[0]
+    assert "-" not in step_lines[0].split()[3]   # span_s populated
+
+
+def test_queue_file_validation(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"not": "a list"}))
+    with pytest.raises(ValueError, match="JSON list"):
+        supervisor.load_queue_file(str(bad))
+    bad.write_text(json.dumps([
+        {"name": "a", "shell": "true", "after": ["ghost"]}]))
+    with pytest.raises(ValueError, match="unknown"):
+        supervisor.load_queue_file(str(bad))
+    bad.write_text(json.dumps([{"name": "a", "shell": "true"},
+                               {"name": "a", "shell": "true"}]))
+    with pytest.raises(ValueError, match="duplicate"):
+        supervisor.load_queue_file(str(bad))
+    # a cycle must be a loud config error here, not a run-time rc 2
+    # the watch loop would retry until its deadline
+    bad.write_text(json.dumps([
+        {"name": "a", "shell": "true", "after": ["b"]},
+        {"name": "b", "shell": "true", "after": ["a"]},
+        {"name": "c", "shell": "true"}]))
+    with pytest.raises(ValueError, match="cycle"):
+        supervisor.load_queue_file(str(bad))
+    with pytest.raises(ValueError, match="stamp"):
+        supervisor.StepSpec("x", "true", stamp="hourly")
+
+
+def test_production_queue_is_wellformed():
+    """Every production step body must at least parse (the queue is
+    unattended — a syntax error would surface mid-recovery), names
+    are unique, dependencies known, and the NEXT.md value ordering is
+    encoded: bench has the highest density, sanitizers the lowest."""
+    import tools.revalidate as cli
+
+    q = cli.PRODUCTION_QUEUE
+    names = [s.name for s in q]
+    assert len(set(names)) == len(names)
+    known = set(names)
+    for s in q:
+        assert all(a in known for a in s.after), s.name
+        r = subprocess.run(["bash", "-n", "-c", s.shell],
+                           capture_output=True, text=True, timeout=30)
+        assert r.returncode == 0, (s.name, r.stderr)
+    dens = {s.name: s.density for s in q}
+    assert dens["bench"] == max(dens.values())
+    assert dens["san_ubsan"] == min(dens.values())
+    assert {"prewarm3d"} == set(
+        next(s for s in q if s.name == "bench").after)
+    # CPU-only steps must say so (they must never wait on a window)
+    for name in ("obs_check", "autotune_smoke", "san_asan",
+                 "san_ubsan"):
+        assert not next(s for s in q if s.name == name).needs_chip
+
+
+def test_production_plan_order_reproduces_next_md(tmp_path,
+                                                  monkeypatch):
+    """Fresh day, no flap history (optimistic default window): the
+    density-under-dependencies schedule must reproduce the NEXT.md
+    highest-value-per-chip-minute ordering the bash queue encoded as
+    comment order — headline capture first, sanitizers last."""
+    import tools.revalidate as cli
+
+    monkeypatch.setenv("TPK_SUPERVISOR_CHECKPOINT",
+                       str(tmp_path / "cp.jsonl"))
+    monkeypatch.setenv("TPK_REVALIDATE_STAMP_DIR",
+                       str(tmp_path / "stamps"))
+    sup = supervisor.Supervisor(cli.PRODUCTION_QUEUE,
+                                announce=False)
+    order = []
+    while True:
+        spec, forced = sup.plan(25.0, may_force=False)
+        if spec is None:
+            break
+        assert not forced
+        order.append(spec.name)
+        sup._settled.add(spec.name)       # pretend it went green
+        sup._attempted.add(spec.name)
+    assert order[:6] == ["prewarm3d", "bench", "obs_check", "c_gate",
+                         "c_scan_timing", "profile"]
+    assert order[-2:] == ["san_asan", "san_ubsan"]
+    assert len(order) == len(cli.PRODUCTION_QUEUE)

@@ -70,7 +70,7 @@ def _fmt(ev):
                 f"{ev.get('timeout_s')}s")
     if kind == "wedge_classification":
         return (f"{ts} [pid {pid}] timeout on "
-                f"{ev.get('metric', '?')} classified "
+                f"{ev.get('metric') or ev.get('step') or '?'} classified "
                 f"{str(ev.get('verdict', '?')).upper()}"
                 + (" - skipping remaining metrics"
                    if ev.get("verdict") == "wedged" else
@@ -121,6 +121,39 @@ def _fmt(ev):
                 f"({ev.get('site')}): {len(snap)} counter(s), "
                 f"{len(ev.get('gauges') or {})} gauge(s), "
                 f"{len(ev.get('histograms') or {})} histogram(s)")
+    if kind == "supervisor_resume":
+        return (f"{ts} [pid {pid}] supervisor RESUMED from checkpoint"
+                f" (green={','.join(ev.get('green') or []) or '-'}"
+                f" interrupted="
+                f"{','.join(ev.get('interrupted') or []) or '-'})")
+    if kind == "window_estimate":
+        return (f"{ts} [pid {pid}] healthy-window estimate "
+                f"{ev.get('minutes')} min ({ev.get('basis')}, "
+                f"{ev.get('windows')} observed)")
+    if kind == "step_start":
+        return (f"{ts} [pid {pid}] step {ev.get('step')} started "
+                f"(attempt {ev.get('attempt')}"
+                + ("" if ev.get("gating") else ", non-gating")
+                + (", FORCED past window" if ev.get("forced") else "")
+                + ")")
+    if kind == "step_done":
+        out = str(ev.get("outcome", "?")).upper()
+        return (f"{ts} [pid {pid}] step {ev.get('step')} {out}"
+                + (f" rc={ev.get('rc')}"
+                   if ev.get("rc") not in (0, None) else "")
+                + (f" ({ev.get('wedges_today')} wedge(s) today)"
+                   if ev.get("outcome") == "wedged" else ""))
+    if kind == "step_skipped":
+        return (f"{ts} [pid {pid}] step {ev.get('step')} skipped "
+                f"({ev.get('reason')})")
+    if kind == "step_quarantined":
+        return (f"{ts} [pid {pid}] step {ev.get('step')} QUARANTINED "
+                f"after {ev.get('wedges')} wedge(s) (threshold "
+                f"{ev.get('threshold')}) - demoted to non-gating")
+    if kind == "probe_scheduled":
+        return (f"{ts} [pid {pid}] next probe in "
+                f"{ev.get('delay_s')}s (attempt {ev.get('attempt')}, "
+                f"{ev.get('reason')})")
     if kind == "tuning_resolved":
         return (f"{ts} [pid {pid}] tuning resolved for "
                 f"{ev.get('kernel')}: {ev.get('params')} "
@@ -172,6 +205,52 @@ def _span_breakdown(events):
     return out
 
 
+def _step_table(events):
+    """Per-step attempt/outcome/quarantine table from the supervisor's
+    step events (docs/RESILIENCE.md §supervisor) — the at-a-glance
+    answer to "which steps keep eating the flap windows"."""
+    steps: dict = {}
+    for ev in events:
+        name = ev.get("step")
+        kind = ev.get("kind")
+        if not name or kind not in ("step_start", "step_done",
+                                    "step_skipped",
+                                    "step_quarantined"):
+            continue
+        s = steps.setdefault(name, {
+            "attempts": 0, "green": 0, "failed": 0, "wedged": 0,
+            "slow": 0, "skipped": 0, "quarantined": False,
+            "wall_s": 0.0,
+        })
+        if kind == "step_start":
+            s["attempts"] += 1
+        elif kind == "step_done":
+            outcome = ev.get("outcome")
+            if outcome in s:
+                s[outcome] += 1
+            s["wall_s"] += ev.get("wall_s") or 0.0
+        elif kind == "step_skipped":
+            s["skipped"] += 1
+        elif kind == "step_quarantined":
+            s["quarantined"] = True
+    if not steps:
+        return []
+    out = ["supervisor steps (attempts / outcomes / quarantine):"]
+    for name in sorted(steps):
+        s = steps[name]
+        flags = []
+        for key in ("green", "failed", "wedged", "slow", "skipped"):
+            if s[key]:
+                flags.append(f"{key}={s[key]}")
+        out.append(
+            f"  {name:<22} attempts={s['attempts']:<3} "
+            f"wall={s['wall_s']:.1f}s "
+            + " ".join(flags)
+            + (" QUARANTINED" if s["quarantined"] else "")
+        )
+    return out
+
+
 def summarize(events, bad=0) -> str:
     out = []
     events = sorted(events, key=lambda e: e.get("t", 0.0))
@@ -188,6 +267,10 @@ def summarize(events, bad=0) -> str:
         if line:
             out.append(line)
     out.append("-" * 60)
+    steps = _step_table(events)
+    if steps:
+        out.extend(steps)
+        out.append("-" * 60)
     breakdown = _span_breakdown(events)
     if breakdown:
         out.extend(breakdown)
@@ -208,7 +291,8 @@ def summarize(events, bad=0) -> str:
     out.append(
         f"verdict: {wedges} wedge(s), {fires} watchdog fire(s), "
         f"{counts.get('partial_result', 0)} partial-result decision(s), "
-        f"{counts.get('fault_injected', 0)} injected fault(s)"
+        f"{counts.get('fault_injected', 0)} injected fault(s), "
+        f"{counts.get('step_quarantined', 0)} quarantined step(s)"
     )
     return "\n".join(out)
 

@@ -13,7 +13,11 @@ Three sections (docs/OBSERVABILITY.md):
    events in the health journal (default: the newest
    ``docs/logs/health_*.jsonl``; spans exist only for runs traced
    with ``TPK_TRACE=1``).
-3. **Metric snapshots** — the last ``metrics`` event per process:
+3. **Supervisor step breakdown** — per-step wall time from the
+   ``step/<name>`` spans plus attempts/outcomes/quarantine state from
+   the supervisor's ``step_*`` events (docs/RESILIENCE.md
+   §supervisor).
+4. **Metric snapshots** — the last ``metrics`` event per process:
    counters (probe retries, watchdog kills, tuning-cache traffic),
    gauges, latency histograms.
 
@@ -81,6 +85,53 @@ def span_section(events, out):
         out.append(
             f"{name:<34} {a['count']:>5} {a['total_s']:>10.3f} "
             f"{a['total_s'] / a['count']:>9.3f} {a['max_s']:>9.3f}"
+        )
+
+
+def step_section(events, out):
+    """Per-step wall-time breakdown for supervisor runs: the
+    `step/<name>` spans (TPK_TRACE=1 runs) give wall time; the
+    step_done / step_quarantined events fill in attempts and
+    quarantine state even for untraced runs."""
+    # spans nest under their parents ("queue/run/step/bench"), so key
+    # on the path segment after the last "step/"
+    agg = {name.split("step/")[-1]: a
+           for name, a in trace.aggregate_spans(events).items()
+           if "step/" in name}
+    dones: dict = {}
+    quarantined = set()
+    for e in events:
+        if e.get("kind") == "step_done":
+            d = dones.setdefault(e.get("step"), {
+                "n": 0, "wall_s": 0.0, "outcomes": {}})
+            d["n"] += 1
+            d["wall_s"] += e.get("wall_s") or 0.0
+            oc = e.get("outcome", "?")
+            d["outcomes"][oc] = d["outcomes"].get(oc, 0) + 1
+        elif e.get("kind") == "step_quarantined":
+            quarantined.add(e.get("step"))
+    if not agg and not dones:
+        return
+    out.append("")
+    out.append(f"== supervisor step breakdown ({len(dones)} step(s), "
+               f"{len(agg)} traced) ==")
+    hdr = (f"{'step':<22} {'runs':>4} {'wall_s':>9} {'span_s':>9} "
+           "outcomes")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for name in sorted(set(agg) | set(dones),
+                       key=lambda n: -dones.get(n, {}).get("wall_s",
+                                                           0.0)):
+        d = dones.get(name, {"n": 0, "wall_s": 0.0, "outcomes": {}})
+        span_s = agg.get(name, {}).get("total_s")
+        oc = ",".join(f"{k}={v}"
+                      for k, v in sorted(d["outcomes"].items()))
+        out.append(
+            f"{name:<22} {d['n']:>4} {d['wall_s']:>9.3f} "
+            + (f"{span_s:>9.3f}" if span_s is not None else
+               f"{'-':>9}")
+            + f" {oc}"
+            + (" QUARANTINED" if name in quarantined else "")
         )
 
 
@@ -171,6 +222,7 @@ def main(argv=None):
     events, _bad = _journal.load_events(journal_paths)
     trend_section(verdicts, out)
     span_section(events, out)
+    step_section(events, out)
     metrics_section(events, out)
     out.append("")
     if bad:

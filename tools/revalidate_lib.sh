@@ -1,29 +1,62 @@
-# Per-day step-stamp library for the revalidation queue — SOURCED by
-# tools/tpu_revalidate.sh and by tests/test_revalidate_stamps.py, so
-# the stamp/resume logic the tests prove is the logic the queue runs.
+# Per-day, GIT-AWARE step-stamp library for the revalidation queue —
+# SOURCED by tests/test_revalidate_stamps.py and by any shell caller
+# that needs the stamp contract. The python supervisor
+# (tpukernels/resilience/supervisor.py stamp_fresh/write_stamp) reads
+# and writes the SAME stamp files with the SAME semantics, so a queue
+# half-run by either driver resumes under the other — the
+# cross-equivalence is test-enforced (tests/test_supervisor.py).
 #
 # Contract (caller must set $stamp_dir and create it):
-#   step_done NAME   -> success iff NAME completed today; always fails
-#                       under TPK_REVALIDATE_FORCE=1 so a same-day
-#                       code change can force a full re-run
-#   stamp NAME       -> mark NAME complete for today (stamps are
-#                       wall-clock-scoped per day, not git-aware — the
-#                       same accepted tradeoff as the bench evidence
-#                       window)
+#   step_done NAME   -> success iff NAME completed today AND no commit
+#                       since the stamp touched the step's inputs
+#                       ($step_inputs, default "bench.py tools
+#                       tpukernels c"); always fails under
+#                       TPK_REVALIDATE_FORCE=1 (kept as the explicit
+#                       manual override, no longer the only defense
+#                       against the same-day-code-change footgun)
+#   stamp NAME       -> mark NAME complete for today; the stamp file
+#                       records the HEAD sha so a later commit can
+#                       invalidate it. Outside git (or a pre-git-aware
+#                       empty stamp) the stamp degrades to the old
+#                       wall-clock-only behavior.
 #   run_step NAME CMD [ARGS...]
-#                    -> skip when stamped; otherwise run CMD and stamp
-#                       ONLY on success. The caller runs under `set -e`
-#                       (the queue is a gate), so a failing CMD aborts
-#                       the queue BEFORE the stamp line — a failed step
-#                       can never stamp, and the retry re-runs it.
+#                    -> skip when stamped-and-fresh; otherwise run CMD
+#                       and stamp ONLY on success. The caller runs
+#                       under `set -e` (the queue is a gate), so a
+#                       failing CMD aborts the queue BEFORE the stamp
+#                       line — a failed step can never stamp, and the
+#                       retry re-runs it.
 
 step_done() {
   [ "${TPK_REVALIDATE_FORCE:-}" = "1" ] && return 1
-  [ -e "$stamp_dir/$1_$(date +%Y-%m-%d).done" ]
+  local _sd_file="$stamp_dir/$1_$(date +%Y-%m-%d).done"
+  [ -e "$_sd_file" ] || return 1
+  local _sd_sha
+  _sd_sha=$(head -1 "$_sd_file" 2>/dev/null)
+  # legacy (sha-less) stamp, or no git here: wall-clock-only, honored
+  [ -n "$_sd_sha" ] || return 0
+  local _sd_head
+  _sd_head=$(git rev-parse HEAD 2>/dev/null) || return 0
+  [ "$_sd_sha" = "$_sd_head" ] && return 0
+  # commits landed since the stamp: stale iff one touched this step's
+  # inputs. A git error (unknown sha after a history rewrite) means
+  # "can't judge" -> re-run, the safe side.
+  local _sd_touched
+  _sd_touched=$(git log --format=%H "$_sd_sha..$_sd_head" -- \
+      ${step_inputs:-bench.py tools tpukernels c} 2>/dev/null) \
+    || { echo "revalidate: stamp for '$1' unjudgeable (git log failed) - re-running" >&2
+         return 1; }
+  if [ -n "$_sd_touched" ]; then
+    echo "revalidate: stamp for '$1' predates commits touching" \
+         "${step_inputs:-bench.py tools tpukernels c} - re-running" >&2
+    return 1
+  fi
+  return 0
 }
 
 stamp() {
-  touch "$stamp_dir/$1_$(date +%Y-%m-%d).done"
+  git rev-parse HEAD 2>/dev/null > "$stamp_dir/$1_$(date +%Y-%m-%d).done" \
+    || : > "$stamp_dir/$1_$(date +%Y-%m-%d).done"
 }
 
 run_step() {

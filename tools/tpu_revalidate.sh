@@ -1,208 +1,14 @@
 #!/bin/bash
-# Post-recovery TPU validation queue (run from /root/repo).
-# Use after the axon tunnel has been down or wedged: re-measures every
-# headline metric, then re-proves the compiled path end to end.
+# Post-recovery TPU validation queue (run from /root/repo) — THIN
+# WRAPPER. The queue logic (step specs, git-aware per-day stamps,
+# crash-safe checkpoint resume, step quarantine, flap-aware
+# admission) moved to tools/revalidate.py +
+# tpukernels/resilience/supervisor.py; this wrapper survives for
+# operator muscle memory and for callers scripted against it.
 #
-# ORDERING (2026-07-31): highest value per chip-minute FIRST. The
-# tunnel has been observed to flap — answer a probe, serve traffic for
-# ~2 minutes, then wedge (hang, not error) — so a healthy window must
-# produce the round's headline numbers before anything long-running
-# gets a chance to eat it. bench.py is itself wedge-tolerant (one
-# killable subprocess per metric, partial results on wedge).
-#
-# -e: this is a gate — a failed bench, suite, gate row, or sanitizer
-# abort must fail the whole queue, not fall through to the next step.
-set -e -x -o pipefail
+# Exit-code contract (unchanged): 0 green; 2 incomplete-but-nothing-
+# regressed (retryable); 124 wedge/timeout (retryable); other nonzero
+# = a gating step failed loudly.
+set -o pipefail
 cd "$(dirname "$0")/.."
-
-# Per-day step stamps: the watcher retries the whole queue on every
-# healthy probe, and with 2-25 minute flap windows an attempt that
-# redoes already-green steps may never REACH the later ones. A step
-# that completed today is skipped on retry (set -e means a failed
-# step never stamps). Same accepted tradeoff as the bench evidence
-# window: stamps are wall-clock-scoped, not git-aware — force a full
-# re-run after a same-day code change with TPK_REVALIDATE_FORCE=1.
-# The bench step is never stamped: its own skip-captured logic keeps
-# it cheap, and the sgemm canary + union gate must run every attempt.
-# step_done/stamp/run_step live in the sourced lib so the CPU test
-# suite (tests/test_revalidate_stamps.py) proves the exact
-# stamp/resume logic this queue runs — not a copy of it.
-stamp_dir="docs/logs/.revalidate_stamps"
-mkdir -p "$stamp_dir"
-source tools/revalidate_lib.sh
-
-# 0. Pre-warm stencil3d's two R-variant compiles into the persistent
-#    cache in a throwaway killable subprocess (VERDICT r4: the tunnel
-#    wedged mid-stencil3d in two consecutive windows, and whether the
-#    trigger is the compile or the execute phase was never pinned).
-#    Non-gating and attempted ONCE per day: the attempt stamp lands
-#    BEFORE the run, so a wedge here cannot re-eat every subsequent
-#    flap window — the next attempt goes straight to bench, which
-#    orders stencil3d last anyway. Either way the stderr breadcrumb
-#    log (slope phases + jacobi3d slab geometry) is the postmortem
-#    evidence: the last line before a wedge names the phase.
-if ! step_done prewarm3d_attempt; then
-  stamp prewarm3d_attempt
-  prewarm_log="docs/logs/prewarm3d_$(date +%Y-%m-%d_%H%M%S).log"
-  if timeout -k 10 900 python bench.py --prewarm stencil3d_mcells_s \
-      >"$prewarm_log" 2>&1; then
-    echo "prewarm stencil3d: OK (compiles cached)"
-  else
-    echo "WARN: stencil3d prewarm failed rc=$? (non-gating) -" \
-         "$prewarm_log is the postmortem evidence"
-  fi
-fi
-
-# 1. Headline metrics (median-of-slopes; see bench.py docstring),
-#    then gate on the self-regression compare: any metric >15% below
-#    the BASELINE.json "measured" medians fails the queue loudly.
-#    The JSON line is also persisted to docs/logs/ so an unattended
-#    recovery (watcher-fired queue) leaves a committable artifact even
-#    if the session that started it is gone.
-#    Artifact name carries the full timestamp: a same-day re-run (the
-#    watcher can fire the queue more than once across tunnel flaps)
-#    must not clobber an earlier good run's numbers with a worse or
-#    partial line.
-#    TPK_BENCH_SKIP_CAPTURED=1 (set by the watcher's retry loop)
-#    spends a short flap window only on metrics with no persisted
-#    evidence yet; the gate then judges the union of the last 24h of
-#    artifacts instead of this run alone.
-union_flag=""
-if [ "${TPK_BENCH_SKIP_CAPTURED:-}" = "1" ]; then
-  # same "= 1" test bench.py uses — any other value (e.g. an intended
-  # "0") must neither skip metrics nor weaken the gate to union mode
-  union_flag="--union-persisted"
-fi
-bench_out=$(timeout 5400 python bench.py)
-printf '%s\n' "$bench_out"
-printf '%s\n' "$bench_out" | tail -1 > "docs/logs/bench_$(date +%Y-%m-%d_%H%M%S).json"
-printf '%s\n' "$bench_out" | tail -1 | python bench.py --check-regression $union_flag
-
-# 1b. Observability trend check (docs/OBSERVABILITY.md): machine-reads
-#     the whole artifact history (BENCH_r*.json + docs/logs/bench_*)
-#     just persisted above and flags >1%-band regressions and
-#     physically-impossible captures. Non-gating: the 15% gate in 1 is
-#     the pass/fail authority; this is the early-drift tripwire, and a
-#     WARN here is a prompt to read `python tools/obs_report.py`
-#     before promoting any baseline.
-if python tools/obs_report.py --check; then
-  echo "obs trend check: OK"
-else
-  echo "WARN: obs_report --check flagged the bench trend (rc=$?," \
-       "non-gating) - run 'python tools/obs_report.py' for the story"
-fi
-
-# 2. C acceptance gate: serial/omp + real TPU rows + fake-device mesh
-c_gate_step() {
-  make -C c -s
-  (cd c && timeout 900 env TPK_TEST_TPU=1 TPK_TEST_MESH=8 ./run_all.sh | tail -3)
-}
-run_step c_gate c_gate_step
-
-# 2b. C-path scan_histogram throughput (docs/NEXT.md item 2): the
-#     combined one-dispatch adapter halved per-rep dispatch cost;
-#     record this Melem/s in docs/PERF.md next to the kernel-level
-#     number.
-c_scan_timing_step() {
-  make -C c -s
-  (cd c && timeout 600 ./bin/scan_histogram --device=tpu --n=4194304 --check)
-}
-run_step c_scan_timing c_scan_timing_step
-
-# 2c. Profiler evidence for the roofline claims (VERDICT r3 item 5):
-#     XProf traces of the two headline kernels, summarized into
-#     docs/logs/profile_{sgemm,stencil}_<date>.log — commit these and
-#     lift the busy %/top-op numbers into docs/PERF.md. Evidence
-#     capture, not a correctness gate: a profiling-only failure (tf
-#     schema drift, empty trace) must not abort a queue whose real
-#     gates all passed, so it is warn-only (and only stamped on
-#     success, so a flap mid-capture retries next window).
-if ! step_done profile; then
-  if bash tools/profile_headline.sh; then
-    stamp profile
-  else
-    echo "WARN: profile capture failed (non-gating)"
-  fi
-fi
-
-# 2d. Knob sanity: histogram impls agree, sgemm precisions hold their
-#     error contracts (exercised by the suite below too; these are
-#     quick re-confirms on the chip while the tunnel is warm)
-knob_sanity_step() {
-  for impl in mxu vpu; do
-    timeout 600 env TPK_HIST_IMPL=$impl python -c "
-from bench import bench_scan_hist
-print('scan_hist $impl:', round(bench_scan_hist(), 1))"
-  done
-  timeout 600 env TPK_SGEMM_PRECISION=float32 python -c "
-from bench import bench_sgemm
-print('sgemm f32 (bf16_6x):', round(bench_sgemm(), 1))"
-}
-run_step knob_sanity knob_sanity_step
-
-# 3. Compiled-path test suite (axon backend, kernels compile on chip).
-# TPK_REQUIRE_TPU=1: a still-wedged tunnel must FAIL here, not slip
-# into conftest's silent CPU fallback. Longest step — deliberately
-# after every metric capture; the 2026-07-31 cold-cache run needed
-# >1800 s of remote compiles (conftest persists the compilation
-# cache, but the FIRST post-recovery run still compiles whatever the
-# bench steps above didn't). Run in stamped GROUPS, kernel files
-# first: pytest has no resume, and one 45-min monolith restarted from
-# scratch every retry may never fit inside a 2-25 min flap window —
-# groups let on-chip validation accrue across windows. Group borders
-# follow compile cost: each kernel file owns its kernel's variants;
-# "rest" is the capi/distributed/bench/host machinery, which mostly
-# spawns scrubbed-CPU subprocesses and reuses the kernels' cache.
-do_pytest_group() {  # pipefail is set, so a failing pytest fails this
-  timeout 1200 env TPK_REQUIRE_TPU=1 python -m pytest "$@" -q | tail -2
-}
-pytest_group() {  # $1 = group name, $2... = pytest file args
-  local grp="$1"; shift
-  run_step "pytest_$grp" do_pytest_group "$@"
-}
-pytest_group vector_add tests/test_vector_add.py
-pytest_group sgemm      tests/test_sgemm.py
-pytest_group stencil    tests/test_stencil.py
-pytest_group scan_hist  tests/test_scan_histogram.py
-pytest_group nbody      tests/test_nbody.py
-pytest_group determinism tests/test_determinism.py tests/test_fuzz_shapes.py
-pytest_group rest tests/ \
-  --ignore=tests/test_vector_add.py --ignore=tests/test_sgemm.py \
-  --ignore=tests/test_stencil.py --ignore=tests/test_scan_histogram.py \
-  --ignore=tests/test_nbody.py --ignore=tests/test_determinism.py \
-  --ignore=tests/test_fuzz_shapes.py
-
-# 3b. Autotune pipeline smoke (docs/TUNING.md): proves the sweep ->
-#     cache -> dispatch path end to end on CPU interpret mode. Needs
-#     no tunnel (the --smoke parent scrubs itself and its bench
-#     children off the axon pool), so it never eats a flap window;
-#     non-gating and once per day, like the profiler capture — a
-#     broken TUNER must not block a queue whose measurement gates all
-#     passed. The smoke cache entry is keyed device_kind=cpu and can
-#     never steer a TPU dispatch.
-if ! step_done autotune_smoke; then
-  autotune_log="docs/logs/autotune_smoke_$(date +%Y-%m-%d_%H%M%S).log"
-  if timeout -k 10 600 python tools/autotune.py --kernel sgemm --smoke \
-      >"$autotune_log" 2>&1; then
-    stamp autotune_smoke
-    echo "autotune smoke: OK (pipeline proven; $autotune_log)"
-  else
-    echo "WARN: autotune smoke failed rc=$? (non-gating) - $autotune_log"
-  fi
-fi
-
-# 4. Sanitizer gates (SURVEY.md §5): ASan then UBSan rebuilds, full
-#    gate incl. the embedded-CPython shim rows on a scrubbed CPU env
-#    (kernels auto-interpret there), then restore the normal build.
-#    CPU-only — needs no tunnel; last on purpose.
-#    First recorded PASS logs: docs/logs/{asan,ubsan}_gate_2026-07-30.log.
-for san in asan ubsan; do
-  if ! step_done "san_$san"; then
-    make -C c "$san"
-    (cd c && timeout 1800 env ASAN_OPTIONS=detect_leaks=0 \
-        PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu TPK_TEST_TPU=1 \
-        TPK_TEST_MESH=8 ./run_all.sh | tail -3)
-    stamp "san_$san"
-    make -C c -s clean && make -C c -s
-  fi
-done
+exec python tools/revalidate.py "$@"

@@ -1,52 +1,52 @@
 #!/bin/bash
 # Poll the axon tunnel and run the revalidation queue the moment it
-# answers (companion to tools/tpu_revalidate.sh; see docs/NEXT.md).
+# answers — THIN WRAPPER (see docs/NEXT.md, docs/RESILIENCE.md).
 #   tools/tpu_wait_and_revalidate.sh [max_hours]   (default 10)
-# Probes every 5 minutes in a killable subprocess (a wedged tunnel
-# HANGS, it never errors). On each healthy probe, runs
-# tpu_revalidate.sh; exits 0 on the first fully-green queue, otherwise
-# resumes probing until the deadline (the tunnel flaps, so a mid-queue
-# wedge must not end the watch). Logs to stdout.
+# The watch loop itself (backoff-scheduled probing, checkpointed
+# queue attempts, post-green harvest) lives in tools/revalidate.py
+# --wait; what stays HERE is the machine-wide $HOME flock machinery,
+# because the lock must be held before any python starts and must die
+# with the process tree.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 # single instance: two watchers (e.g. one left over from a previous
 # session, or one per checkout/worktree) would both fire the
 # revalidation queue on recovery and interleave timed runs on the one
-# chip. The lock dies with the process; the spawned revalidation
-# inherits the fd, which keeps the exclusion through the whole queue.
-# $HOME-scoped fixed path on purpose: machine-wide exclusion across
-# checkouts (a repo-local lock would let two worktrees fire
-# concurrently) without the world-writable-/tmp hazard of any local
-# user pre-holding it to silently disable the watcher. No /tmp
-# fallback for the same reason — an env without HOME (cron, systemd)
-# must fail loudly here, not silently downgrade to a pre-holdable
-# lock. Exit 3 is distinct so a chaining caller can tell "already
-# covered" from "revalidated OK".
+# chip. The lock dies with the process; exec below keeps fd 9 (and
+# our pid) through the python watcher, which keeps the exclusion
+# through the whole watch. $HOME-scoped fixed path on purpose:
+# machine-wide exclusion across checkouts without the world-writable-
+# /tmp hazard of any local user pre-holding it to silently disable
+# the watcher. No /tmp fallback for the same reason — an env without
+# HOME (cron, systemd) must fail loudly here, not silently downgrade
+# to a pre-holdable lock. Exit 3 is distinct so a chaining caller can
+# tell "already covered" from "revalidated OK".
 : "${HOME:?tpu_wait: HOME unset - refusing a world-writable /tmp lock}"
-exec 9>"$HOME/.tpk_tpu_wait.lock"
+# 9>> (append), NOT 9>: a LOSING contender must not truncate the live
+# watcher's recorded pid out of the lock file before its flock fails —
+# that would blind --whos-holding in exactly the contention case it
+# exists for. The winner rewrites the pid below.
+exec 9>>"$HOME/.tpk_tpu_wait.lock"
 if ! flock -n 9; then
   # held — by a live watcher (hours) or by a child orphaned when a
-  # previous watcher died mid-queue/mid-sweep (bounded: the sweep's
-  # worst case is ~21 min). Wait long enough to outlive any orphan
-  # before concluding a live watcher owns it; exit 3 stays distinct
-  # so a chaining caller can tell "already covered" from "ran".
-  echo "tpu_wait: lock held (live watcher or orphaned child); waiting up to 30m"
-  if ! flock -w 1800 9; then
-    # Most likely a LIVE watcher (hours-long hold) — but an orphaned
-    # tpu_revalidate.sh queue child also inherits fd 9 and can hold it
-    # past 30m (the queue's worst case is ~2h of stamped steps on a
-    # healthy chip; the sweep's is ~21m). Print the commands that
-    # distinguish the two so the operator can kill a true orphan
-    # instead of silently losing watch coverage.
-    echo "tpu_wait: lock still held after 30m; exiting 3. Distinguish the holder:"
-    echo "  pgrep -af tpu_wait_and_revalidate    # a LIVE watcher - leave it alone"
-    echo "  pgrep -af 'tpu_revalidate|bench.py|sgemm_tune'  # an ORPHANED queue/sweep -"
-    echo "  if only the second matches, kill those PIDs and re-run this script"
+  # previous watcher died mid-queue/mid-sweep. Wait long enough to
+  # outlive any orphan before concluding a live watcher owns it
+  # (TPK_LOCK_WAIT_S: tests compress the wait; default 30m).
+  echo "tpu_wait: lock held (live watcher or orphaned child); waiting ${TPK_LOCK_WAIT_S:-1800}s"
+  if ! flock -w "${TPK_LOCK_WAIT_S:-1800}" 9; then
+    echo "tpu_wait: lock still held; exiting 3. Diagnose the holder with:"
+    echo "  python tools/revalidate.py --whos-holding"
+    echo "(a LIVE watcher - leave it alone; an ORPHANED queue/sweep -"
+    echo " kill the listed pids and re-run this script)"
     exit 3
   fi
   echo "tpu_wait: lock acquired after wait (previous holder exited)"
 fi
+# record the holder for --whos-holding: exec preserves our pid, so $$
+# IS the python watcher's pid. Write via the path (fd 9's offset is
+# the flock handle, not a log).
+echo "$$" > "$HOME/.tpk_tpu_wait.lock"
 # transition guard: a watcher from a pre-relocation checkout may still
 # hold the LEGACY /tmp lock and would not contend with ours — warn so
 # the operator kills it rather than risking two interleaved
@@ -60,99 +60,11 @@ if [ -e /tmp/tpk_tpu_wait.lock ] && command -v flock >/dev/null; then
   fi
 fi
 
-max_hours="${1:-10}"
-deadline=$(( $(date +%s) + max_hours * 3600 ))
-
-# one probe, two call sites (liveness poll + post-failure classifier)
-# — they must answer the SAME question or the classifier can
-# misjudge a wedge. The backend assert matters: with the tunnel down
-# in a fail-FAST mode jax silently falls back to CPU, and a bare
-# matmul probe would declare the dead tunnel ALIVE. -k: a wedged
-# tunnel read can ignore SIGTERM — escalate to SIGKILL so the
-# watcher itself can't hang on the exact failure it exists to
-# survive. 9>&-: don't hand the lock fd to a killable child.
-probe_tunnel() {
-  timeout -k 10 90 python -c \
-    "import jax; assert jax.default_backend() != 'cpu', jax.default_backend(); import jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready()" \
-    9>&-
-}
-
-while [ "$(date +%s)" -lt "$deadline" ]; do
-  probe_err=$(probe_tunnel 2>&1 >/dev/null)
-  if [ $? -eq 0 ]; then
-    echo "tpu_wait: tunnel ALIVE at $(date -Is); starting revalidation"
-    # no exec: the tunnel FLAPS (2-25 healthy minutes, then a wedge),
-    # so a mid-queue wedge must put us back on probe duty, not kill
-    # the watcher with the queue. Each attempt persists whatever it
-    # captured; TPK_BENCH_SKIP_CAPTURED=1 makes the next attempt spend
-    # its window only on still-missing metrics and judge the union of
-    # the last 24h of artifacts (bench.py --union-persisted). The
-    # flock fd is inherited by the child, so exclusion holds through
-    # the queue.
-    # PROBE_ATTEMPTS=1: we JUST probed healthy — if bench's own probe
-    # fails now the tunnel already re-wedged, and its default ~30 min
-    # of patience would burn the next flap window inside the queue
-    # instead of returning it to this loop.
-    env TPK_BENCH_SKIP_CAPTURED=1 TPK_BENCH_PROBE_ATTEMPTS=1 \
-        bash tools/tpu_revalidate.sh
-    queue_rc=$?  # must be captured from the command itself, not an
-                 # if/fi (whose status is 0 when no branch runs)
-    if [ "$queue_rc" -eq 0 ]; then
-      echo "tpu_wait: revalidation PASSED at $(date -Is)"
-      # queue green — spend whatever window remains on the sgemm tile
-      # sweep (best-effort harvest, never gates: the chip may wedge
-      # mid-sweep and that must not turn a PASSED queue into a
-      # failure). Persisted to docs/logs for the session/driver to
-      # commit.
-      # fd 9 (the machine-wide chip lock) is deliberately INHERITED
-      # here: if this watcher dies mid-sweep, the orphaned sweep is
-      # still running timed configs on the one chip, and a new
-      # watcher must not interleave its queue with it. The orphan's
-      # hold is bounded (~21 min worst case: 3 configs x 420 s), and
-      # the acquisition path above waits out a held lock rather than
-      # exiting immediately, so inheritance cannot dead-lock a
-      # replacement watcher.
-      python tools/sgemm_tune.py --quick 2>&1 \
-        | tee "docs/logs/sgemm_tune_$(date +%Y-%m-%d_%H%M%S).log" \
-        || true
-      exit 0
-    fi
-    # wedge vs deterministic failure: if the tunnel still answers
-    # right after the queue failed, the failure was NOT a wedge (a
-    # real regression, a C-gate bug, a sanitizer abort) — retrying
-    # every 5m would re-run the expensive queue for hours against a
-    # reproducible failure. Surface it instead. Only a dead/wedged
-    # tunnel puts us back on probe duty. Two rcs are ALWAYS
-    # retryable, healthy tunnel or not:
-    #   124 — a `timeout`-killed step: something HUNG, and with
-    #         45-90 min steps the tunnel can wedge and recover before
-    #         the step's timeout fires;
-    #   2   — bench gate "insufficient coverage": a metric has no
-    #         value yet (bench is wedge-tolerant — a mid-bench wedge
-    #         surfaces as a PARTIAL line + gate rc 2, never 124).
-    #         Nothing regressed; the next window can fill the gap.
-    if [ "$queue_rc" -ne 124 ] && [ "$queue_rc" -ne 2 ] \
-        && probe_tunnel >/dev/null 2>&1; then
-      echo "tpu_wait: queue FAILED (rc=$queue_rc) with the tunnel" \
-           "still healthy - deterministic failure, not a wedge;" \
-           "exiting $queue_rc"
-      exit "$queue_rc"
-    fi
-    echo "tpu_wait: revalidation attempt FAILED at $(date -Is)" \
-         "(rc=$queue_rc: wedge or not-yet-complete coverage);" \
-         "back to probing in 5m"
-    # 9>&-: a killed watcher must not leave its sleep holding the
-    # lock fd for up to 5 min — that window blocks a REPLACEMENT
-    # watcher (it sees the lock held and exits 3), leaving no watcher
-    # at all (observed 2026-07-31)
-    sleep 300 9>&-
-    continue
-  fi
-  # keep the probe's own error visible: a broken probe (jax missing,
-  # snippet bug) must be distinguishable from a dead tunnel
-  echo "tpu_wait: tunnel still dead at $(date -Is); retry in 5m"
-  [ -n "$probe_err" ] && printf '%s\n' "$probe_err" | tail -3
-  sleep 300 9>&-  # see the retry-loop sleep: don't orphan the lock
-done
-echo "tpu_wait: gave up after ${max_hours}h"
-exit 1
+# exec on purpose (unlike the old watcher): the probe/retry loop now
+# lives INSIDE revalidate.py --wait, so a mid-queue wedge returns to
+# probe duty within the python process; fd 9 rides through exec and
+# the lock holds for the watcher's whole life. The supervisor passes
+# fd 9 on to its STEP children (and only those — probes close it),
+# preserving the old queue's invariant: a step orphaned by a dying
+# watcher still holds the lock while it runs timed work on the chip.
+exec python tools/revalidate.py --wait --max-hours "${1:-10}"

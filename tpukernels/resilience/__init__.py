@@ -20,10 +20,20 @@ regression-testable on CPU:
 - ``journal``  — structured JSONL health-event log
   (``docs/logs/health_*.jsonl``) replacing grep-the-stderr
   postmortems; ``tools/health_report.py`` turns one into a narrative.
+- ``supervisor`` — the checkpointed revalidation run-queue behind
+  ``tools/revalidate.py``: crash-safe resume from an append-only
+  JSONL checkpoint, per-day step quarantine after repeated wedges,
+  flap-aware admission by value-per-chip-minute, backoff-scheduled
+  probing. The shell queue scripts are thin wrappers over it.
 
 Import-order contract: everything here is stdlib-only (no jax, no
 numpy) so bench.py/capi.py can import it BEFORE jax, and
 ``import tpukernels`` stays jax-free. See docs/RESILIENCE.md.
 """
 
-from tpukernels.resilience import faults, journal, watchdog  # noqa: F401
+from tpukernels.resilience import (  # noqa: F401
+    faults,
+    journal,
+    supervisor,
+    watchdog,
+)

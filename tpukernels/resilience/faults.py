@@ -33,6 +33,9 @@ contract and examples):
 - ``"fail_capi": "sgemm"`` / ``"wedge_capi": "sgemm"`` — the C-shim
   entry ``capi.run_from_c`` raises / hangs when dispatching that
   kernel.
+- ``"kill_supervisor": "stepname"`` (or ``{"step": ...}``) — the
+  revalidation supervisor SIGKILLs ITSELF right after checkpointing
+  that step's ``step_start`` — the crash-safe-resume chaos proof.
 
 Fault state (probe script position, current metric) is per-process;
 plans reach bench's ``--one`` children through env inheritance. Every
@@ -173,6 +176,30 @@ def import_fault(kernels):
     if want and want in kernels:
         journal.emit("fault_injected", site="import", kernels=list(kernels))
         raise ImportError(f"injected fault: fail_import {want}")
+
+
+def supervisor_fault(step: str):
+    """Injection point for the revalidation supervisor
+    (resilience/supervisor.py): a ``"kill_supervisor"`` plan key —
+    ``"stepname"`` or ``{"step": "stepname"}`` (omit the step to match
+    any) — SIGKILLs the SUPERVISOR process itself at the worst instant
+    for resume correctness: after ``step_start`` is durably
+    checkpointed, before any outcome can be recorded. The crash-safe
+    resume proof (tests/test_supervisor.py) re-runs without the plan
+    and must converge without redoing green steps."""
+    if _PLAN is None:
+        return
+    spec = _PLAN.get("kill_supervisor")
+    if spec is None:
+        return
+    want = spec.get("step") if isinstance(spec, dict) else spec
+    if want and want != step:
+        return
+    journal.emit("fault_injected", site="supervisor", step=step,
+                 fault="kill_supervisor")
+    print(f"# fault: SIGKILL supervisor mid-{step}", file=sys.stderr,
+          flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def capi_fault(kernel: str):
