@@ -160,6 +160,26 @@ if "TPK_TUNING_CACHE_DIR" not in os.environ:
     except OSError:
         pass
 
+# Isolate the AOT executable-cache manifest (docs/PERF.md §compile
+# discipline) the same way: every capi/bench test dispatch flows
+# through aot._record, and tiny test-shape keys must not pollute the
+# repo's real .jax_cache/aot.json — the manifest real prewarm/bench
+# runs read as warm-cache evidence. Tests that assert manifest
+# behavior point TPK_AOT_CACHE_DIR at their own tmp path.
+if "TPK_AOT_CACHE_DIR" not in os.environ:
+    import tempfile
+
+    _aot_dir = os.path.join(
+        tempfile.gettempdir(), f"tpk_aot_test_{os.getuid()}"
+    )
+    os.makedirs(_aot_dir, exist_ok=True)
+    os.environ["TPK_AOT_CACHE_DIR"] = _aot_dir
+    try:  # stale manifests from a previous suite run must not read as
+        # warm-cache evidence for this one
+        os.unlink(os.path.join(_aot_dir, "aot.json"))
+    except OSError:
+        pass
+
 # Persist compiled executables across suite runs (the shared knob —
 # tpukernels/_cachedir.py; `import tpukernels` is deliberately
 # jax-free, so this respects the env-before-jax-import rule below).

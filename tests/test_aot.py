@@ -1,0 +1,444 @@
+"""CPU suite for the AOT precompile + persistent executable cache
+(docs/PERF.md §compile discipline).
+
+Covers the tentpole contracts without a TPU: one compile per (kernel,
+shape, dtype, statics) per process across precompile and dispatch
+entry paths, manifest keying/invalidation (a stale kernel-source sha
+rejects exactly that kernel's entries, loudly), the warm-start proof
+(second-process compile-span wall a fraction of the cold wall, with
+aot_hit evidence), `TPK_AOT_CACHE=0` disabling cleanly, byte-identical
+clean-path bench stdout with the layer on and off, the prewarm CLI's
+exit-code contract, the tuning runner's per-candidate hit-ratio tail
+reader, and the supervisor's measured-cost refinement.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from test_distributed import _scrubbed_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _events(path, kind=None):
+    recs = [
+        json.loads(line)
+        for line in open(path).read().splitlines()
+        if line.strip()
+    ]
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    return recs
+
+
+@pytest.fixture
+def aot_env(monkeypatch, tmp_path):
+    """Isolated AOT state: manifest in a tmp dir, journal in a tmp
+    file, per-process memos cleared on both sides of the test."""
+    from tpukernels import aot
+    from tpukernels.obs import metrics
+
+    monkeypatch.setenv("TPK_AOT_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(tmp_path / "j.jsonl"))
+    monkeypatch.delenv("TPK_AOT_CACHE", raising=False)
+    aot.reset()
+    metrics.reset()
+    yield tmp_path
+    aot.reset()
+    metrics.reset()
+
+
+def _aot_compiles():
+    from tpukernels.obs import metrics
+
+    return metrics.snapshot()["counters"].get("aot.compiles", 0)
+
+
+# ---------------------------------------------------------------- #
+# keys + spec coverage                                              #
+# ---------------------------------------------------------------- #
+
+def test_cache_key_format(aot_env):
+    """Keys follow the tuning cache's kernel|shape|dtype|device_kind
+    scheme; statics select a different program, so they ride on the
+    kernel field."""
+    from tpukernels import aot
+
+    x = np.zeros((64, 128), np.float32)
+    key = aot.cache_key("sgemm", (x, x), kind="cpu")
+    assert key == "sgemm|64x128+64x128|float32|cpu"
+    key = aot.cache_key("histogram", (np.zeros(16, np.int32),),
+                        statics={"nbins": 256}, kind="cpu")
+    assert key == "histogram@nbins=256|16|int32|cpu"
+
+
+def test_tuning_promotion_changes_cache_key(aot_env, monkeypatch):
+    """A tuning-cache promotion selects different compiled programs at
+    unchanged shapes, so it must change the AOT key — the manifest
+    must never claim aot_hit for a post-promotion compile."""
+    from tpukernels import aot
+
+    tdir = aot_env / "tuned"
+    tdir.mkdir()
+    monkeypatch.setenv("TPK_TUNING_CACHE_DIR", str(tdir))
+    x = np.zeros(16, np.float32)
+    key_before = aot.cache_key("vector_add", (x,), kind="cpu")
+    (tdir / "tuning.json").write_text('{"entries": {"k": 1}}')
+    key_after = aot.cache_key("vector_add", (x,), kind="cpu")
+    assert key_before != key_after
+    assert "tuned=" in key_after
+    # same content -> same key (stable across processes)
+    aot.reset()
+    assert aot.cache_key("vector_add", (x,), kind="cpu") == key_after
+    # disabled cache contributes nothing
+    monkeypatch.setenv("TPK_TUNING_CACHE", "0")
+    assert aot.cache_key("vector_add", (x,), kind="cpu") == key_before
+
+
+def test_every_registered_config_has_sources():
+    """A kernel config without a sources row would validate against
+    nothing — its manifest entries could never go stale."""
+    from tpukernels import aot
+
+    for name in aot.BENCH_CONFIGS:
+        assert aot.KERNEL_SOURCES.get(name), name
+
+
+def test_registry_precompilable_covers_registry():
+    """Every registry kernel must precompile (a new kernel added
+    without a BENCH_CONFIGS row silently escapes the prewarm)."""
+    from tpukernels import registry
+
+    assert registry.precompilable_kernels() == registry.names()
+
+
+# ---------------------------------------------------------------- #
+# one compile per (kernel, shape, dtype) per process                #
+# ---------------------------------------------------------------- #
+
+def test_precompile_then_dispatch_reuses_executable(aot_env):
+    """The dedupe contract: registry.precompile compiles the bench
+    config ONCE; a later dispatch at the same shapes (the capi path)
+    reuses the compiled executable — no second compile anywhere."""
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    row = registry.precompile("vector_add")
+    assert row["expected"] == "miss"
+    assert _aot_compiles() == 1
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(1 << 20), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(1 << 20), jnp.float32)
+    out = registry.dispatch("vector_add", jnp.float32(2.0), x, y)
+    np.testing.assert_allclose(
+        np.asarray(out), 2.0 * np.asarray(x) + np.asarray(y), rtol=1e-5
+    )
+    assert _aot_compiles() == 1  # the dispatch did NOT recompile
+    # and a repeat precompile is a memo no-op too
+    registry.precompile("vector_add")
+    assert _aot_compiles() == 1
+
+
+def test_dispatch_statics_share_one_compile(aot_env):
+    """Static params (nbins) are part of the program: one compile per
+    distinct static set, reused across repeat dispatches."""
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(0, 256, 1 << 16), jnp.int32)
+    h1 = registry.dispatch("histogram", x, nbins=256)
+    assert _aot_compiles() == 1
+    h2 = registry.dispatch("histogram", x, nbins=256)
+    assert _aot_compiles() == 1
+    np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+    np.testing.assert_array_equal(
+        np.asarray(h1), np.bincount(np.asarray(x), minlength=256)
+    )
+
+
+# ---------------------------------------------------------------- #
+# disable knob                                                      #
+# ---------------------------------------------------------------- #
+
+def test_disabled_cleanly(aot_env, monkeypatch):
+    """TPK_AOT_CACHE=0: dispatch falls through to the plain eager
+    wrapper (same numbers), nothing compiles through the choke point,
+    no manifest appears, and precompile refuses loudly."""
+    import jax.numpy as jnp
+
+    from tpukernels import aot, registry
+
+    monkeypatch.setenv("TPK_AOT_CACHE", "0")
+    assert not aot.enabled()
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal(1 << 12), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(1 << 12), jnp.float32)
+    out = registry.dispatch("vector_add", jnp.float32(0.5), x, y)
+    np.testing.assert_allclose(
+        np.asarray(out), 0.5 * np.asarray(x) + np.asarray(y), rtol=1e-5
+    )
+    assert _aot_compiles() == 0
+    assert not os.path.exists(os.path.join(str(aot_env), "aot.json"))
+    with pytest.raises(RuntimeError, match="TPK_AOT_CACHE"):
+        aot.precompile("vector_add")
+
+
+# ---------------------------------------------------------------- #
+# manifest keying / invalidation                                    #
+# ---------------------------------------------------------------- #
+
+def test_stale_source_sha_invalidates_exactly_that_kernel(aot_env):
+    """Touching one kernel's sources (simulated: its manifest entry
+    carries a sha no commit matches) rejects exactly that kernel's
+    entries — loudly — while the other kernel's entry still reads as
+    a hit."""
+    import jax.numpy as jnp
+
+    from tpukernels import aot, registry
+
+    x = jnp.asarray(np.ones(1 << 10), jnp.float32)
+    s = jnp.asarray(np.ones(1 << 10), jnp.int32)
+    registry.dispatch("vector_add", jnp.float32(1.0), x, x)
+    registry.dispatch("scan", s)
+    manifest = os.path.join(str(aot_env), "aot.json")
+    data = json.load(open(manifest))
+    scan_keys = [k for k in data["entries"] if k.startswith("scan|")]
+    va_keys = [k for k in data["entries"] if k.startswith("vector_add|")]
+    assert scan_keys and va_keys
+    for k in scan_keys:
+        data["entries"][k]["source_sha"] = "0" * 40  # pre-"commit" sha
+    json.dump(data, open(manifest, "w"))
+
+    aot.reset()  # fresh process, same manifest
+    registry.dispatch("vector_add", jnp.float32(1.0), x, x)
+    registry.dispatch("scan", s)
+    jpath = os.path.join(str(aot_env), "j.jsonl")
+    rejected = {e["key"] for e in _events(jpath, "aot_rejected")}
+    hits = {e["key"] for e in _events(jpath, "aot_hit")}
+    assert rejected == set(scan_keys)
+    assert set(va_keys) <= hits
+    assert not (set(scan_keys) & hits)
+
+
+# ---------------------------------------------------------------- #
+# warm start across processes (the acceptance proof)                #
+# ---------------------------------------------------------------- #
+
+def _run_prewarm(tmp_path, tag, kernels):
+    env = _scrubbed_env(fake_devices=None)
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "cache")
+    env["TPK_AOT_CACHE_DIR"] = str(tmp_path / "cache")
+    env["TPK_TUNING_CACHE"] = "0"
+    env["TPK_TRACE"] = "1"
+    journal = tmp_path / f"j_{tag}.jsonl"
+    env["TPK_HEALTH_JOURNAL"] = str(journal)
+    proc = subprocess.run(
+        [sys.executable, "tools/prewarm.py", "--kernels", kernels],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return journal
+
+
+def _compile_span_total(journal):
+    return sum(
+        e["wall_s"] for e in _events(journal, "span")
+        if e["name"].startswith("aot/compile/")
+    )
+
+
+@pytest.mark.slow
+def test_second_process_prewarm_is_warm_full_registry(tmp_path):
+    """The acceptance criterion end to end: prewarm the FULL
+    registered suite cold, then again in a fresh process — every key
+    is an aot_hit and the summed aot/compile span wall lands well
+    under the 20% bar (gated at 50% against CI timer noise; measured
+    ~7% on this container)."""
+    from tpukernels import aot
+
+    kernels = ",".join(sorted(aot.BENCH_CONFIGS))
+    cold = _run_prewarm(tmp_path, "cold", kernels)
+    warm = _run_prewarm(tmp_path, "warm", kernels)
+    n = len(aot.BENCH_CONFIGS)
+    assert len(_events(cold, "aot_miss")) == n
+    assert len(_events(warm, "aot_hit")) == n
+    assert _events(warm, "aot_miss") == []
+    cold_s, warm_s = _compile_span_total(cold), _compile_span_total(warm)
+    assert cold_s > 0
+    assert warm_s < 0.5 * cold_s, (warm_s, cold_s)
+
+
+def test_second_process_compile_is_cache_hit_small(tmp_path):
+    """Fast (not slow-marked) two-kernel version of the warm-start
+    proof, so tier-1 always exercises the cross-process hit path."""
+    cold = _run_prewarm(tmp_path, "cold", "vector_add,scan")
+    warm = _run_prewarm(tmp_path, "warm", "vector_add,scan")
+    assert len(_events(cold, "aot_miss")) == 2
+    assert len(_events(warm, "aot_hit")) == 2
+    assert _events(warm, "aot_miss") == []
+
+
+# ---------------------------------------------------------------- #
+# bench integration: byte-identical stdout, slope evidence          #
+# ---------------------------------------------------------------- #
+
+def test_bench_stdout_byte_identical_aot_on_off(tmp_path):
+    """Clean-path bench stdout must not change with the AOT layer on
+    vs off (same proof style as the fault and trace layers); only the
+    enabled run's journal carries aot evidence, keyed by the bench
+    loop-program naming (bench_saxpy.R<n>)."""
+    outs, journals = [], []
+    for i, knob in enumerate((None, "0")):
+        env = _scrubbed_env(fake_devices=None)
+        env["TPK_BENCH_SMOKE"] = "1"
+        env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "cache")
+        env["TPK_AOT_CACHE_DIR"] = str(tmp_path / "cache")
+        env["TPK_TUNING_CACHE"] = "0"
+        journal = tmp_path / f"health_{i}.jsonl"
+        journals.append(journal)
+        env["TPK_HEALTH_JOURNAL"] = str(journal)
+        env.pop("TPK_AOT_CACHE", None)
+        env.pop("TPK_FAULT_PLAN", None)
+        env.pop("TPK_TRACE", None)
+        if knob is not None:
+            env["TPK_AOT_CACHE"] = knob
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--one", "saxpy_gb_s"],
+            env=env, capture_output=True, text=True, timeout=420,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        outs.append(proc.stdout)
+    assert outs[0] == outs[1]
+    on_keys = [e["key"] for e in _events(journals[0], "aot_miss")]
+    assert sorted(on_keys) == [
+        "bench_saxpy.R1|1048576+1048576|float32|cpu",
+        "bench_saxpy.R2|1048576+1048576|float32|cpu",
+    ]
+    assert _events(journals[1], "aot_miss") == []
+    assert _events(journals[1], "aot_hit") == []
+
+
+# ---------------------------------------------------------------- #
+# prewarm CLI exit-code contract                                    #
+# ---------------------------------------------------------------- #
+
+def test_prewarm_cli_usage_and_disabled(tmp_path):
+    env = _scrubbed_env(fake_devices=None)
+    env["TPK_AOT_CACHE_DIR"] = str(tmp_path)
+    env["TPK_HEALTH_JOURNAL"] = str(tmp_path / "j.jsonl")
+    bad = subprocess.run(
+        [sys.executable, "tools/prewarm.py", "--kernels", "not_a_kernel"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert bad.returncode == 2, bad.stdout + bad.stderr
+    assert "unknown" in bad.stderr
+    env["TPK_AOT_CACHE"] = "0"
+    off = subprocess.run(
+        [sys.executable, "tools/prewarm.py"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert off.returncode == 1, off.stdout + off.stderr
+    assert "TPK_AOT_CACHE=0" in off.stderr
+
+
+# ---------------------------------------------------------------- #
+# tuning runner: per-candidate hit-ratio tail reader                #
+# ---------------------------------------------------------------- #
+
+def test_runner_aot_hit_ratio_tail(tmp_path):
+    """The ratio counts only events appended past the recorded offset
+    — candidate N's evidence, not the whole sweep's."""
+    from tpukernels.tuning import runner
+
+    j = tmp_path / "j.jsonl"
+    j.write_text(json.dumps({"kind": "aot_miss"}) + "\n")
+    offset = runner._journal_size(str(j))
+    with open(j, "a") as f:
+        for kind in ("aot_hit", "aot_hit", "aot_miss", "span"):
+            f.write(json.dumps({"kind": kind}) + "\n")
+    assert runner._aot_hit_ratio(str(j), offset) == pytest.approx(
+        2 / 3, abs=1e-3
+    )
+    assert runner._aot_hit_ratio(str(j), runner._journal_size(str(j))) \
+        is None
+    assert runner._aot_hit_ratio(None, 0) is None
+
+
+# ---------------------------------------------------------------- #
+# supervisor: measured prewarm walls refine the admission cost      #
+# ---------------------------------------------------------------- #
+
+def test_observed_prewarm_cost_min():
+    """Newest wall per kernel inside 24 h, summed, clamped; failures
+    and stale events don't count; no evidence -> None (shipped
+    cost_min stands)."""
+    from tpukernels.resilience import supervisor
+
+    now = 1_000_000.0
+    events = [
+        {"kind": "prewarm_kernel", "kernel": "sgemm", "status": "ok",
+         "wall_s": 300.0, "t": now - 7200},
+        # newer sgemm measurement supersedes the older one
+        {"kind": "prewarm_kernel", "kernel": "sgemm", "status": "ok",
+         "wall_s": 60.0, "t": now - 600},
+        {"kind": "prewarm_kernel", "kernel": "scan", "status": "ok",
+         "wall_s": 120.0, "t": now - 600},
+        {"kind": "prewarm_kernel", "kernel": "nbody", "status": "error",
+         "wall_s": 900.0, "t": now - 600},          # failed: ignored
+        {"kind": "prewarm_kernel", "kernel": "stencil3d", "status": "ok",
+         "wall_s": 900.0, "t": now - 25 * 3600},    # stale: ignored
+    ]
+    est = supervisor.observed_prewarm_cost_min(events, now=now)
+    assert est == pytest.approx((60.0 + 120.0) / 60.0)
+    assert supervisor.observed_prewarm_cost_min([], now=now) is None
+    # tiny warm walls clamp to the floor, never to zero
+    tiny = [{"kind": "prewarm_kernel", "kernel": "scan", "status": "ok",
+             "wall_s": 1.0, "t": now - 60}]
+    assert supervisor.observed_prewarm_cost_min(tiny, now=now) == 0.5
+
+
+def test_supervisor_applies_prewarm_cost(tmp_path, monkeypatch):
+    """A cost_from="prewarm" step's cost_min is re-derived from the
+    journal before admission, and the decision is journaled as
+    step_cost_estimated."""
+    from tpukernels.resilience import supervisor
+
+    journal_path = tmp_path / "health_x.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(journal_path))
+    monkeypatch.setenv("TPK_SUPERVISOR_CHECKPOINT",
+                       str(tmp_path / "cp.jsonl"))
+    monkeypatch.setenv("TPK_REVALIDATE_STAMP_DIR",
+                       str(tmp_path / "stamps"))
+    monkeypatch.setenv("TPK_SUPERVISOR_WINDOW_MIN", "25")
+    import time as _time
+
+    with open(journal_path, "w") as f:
+        f.write(json.dumps({
+            "kind": "prewarm_kernel", "kernel": "sgemm", "status": "ok",
+            "wall_s": 120.0, "t": _time.time() - 60,
+            "ts": "x",
+        }) + "\n")
+    spec = supervisor.StepSpec("prewarm_all", "true", gating=False,
+                               cost_min=12, value=50,
+                               cost_from="prewarm")
+    sup = supervisor.Supervisor([spec], repo=str(tmp_path),
+                                announce=False)
+    rc = sup.run_queue()
+    assert rc == supervisor.RC_GREEN
+    # the refinement is per-run, never a mutation of the shared spec:
+    # a later Supervisor built from the same module-level queue must
+    # still see the shipped cost as its "prior"
+    assert sup._cost_min(spec) == pytest.approx(2.0)
+    assert spec.cost_min == 12
+    ests = _events(journal_path, "step_cost_estimated")
+    assert ests and ests[0]["step"] == "prewarm_all"
+    assert ests[0]["prior_cost_min"] == 12

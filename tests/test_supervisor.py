@@ -728,8 +728,12 @@ def test_production_queue_is_wellformed():
     dens = {s.name: s.density for s in q}
     assert dens["bench"] == max(dens.values())
     assert dens["san_ubsan"] == min(dens.values())
-    assert {"prewarm3d"} == set(
+    assert {"prewarm_all"} == set(
         next(s for s in q if s.name == "bench").after)
+    # the prewarm step re-derives its chip-minute cost from measured
+    # compile walls (docs/PERF.md §compile discipline)
+    assert next(s for s in q if s.name == "prewarm_all").cost_from == \
+        "prewarm"
     # CPU-only steps must say so (they must never wait on a window)
     for name in ("obs_check", "autotune_smoke", "san_asan",
                  "san_ubsan"):
@@ -759,7 +763,7 @@ def test_production_plan_order_reproduces_next_md(tmp_path,
         order.append(spec.name)
         sup._settled.add(spec.name)       # pretend it went green
         sup._attempted.add(spec.name)
-    assert order[:6] == ["prewarm3d", "bench", "obs_check", "c_gate",
+    assert order[:6] == ["prewarm_all", "bench", "obs_check", "c_gate",
                          "c_scan_timing", "profile"]
     assert order[-2:] == ["san_asan", "san_ubsan"]
     assert len(order) == len(cli.PRODUCTION_QUEUE)

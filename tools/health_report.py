@@ -154,6 +154,39 @@ def _fmt(ev):
         return (f"{ts} [pid {pid}] next probe in "
                 f"{ev.get('delay_s')}s (attempt {ev.get('attempt')}, "
                 f"{ev.get('reason')})")
+    if kind == "aot_hit":
+        return (f"{ts} [pid {pid}] aot compile HIT {ev.get('key')} "
+                f"(compile {ev.get('compile_s')}s, prior "
+                f"{ev.get('prior_compile_s')}s)")
+    if kind == "aot_miss":
+        return (f"{ts} [pid {pid}] aot compile MISS {ev.get('key')} "
+                f"(lower {ev.get('lower_s')}s + compile "
+                f"{ev.get('compile_s')}s)")
+    if kind == "aot_rejected":
+        return (f"{ts} [pid {pid}] aot-cache REJECTED "
+                f"{ev.get('key')}: {ev.get('reason')}")
+    if kind == "prewarm_start":
+        return (f"{ts} [pid {pid}] prewarm started: "
+                f"{len(ev.get('kernels') or [])} kernel config(s), "
+                f"{len(ev.get('metrics') or [])} bench metric(s)")
+    if kind == "prewarm_kernel":
+        if ev.get("status") not in (None, "ok"):
+            return (f"{ts} [pid {pid}] prewarm {ev.get('kernel')} "
+                    f"FAILED ({ev.get('status')})"
+                    + (f": {ev.get('error')}" if ev.get("error") else ""))
+        return (f"{ts} [pid {pid}] prewarm {ev.get('kernel')} warmed "
+                f"in {ev.get('wall_s')}s"
+                + (f" (expected {ev.get('expected')})"
+                   if ev.get("expected") else ""))
+    if kind == "prewarm_end":
+        return (f"{ts} [pid {pid}] prewarm done: "
+                f"{ev.get('compiled')} warmed, "
+                f"{len(ev.get('failed') or [])} failed in "
+                f"{ev.get('total_wall_s')}s")
+    if kind == "step_cost_estimated":
+        return (f"{ts} [pid {pid}] step {ev.get('step')} chip-minute "
+                f"cost re-estimated {ev.get('prior_cost_min')} -> "
+                f"{ev.get('cost_min')} min ({ev.get('basis')})")
     if kind == "tuning_resolved":
         return (f"{ts} [pid {pid}] tuning resolved for "
                 f"{ev.get('kernel')}: {ev.get('params')} "
@@ -173,8 +206,11 @@ def _fmt(ev):
     if kind == "tuning_candidate":
         shown = ev.get("value")
         shown = shown if shown is not None else f"FAIL ({ev.get('status')})"
+        ratio = ev.get("aot_hit_ratio")
         return (f"{ts} [pid {pid}] candidate {ev.get('params')} -> "
-                f"{shown}")
+                f"{shown}"
+                + (f" (aot hit {ratio:.0%})" if isinstance(
+                    ratio, (int, float)) else ""))
     if kind == "tuning_promoted":
         return (f"{ts} [pid {pid}] PROMOTED {ev.get('kernel')} "
                 f"{ev.get('params')} (value {ev.get('value')} vs "

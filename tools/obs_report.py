@@ -17,7 +17,10 @@ Three sections (docs/OBSERVABILITY.md):
    ``step/<name>`` spans plus attempts/outcomes/quarantine state from
    the supervisor's ``step_*`` events (docs/RESILIENCE.md
    §supervisor).
-4. **Metric snapshots** — the last ``metrics`` event per process:
+4. **AOT compile cache** — hit/miss traffic, compile walls on each,
+   stale-entry rejections and prewarm outcomes from the ``aot_*`` /
+   ``prewarm_*`` events (docs/PERF.md §compile discipline).
+5. **Metric snapshots** — the last ``metrics`` event per process:
    counters (probe retries, watchdog kills, tuning-cache traffic),
    gauges, latency histograms.
 
@@ -135,6 +138,41 @@ def step_section(events, out):
         )
 
 
+def aot_section(events, out):
+    """Compile-discipline evidence (docs/PERF.md): per-program compile
+    walls from the ``aot_hit``/``aot_miss`` events plus rejection and
+    prewarm traffic — the at-a-glance answer to "did the window spend
+    its minutes compiling or measuring"."""
+    hits = [e for e in events if e.get("kind") == "aot_hit"]
+    misses = [e for e in events if e.get("kind") == "aot_miss"]
+    rejected = [e for e in events if e.get("kind") == "aot_rejected"]
+    prewarm = [e for e in events if e.get("kind") == "prewarm_end"]
+    if not (hits or misses or rejected or prewarm):
+        return
+    out.append("")
+    n = len(hits) + len(misses)
+    ratio = f"{len(hits) / n:.0%}" if n else "-"
+    hit_wall = sum(e.get("compile_s") or 0.0 for e in hits)
+    miss_wall = sum(e.get("compile_s") or 0.0 for e in misses)
+    out.append(f"== aot compile cache ({len(hits)} hit(s), "
+               f"{len(misses)} miss(es), hit ratio {ratio}) ==")
+    out.append(f"compile wall: {hit_wall:.3f}s on hits, "
+               f"{miss_wall:.3f}s on misses"
+               + (f"; {len(rejected)} stale entr(ies) rejected"
+                  if rejected else ""))
+    worst = sorted(misses, key=lambda e: -(e.get("compile_s") or 0.0))
+    for e in worst[:8]:
+        out.append(f"  miss {e.get('key')}: "
+                   f"lower {e.get('lower_s')}s + compile "
+                   f"{e.get('compile_s')}s")
+    for e in rejected:
+        out.append(f"  rejected {e.get('key')}: {e.get('reason')}")
+    for e in prewarm:
+        out.append(f"  prewarm: {e.get('compiled')} warmed, "
+                   f"{len(e.get('failed') or [])} failed in "
+                   f"{e.get('total_wall_s')}s")
+
+
 def metrics_section(events, out):
     snaps = [e for e in events if e.get("kind") == "metrics"]
     out.append("")
@@ -223,6 +261,7 @@ def main(argv=None):
     trend_section(verdicts, out)
     span_section(events, out)
     step_section(events, out)
+    aot_section(events, out)
     metrics_section(events, out)
     out.append("")
     if bad:

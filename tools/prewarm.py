@@ -1,0 +1,176 @@
+"""Suite-wide AOT prewarm CLI (docs/PERF.md §compile discipline).
+
+Usage:
+    python tools/prewarm.py                     # precompile every
+                                                # registered kernel config
+    python tools/prewarm.py --kernels sgemm,scan
+    python tools/prewarm.py --bench all         # also pre-warm the bench
+                                                # loop programs (killable
+                                                # bench.py --prewarm child
+                                                # per metric)
+    python tools/prewarm.py --check             # machine mode (rc only
+                                                # prints failures)
+
+Compiles the whole suite OFF-window so a healthy flap window opens
+with a hot cache: the registry-level pass lowers every kernel's
+benchmark config from ShapeDtypeStruct avatars (nothing allocates,
+nothing executes — safe on any host, and on the TPU box it fills the
+remote-compile cache without holding the chip); ``--bench`` adds the
+two jitted repeat-count loop programs per metric via ``bench.py
+--prewarm`` children under the watchdog's hard kill, exactly the old
+stencil3d-only step 0 generalized to the full registry.
+
+Every kernel lands a ``prewarm_kernel`` journal event whose measured
+walls feed the supervisor's chip-minute cost estimate for the
+``prewarm_all`` step (tools/revalidate.py); the run is bracketed by
+``prewarm_start`` / ``prewarm_end``.
+
+Exit codes mirror ``tools/obs_report.py --check``:
+    0 — everything asked for compiled (warm cache, go measure);
+    1 — at least one kernel/metric failed to compile (or the AOT
+        layer is disabled — a prewarm that compiles nothing must
+        never report success);
+    2 — usage error.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# env-before-jax-import rule: the cache knobs must be set before the
+# registry pulls jax in through the first precompile
+from tpukernels._cachedir import ensure_compilation_cache  # noqa: E402
+
+ensure_compilation_cache()
+
+from tpukernels import aot  # noqa: E402
+from tpukernels.resilience import journal, watchdog  # noqa: E402
+
+
+def _prewarm_bench_metric(metric: str, timeout_s: float):
+    """One ``bench.py --prewarm <metric>`` child under the watchdog's
+    hard kill — the loop-program half of the prewarm. Returns
+    (status, wall_s) with the watchdog's ok|timeout|error vocabulary."""
+    import subprocess
+
+    t0 = time.monotonic()
+    r, status = watchdog.kill_after(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--prewarm", metric],
+        timeout_s,
+        site=f"prewarm --prewarm {metric}",
+        cwd=_REPO,
+        stdout=subprocess.DEVNULL,
+    )
+    if status == "ok" and r.returncode != 0:
+        status = "error"
+    return status, round(time.monotonic() - t0, 3)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    check = "--check" in argv
+    kernels = None
+    bench_metrics: list = []
+    timeout_s = 900.0
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--kernels":
+                kernels = [k.strip() for k in next(it).split(",")
+                           if k.strip()]
+            elif a == "--bench":
+                bench_metrics = [m.strip() for m in next(it).split(",")
+                                 if m.strip()]
+            elif a == "--timeout-s":
+                timeout_s = float(next(it))
+            elif a != "--check":
+                print(__doc__, file=sys.stderr)
+                print(f"prewarm: unknown argument {a!r}", file=sys.stderr)
+                return 2
+    except StopIteration:
+        print(f"prewarm: {a} requires a value", file=sys.stderr)
+        return 2
+    except ValueError:
+        print(f"prewarm: {a} needs a numeric value", file=sys.stderr)
+        return 2
+    if not aot.enabled():
+        # a prewarm that silently compiles nothing would read as a hot
+        # cache to the supervisor — refuse loudly instead
+        print("prewarm: TPK_AOT_CACHE=0 disables the AOT layer - "
+              "nothing to prewarm", file=sys.stderr)
+        return 1
+    # unattended runs land their evidence in the day's journal, same
+    # routing default as bench.py's CLI entry
+    os.environ.setdefault("TPK_HEALTH_JOURNAL", journal.default_path())
+
+    from tpukernels import registry
+
+    known = registry.precompilable_kernels()
+    if kernels is None:
+        kernels = known
+    else:
+        unknown = [k for k in kernels if k not in known]
+        if unknown:
+            print(f"prewarm: unknown/unprecompilable kernels {unknown}; "
+                  f"known: {known}", file=sys.stderr)
+            return 2
+    from bench import BENCH_METRICS  # noqa: E402 — after cache env setup
+
+    metric_names = [n for n, _f in BENCH_METRICS]
+    if bench_metrics == ["all"]:
+        bench_metrics = metric_names
+    else:
+        unknown = [m for m in bench_metrics if m not in metric_names]
+        if unknown:
+            print(f"prewarm: unknown bench metrics {unknown}; known: "
+                  f"{metric_names}", file=sys.stderr)
+            return 2
+
+    journal.emit("prewarm_start", kernels=kernels, metrics=bench_metrics)
+    t0 = time.monotonic()
+    failed = []
+    echo = (lambda line: None) if check else print
+    echo(f"prewarm: {len(kernels)} kernel config(s)"
+         + (f" + {len(bench_metrics)} bench metric(s)"
+            if bench_metrics else ""))
+    for row in aot.prewarm_all(kernels, echo=echo):
+        if "error" in row:
+            failed.append(row["kernel"])
+            print(f"prewarm: {row['kernel']} FAILED: {row['error']}",
+                  file=sys.stderr)
+            journal.emit("prewarm_kernel", kernel=row["kernel"],
+                         status="error", error=row["error"])
+        else:
+            journal.emit("prewarm_kernel", kernel=row["kernel"],
+                         key=row["key"], expected=row["expected"],
+                         wall_s=row["wall_s"], status="ok")
+    for metric in bench_metrics:
+        status, wall = _prewarm_bench_metric(metric, timeout_s)
+        if status != "ok":
+            failed.append(metric)
+            print(f"prewarm: bench metric {metric} FAILED ({status})",
+                  file=sys.stderr)
+        else:
+            echo(f"  {metric:<22} loop programs cached "
+                 f"wall={wall:.1f}s")
+        journal.emit("prewarm_kernel", kernel=metric, mode="bench",
+                     status=status, wall_s=wall)
+    total = round(time.monotonic() - t0, 3)
+    journal.emit("prewarm_end",
+                 compiled=len(kernels) + len(bench_metrics) - len(failed),
+                 failed=sorted(failed), total_wall_s=total)
+    n_ok = len(kernels) + len(bench_metrics) - len(failed)
+    print(f"prewarm{' --check' if check else ''}: {n_ok} warmed, "
+          f"{len(failed)} failed in {total:.1f}s"
+          + (f" (failed: {','.join(sorted(failed))})" if failed else ""))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,22 +55,40 @@ S = supervisor.StepSpec
 # "highest value per chip-minute first" ordering, now enforced in
 # code. Step bodies stay the same shell the old queue ran.
 PRODUCTION_QUEUE = [
-    # 0. stencil3d compile pre-warm: non-gating, attempted ONCE per
-    #    day (stamp="attempt" lands before the run — a wedge here must
-    #    not re-eat every subsequent flap window). Must precede bench.
-    S("prewarm3d", """
+    # 0. suite-wide AOT prewarm (docs/PERF.md §compile discipline):
+    #    the old stencil3d-only hand-prewarm generalized to the full
+    #    registry — tools/prewarm.py precompiles every registered
+    #    kernel config plus both bench loop programs per metric, so
+    #    the healthy window after it spends chip minutes measuring,
+    #    not compiling. Non-gating, stamped daily on SUCCESS with
+    #    git-aware inputs (a kernel/bench commit re-runs it) — but
+    #    max_attempts_per_day=2: a deterministic compile failure
+    #    (rc 1, which quarantine never catches — that needs wedges)
+    #    must not re-eat every flap window the way the old
+    #    attempt-stamp contract guarded against. cost_from="prewarm"
+    #    re-derives the chip-minute cost from the newest measured
+    #    per-kernel compile walls, so once the cache is warm the
+    #    admission planner stops budgeting cold-compile minutes for
+    #    it. Must precede bench.
+    #    Timeout coherence: 7 bench-metric children at --timeout-s 420
+    #    each (the per-child watchdog owns wedge classification) plus
+    #    the avatar pass must fit under the outer kill, or a SIGKILL
+    #    mid-run would swallow prewarm_end and blame the whole step
+    #    for one metric's wedge: 7*420 + slack = 3540 < 3600.
+    S("prewarm_all", """
 set -o pipefail
-prewarm_log="docs/logs/prewarm3d_$(date +%Y-%m-%d_%H%M%S).log"
-if timeout -k 10 900 python bench.py --prewarm stencil3d_mcells_s \\
-    >"$prewarm_log" 2>&1; then
-  echo "prewarm stencil3d: OK (compiles cached)"
+prewarm_log="docs/logs/prewarm_$(date +%Y-%m-%d_%H%M%S).log"
+if timeout -k 10 3540 python tools/prewarm.py --bench all --check \\
+    --timeout-s 420 >"$prewarm_log" 2>&1; then
+  tail -1 "$prewarm_log"
 else
-  echo "WARN: stencil3d prewarm failed rc=$? (non-gating) -" \\
+  echo "WARN: prewarm_all failed rc=$? (non-gating) -" \\
        "$prewarm_log is the postmortem evidence"
   exit 1
 fi
-""", gating=False, stamp="attempt", timeout_s=960, cost_min=12,
-      value=50, inputs=("tpukernels/kernels", "bench.py")),
+""", gating=False, stamp="daily", timeout_s=3600, cost_min=12,
+      value=50, cost_from="prewarm", max_attempts_per_day=2,
+      inputs=("tpukernels", "bench.py", "tools/prewarm.py")),
     # 1. headline metrics + the 15% self-regression gate; the JSON
     #    line is persisted so an unattended recovery leaves a
     #    committable artifact. Never stamped: its own skip-captured
@@ -90,7 +108,7 @@ printf '%s\\n' "$bench_out" | tail -1 \\
 printf '%s\\n' "$bench_out" | tail -1 \\
   | python bench.py --check-regression $union_flag
 """, stamp="never", timeout_s=5460, cost_min=15, value=100,
-      after=("prewarm3d",), inputs=("tpukernels", "bench.py")),
+      after=("prewarm_all",), inputs=("tpukernels", "bench.py")),
     # 1b. trend tripwire, non-gating (the 15% gate above is the
     #     authority); CPU-only, so it never eats a flap window.
     S("obs_check", """

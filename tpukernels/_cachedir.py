@@ -22,6 +22,14 @@ def ensure_compilation_cache(env: dict | None = None) -> str:
     """Point JAX_COMPILATION_CACHE_DIR at the repo ``.jax_cache``
     unless the caller's environment already chose one.
 
+    Also lowers JAX's persist-this-compile thresholds to zero (again
+    setdefault — an explicit env choice wins): the stock 1 s
+    min-compile-time floor exists to keep laptop caches small, but
+    here EVERY skipped recompile is either a 20-40 s remote compile
+    through the flapping tunnel or part of the CPU warm-start proof
+    (docs/PERF.md §compile discipline), so no compile is cheap enough
+    to throw away.
+
     env: a subprocess environment dict to update, or None for
     ``os.environ``. Returns the effective cache dir either way.
     """
@@ -29,6 +37,8 @@ def ensure_compilation_cache(env: dict | None = None) -> str:
     target.setdefault(
         "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
     )
+    target.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    target.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
     return target["JAX_COMPILATION_CACHE_DIR"]
 
 
@@ -49,3 +59,21 @@ def tuning_cache_path(env: dict | None = None) -> str:
             _REPO, ".jax_cache"
         )
     return os.path.join(d, "tuning.json")
+
+
+def aot_manifest_path(env: dict | None = None) -> str:
+    """Path of the AOT executable-cache manifest (docs/PERF.md
+    §compile discipline; ``tpukernels/aot.py``).
+
+    Lives beside the compilation cache it describes — one ``aot.json``
+    per cache dir — unless ``TPK_AOT_CACHE_DIR`` redirects it (tests
+    point it at a tmp dir so they never touch the repo's real warm
+    cache). Same read-the-env-per-call rule as the tuning cache.
+    """
+    target = os.environ if env is None else env
+    d = target.get("TPK_AOT_CACHE_DIR")
+    if not d:
+        d = target.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            _REPO, ".jax_cache"
+        )
+    return os.path.join(d, "aot.json")

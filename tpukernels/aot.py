@@ -1,0 +1,592 @@
+"""AOT precompile + persistent executable cache (docs/PERF.md
+§compile discipline).
+
+On the flapping axon tunnel the scarce healthy windows were partly
+burned on XLA compilation: every new process re-traced and re-compiled
+every kernel from scratch, the old revalidate step 0 hand-prewarmed
+only stencil3d, and one slab-compile experiment "sent compile times
+through the roof and once wedged the remote-compile tunnel for hours"
+(docs/PERF.md). This module makes compilation a cached, ahead-of-time,
+per-kernel-accounted phase so chip minutes go to measuring:
+
+- **One choke point** — :func:`compile_jitted` is the only place the
+  repo lowers-and-compiles a program it intends to reuse. It splits
+  the wall into an ``aot/lower/<name>`` span (tracing + lowering,
+  never cacheable) and an ``aot/compile/<name>`` span (the XLA backend
+  compile — exactly the part JAX's persistent compilation cache under
+  ``.jax_cache/`` elides on a warm start), journals ``aot_hit`` /
+  ``aot_miss`` evidence, and feeds compile-wall metrics.
+- **Per-process executable memo** — :func:`run_cached` /
+  :func:`registry.dispatch` give bench, ``capi.run_from_c`` and the
+  tuning sweep one compiled executable per (kernel, shape, dtype,
+  statics) per process instead of up to three independent jit caches
+  compiling the same program.
+- **Persistent manifest** — ``.jax_cache/aot.json`` records which keys
+  have been compiled, under which jax version and kernel-source
+  commit, with measured lower/compile walls. Keys follow the tuning
+  cache's scheme (``kernel|shape|dtype|device_kind``) and are
+  validated at read time the same way: a stale entry (jax upgraded, a
+  commit touching the kernel's sources) is LOUDLY rejected
+  (``aot_rejected`` stderr note + journal event) and the key is
+  treated as cold — never silently trusted. The manifest is evidence
+  ("a warm executable should exist; expect the compile span to be
+  cheap"), the XLA cache is the store; disagreement between them shows
+  up as an ``aot_hit`` event with a cold-sized ``compile_s``.
+- **Prewarm** — :func:`precompile` / :func:`prewarm_all` compile every
+  registered benchmark config from :data:`BENCH_CONFIGS` avatars
+  (``jax.ShapeDtypeStruct`` — no operands, nothing executes), so
+  ``tools/prewarm.py`` can fill the cache off-window and a healthy
+  window opens hot.
+
+``TPK_AOT_CACHE=0`` (or ``off``/``none``) disables the layer cleanly:
+:func:`registry.dispatch` falls through to the plain eager wrapper,
+bench's ``_slope`` keeps its old warm-call compile, no manifest is
+read or written, and no ``aot_*`` event is emitted — clean-path bench
+stdout is byte-identical either way (tests/test_aot.py proves it the
+same way the fault and trace layers are proven).
+
+Stdlib-only at import time (jax loads lazily inside the compile
+paths), like the tuning and obs layers.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from tpukernels import _cachedir
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.obs import trace
+from tpukernels.resilience import journal
+
+_DISABLED = ("0", "off", "none")
+
+# per-process caches (reset() for tests)
+_EXEC_MEMO: dict = {}     # (name, avals_key, statics_key) -> executable
+_JIT_MEMO: dict = {}      # (id-keyed fn, statics names) -> jitted wrapper
+_MANIFEST_MEMO: dict = {} # path -> (stat_key, parsed)
+_REJECT_NOTED: set = set()
+
+
+def enabled() -> bool:
+    raw = os.environ.get("TPK_AOT_CACHE")
+    return raw is None or raw.strip().lower() not in _DISABLED
+
+
+def manifest_path() -> str:
+    return _cachedir.aot_manifest_path()
+
+
+def reset():
+    """Drop per-process state (tests only — real processes want the
+    memo to live exactly as long as the backend client does)."""
+    global _TUNABLE_ENVS
+    _EXEC_MEMO.clear()
+    _JIT_MEMO.clear()
+    _MANIFEST_MEMO.clear()
+    _REJECT_NOTED.clear()
+    _TUNING_TOKEN_MEMO.clear()
+    _TUNABLE_ENVS = None
+
+
+# ------------------------------------------------------------------ #
+# keys                                                               #
+# ------------------------------------------------------------------ #
+
+def _aval_of(x):
+    """(shape_tuple, dtype_str) for a concrete array, a ShapeDtypeStruct
+    avatar, or a host scalar (canonicalized the way jnp.asarray will)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        # host scalar: the dispatch path canonicalizes these to f32/i32
+        # before tracing, so the key must agree
+        if isinstance(x, bool):
+            return ((), "bool")
+        if isinstance(x, int):
+            return ((), "int32")
+        return ((), "float32")
+    return (tuple(int(d) for d in shape), str(dtype))
+
+
+def _avals_key(args) -> tuple:
+    return tuple(_aval_of(a) for a in args)
+
+
+def _statics_key(statics: dict) -> tuple:
+    return tuple(sorted(statics.items()))
+
+
+def device_kind() -> str:
+    """Canonical backend device kind — same spelling as the tuning
+    cache so the two caches' keys line up in reports."""
+    from tpukernels.tuning import cache as tcache
+
+    return tcache.device_kind()
+
+
+# program-selecting env knobs that are not declared Tunables (the
+# TUNABLES env names are collected from the registry)
+_EXTRA_PROGRAM_ENV = ("TPK_SGEMM_PRECISION",)
+_TUNABLE_ENVS: set | None = None  # memoized name set (values read live)
+
+
+def _tunable_env_names() -> set:
+    global _TUNABLE_ENVS
+    if _TUNABLE_ENVS is not None:
+        return _TUNABLE_ENVS
+    names = set(_EXTRA_PROGRAM_ENV)
+    try:
+        from tpukernels import registry
+
+        for k in registry.tunable_kernels():
+            for t in registry.tunables(k).tunables:
+                names.add(t.env)
+    except Exception:
+        # a failed kernel-import group must not take the AOT layer
+        # down; the un-memoized partial set retries next call
+        return names
+    _TUNABLE_ENVS = names
+    return names
+
+
+_TUNING_TOKEN_MEMO: dict = {}  # path -> (stat_key, token)
+
+
+def _tuning_cache_token() -> str:
+    """Content identity of the tuning cache file, or "" when the cache
+    is disabled/absent. Tuned params resolve inside the kernel at
+    trace time with the same key-invisibility as env knobs (precedence
+    env > tuned-cache > default), so an autotune PROMOTION changes the
+    compiled program under otherwise-unchanged keys — without this
+    token the first post-promotion compile would claim ``aot_hit``
+    while paying a full cold compile. One whole-file digest (not
+    per-kernel): promotions are rare, and over-invalidating toward
+    "miss" is the honest direction."""
+    from tpukernels.tuning import cache as tcache
+
+    if not tcache.enabled():
+        return ""
+    p = tcache.path()
+    try:
+        st = os.stat(p)
+    except OSError:
+        return ""
+    stat_key = (st.st_mtime_ns, st.st_size)
+    memo = _TUNING_TOKEN_MEMO.get(p)
+    if memo and memo[0] == stat_key:
+        return memo[1]
+    import hashlib
+
+    try:
+        with open(p, "rb") as f:
+            digest = hashlib.md5(f.read()).hexdigest()[:10]
+    except OSError:
+        return ""
+    token = f"tuned={digest}"
+    _TUNING_TOKEN_MEMO[p] = (stat_key, token)
+    return token
+
+
+def tunable_env_fingerprint() -> str:
+    """Everything that selects a different compiled program at the
+    SAME shapes without showing up in the operand avals: the set
+    tunable TPK_* knobs (block geometries, impl choices —
+    docs/TUNING.md) plus the tuning-cache content token. An autotune
+    candidate at rows=256 is a different program than rows=512, and
+    calling its compile a "hit" because the default-rows entry exists
+    would overstate the sweep's warmth (and a process-local memo
+    ignoring these would serve stale executables after an env flip or
+    a mid-process promotion)."""
+    parts = sorted(
+        f"{n}={os.environ[n]}"
+        for n in _tunable_env_names()
+        if n in os.environ
+    )
+    token = _tuning_cache_token()
+    if token:
+        parts.append(token)
+    return ",".join(parts)
+
+
+def cache_key(name: str, args, statics=None, kind=None) -> str:
+    """``kernel|shape|dtype|device_kind`` — the tuning cache's key
+    scheme. Multi-operand programs join per-operand shapes/dtypes with
+    ``+``; static params ride on the kernel field (``histogram@nbins=
+    256``) because they select a different program, not a different
+    operand layout."""
+    if kind is None:
+        kind = device_kind()
+    avals = _avals_key(args)
+    shapes = "+".join(
+        "x".join(str(d) for d in s) if s else "-" for s, _dt in avals
+    )
+    dtypes = sorted({dt for _s, dt in avals})
+    field = name
+    if statics:
+        field += "@" + ",".join(
+            f"{k}={v}" for k, v in _statics_key(statics)
+        )
+    env_fp = tunable_env_fingerprint()
+    if env_fp:
+        field += "@" + env_fp
+    return "|".join((field, shapes or "-", "+".join(dtypes) or "-", kind))
+
+
+# ------------------------------------------------------------------ #
+# the persistent manifest                                            #
+# ------------------------------------------------------------------ #
+
+def _load_manifest(p: str) -> dict:
+    """Parsed manifest (memoized on stat); {} when absent/corrupt — an
+    unreadable manifest degrades to cold-cache behavior, never raises
+    (the tuning cache's contract)."""
+    import json
+
+    try:
+        st = os.stat(p)
+        stat_key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return {}
+    memo = _MANIFEST_MEMO.get(p)
+    if memo and memo[0] == stat_key:
+        return memo[1]
+    try:
+        with open(p) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    if not isinstance(data, dict):
+        data = {}
+    _MANIFEST_MEMO[p] = (stat_key, data)
+    return data
+
+
+def _reject(key: str, reason: str, **fields):
+    """Loud-rejection contract shared with the tuning cache: surfaced
+    (counter + stderr + ``aot_rejected`` journal event) once per
+    process per cause. Unlike the tuning cache's per-occurrence
+    counting (a hot dispatch loop is a volume signal there), a stale
+    AOT entry is legitimately validated twice per precompile (the
+    ``expected`` probe + the choke point) — counting occurrences
+    would double every rejection in the metrics snapshot."""
+    memo = (key, reason)
+    if memo in _REJECT_NOTED:
+        return
+    _REJECT_NOTED.add(memo)
+    obs_metrics.inc("aot.rejections")
+    print(f"# aot-cache rejected: {key} ({reason})", file=sys.stderr)
+    journal.emit("aot_rejected", key=key, reason=reason, **fields)
+
+
+def manifest_entry(key: str, sources=()) -> dict | None:
+    """The validated manifest entry for ``key``, or None when absent /
+    stale. Validation mirrors the tuning cache: jax version must match
+    and no commit touching ``sources`` may postdate the entry's
+    ``source_sha`` (outside git the sha check degrades to
+    version-scoped). A stale entry is rejected loudly and treated as
+    cold — the XLA cache may well still hold the old executable, and
+    trusting it would hand a pre-change kernel's compile to a
+    post-change benchmark."""
+    entry = _load_manifest(manifest_path()).get("entries", {}).get(key)
+    if not isinstance(entry, dict):
+        return None
+    import jax
+
+    if entry.get("jax") != jax.__version__:
+        _reject(
+            key,
+            f"compiled under jax {entry.get('jax')}, "
+            f"running {jax.__version__}",
+        )
+        return None
+    if sources:
+        from tpukernels.tuning import cache as tcache
+
+        sha = tcache.source_sha(tuple(sources))
+        if sha is not None and entry.get("source_sha") not in (None, sha):
+            _reject(
+                key,
+                "stale: a commit touching "
+                + ",".join(sources)
+                + " postdates this entry",
+                entry_sha=entry.get("source_sha"),
+                current_sha=sha,
+            )
+            return None
+    return entry
+
+
+def _record(key: str, sources, lower_s: float, compile_s: float):
+    """Atomically upsert one manifest entry (flock-serialized
+    read-modify-write, same discipline as tuning.cache.put)."""
+    import fcntl
+    import json
+
+    from tpukernels.tuning import cache as tcache
+
+    p = manifest_path()
+    d = os.path.dirname(p)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    import jax
+
+    entry = {
+        "jax": jax.__version__,
+        "source_sha": tcache.source_sha(tuple(sources)) if sources else None,
+        "git_head": journal.git_head(),
+        "lower_s": round(lower_s, 6),
+        "compile_s": round(compile_s, 6),
+        "recorded": round(time.time(), 3),
+    }
+    with open(f"{p}.lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        _MANIFEST_MEMO.pop(p, None)
+        data = _load_manifest(p)
+        data.setdefault("entries", {})[key] = entry
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, p)
+    _MANIFEST_MEMO.pop(p, None)
+    return entry
+
+
+# ------------------------------------------------------------------ #
+# the compile choke point                                            #
+# ------------------------------------------------------------------ #
+
+def compile_jitted(name: str, jitted, args, statics=None, sources=()):
+    """Lower and compile one jitted program ahead of time; returns the
+    compiled executable (callable with the traced args; statics are
+    baked in).
+
+    THE choke point: every reusable compile in the repo runs through
+    here so the wall is split into its cacheable and uncacheable
+    halves — ``aot/lower/<name>`` (tracing + lowering; re-paid every
+    process) and ``aot/compile/<name>`` (the XLA backend compile; a
+    warm ``.jax_cache`` turns it into a disk read) — and every compile
+    leaves ``aot_hit``/``aot_miss`` journal evidence with both walls.
+    "hit" means the persistent manifest held a validated entry for the
+    key, i.e. a prior process compiled this exact program under the
+    same jax + kernel sources and the XLA cache should serve it; the
+    recorded ``compile_s`` is the ground truth either way.
+    """
+    statics = statics or {}
+    key = cache_key(name, args, statics)
+    prior = manifest_entry(key, sources) if enabled() else None
+    t0 = time.perf_counter()
+    with trace.span(f"aot/lower/{name}"):
+        lowered = jitted.lower(*args, **statics)
+    t1 = time.perf_counter()
+    with trace.span(f"aot/compile/{name}"):
+        compiled = lowered.compile()
+    t2 = time.perf_counter()
+    lower_s, compile_s = t1 - t0, t2 - t1
+    obs_metrics.inc("aot.compiles")
+    obs_metrics.observe("aot.lower_wall_s", lower_s)
+    obs_metrics.observe("aot.compile_wall_s", compile_s)
+    if enabled():
+        if prior is not None:
+            obs_metrics.inc("aot.hits")
+            journal.emit(
+                "aot_hit", key=key, lower_s=round(lower_s, 6),
+                compile_s=round(compile_s, 6),
+                prior_compile_s=prior.get("compile_s"),
+            )
+        else:
+            obs_metrics.inc("aot.misses")
+            journal.emit(
+                "aot_miss", key=key, lower_s=round(lower_s, 6),
+                compile_s=round(compile_s, 6),
+            )
+        _record(key, sources, lower_s, compile_s)
+    return compiled
+
+
+# ------------------------------------------------------------------ #
+# registry-level executable memo                                     #
+# ------------------------------------------------------------------ #
+
+# Per-kernel sources for manifest staleness — the same files whose
+# commits gate bench evidence (bench._METRIC_KERNEL_SOURCES) and
+# tuning-cache entries (TUNABLES.sources). tests/test_aot.py asserts
+# every BENCH_CONFIGS kernel has a row.
+KERNEL_SOURCES = {
+    "vector_add": ("tpukernels/kernels/vector_add.py",),
+    "sgemm": ("tpukernels/kernels/sgemm.py",),
+    "stencil2d": ("tpukernels/kernels/stencil.py",),
+    "stencil3d": ("tpukernels/kernels/stencil.py",),
+    "scan": ("tpukernels/kernels/scan.py",),
+    "scan_exclusive": ("tpukernels/kernels/scan.py",),
+    "histogram": ("tpukernels/kernels/histogram.py",),
+    "nbody": ("tpukernels/kernels/nbody.py",),
+}
+
+
+def _jitted_wrapper(name: str, fn, statics: dict):
+    """One memoized ``jax.jit`` wrapper per (kernel, static-name-set)
+    per process — bench children, capi dispatches and precompile must
+    share the SAME wrapper object or each would key its own jit cache
+    (the PR-2 lesson from ``bench._normal_generator``)."""
+    import jax
+
+    memo = (name, tuple(sorted(statics)))
+    jitted = _JIT_MEMO.get(memo)
+    if jitted is None:
+        jitted = jax.jit(fn, static_argnames=tuple(sorted(statics)))
+        _JIT_MEMO[memo] = jitted
+    return jitted
+
+
+def _ensure_executable(name: str, fn, args, statics: dict, sources):
+    """The memo-or-compile step shared by dispatch and precompile —
+    ONE construction of the memo key, so a future key component (the
+    env fingerprint was added for exactly this reason) can never be
+    applied to one entry path and not the other. The fingerprint is
+    part of the memo: flipping a tunable knob mid-process
+    (TPK_HIST_IMPL and friends) selects a different program and must
+    never be served the old executable."""
+    memo = (name, _avals_key(args), _statics_key(statics),
+            tunable_env_fingerprint())
+    exe = _EXEC_MEMO.get(memo)
+    if exe is None:
+        jitted = _jitted_wrapper(name, fn, statics)
+        exe = compile_jitted(name, jitted, args, statics, sources)
+        _EXEC_MEMO[memo] = exe
+    return exe
+
+
+def run_cached(name: str, fn, args, statics=None, sources=None):
+    """Run one kernel call through the per-process executable memo:
+    the first call at a given (shape, dtype, statics) compiles through
+    :func:`compile_jitted`; every later call — from any entry path in
+    the same process — reuses the compiled executable with zero
+    re-trace and zero re-compile (tests assert exactly one compile per
+    (kernel, shape, dtype) per process)."""
+    statics = statics or {}
+    if sources is None:
+        sources = KERNEL_SOURCES.get(name, ())
+    return _ensure_executable(name, fn, args, statics, sources)(*args)
+
+
+# ------------------------------------------------------------------ #
+# registered benchmark configs + prewarm                             #
+# ------------------------------------------------------------------ #
+
+# The configs of record (BASELINE.json "configs" / bench.py shapes),
+# as ShapeDtypeStruct avatar specs: ("f32"|"i32", shape) operands plus
+# the static params the C adapters pass. precompile() lowers these —
+# nothing is allocated, nothing executes, so the whole registered
+# suite precompiles on any host (CPU-provable; on a TPU host the same
+# call fills the remote-compile cache off-window).
+BENCH_CONFIGS = {
+    "vector_add": {
+        "args": (("f32", ()), ("f32", (1 << 20,)), ("f32", (1 << 20,))),
+        "statics": {},
+    },
+    "sgemm": {
+        "args": (("f32", ()), ("f32", (1024, 1024)), ("f32", (1024, 1024)),
+                 ("f32", ()), ("f32", (1024, 1024))),
+        "statics": {},
+    },
+    "stencil2d": {
+        "args": (("f32", (4096, 4096)),),
+        "statics": {"iters": 8},
+    },
+    "stencil3d": {
+        "args": (("f32", (384, 384, 384)),),
+        "statics": {"iters": 8},
+    },
+    "scan": {
+        "args": (("i32", (1 << 22,)),),
+        "statics": {},
+    },
+    "scan_exclusive": {
+        "args": (("i32", (1 << 22,)),),
+        "statics": {},
+    },
+    "histogram": {
+        "args": (("i32", (1 << 22,)),),
+        "statics": {"nbins": 256},
+    },
+    "nbody": {
+        # dt/eps/steps mirror the C adapter's defaults so a capi
+        # dispatch at the config of record reuses the precompiled
+        # executable (statics are part of the memo key)
+        "args": (("f32", (65536,)),) * 7,
+        "statics": {"dt": 1e-3, "eps": 1e-2, "steps": 1},
+    },
+}
+
+
+def _avatar_args(spec):
+    import jax
+    import jax.numpy as jnp
+
+    dt = {"f32": jnp.float32, "i32": jnp.int32}
+    return tuple(
+        jax.ShapeDtypeStruct(shape, dt[kind])
+        for kind, shape in spec["args"]
+    )
+
+
+def precompile(name: str) -> dict:
+    """Compile one registered kernel's benchmark config ahead of time
+    into the per-process memo + persistent cache. Returns a summary
+    row ``{kernel, key, expected, lower_s, compile_s}`` (``expected``
+    = hit/miss, what the manifest predicted before compiling). Raises
+    KeyError for kernels without a registered config and RuntimeError
+    when the layer is disabled — a prewarm that silently compiles
+    nothing is worse than a loud refusal."""
+    if not enabled():
+        raise RuntimeError(
+            "aot.precompile: TPK_AOT_CACHE=0 disables the AOT layer; "
+            "unset it to prewarm"
+        )
+    try:
+        spec = BENCH_CONFIGS[name]
+    except KeyError:
+        raise KeyError(
+            f"kernel {name!r} has no registered benchmark config; "
+            f"known: {sorted(BENCH_CONFIGS)}"
+        ) from None
+    from tpukernels import registry
+
+    fn = registry.lookup(name)
+    args = _avatar_args(spec)
+    statics = dict(spec["statics"])
+    sources = KERNEL_SOURCES.get(name, ())
+    key = cache_key(name, args, statics)
+    expected = "hit" if manifest_entry(key, sources) else "miss"
+    t0 = time.perf_counter()
+    _ensure_executable(name, fn, args, statics, sources)
+    wall = time.perf_counter() - t0
+    return {
+        "kernel": name, "key": key, "expected": expected,
+        "wall_s": round(wall, 6),
+    }
+
+
+def prewarm_all(names=None, echo=None):
+    """Precompile every registered benchmark config (or the ``names``
+    subset); returns a list of per-kernel rows — succeeded rows from
+    :func:`precompile` plus ``{"kernel", "error"}`` rows for failures
+    (one kernel's broken compile must not abort the rest of the
+    prewarm; the caller decides whether that's fatal)."""
+    echo = echo or (lambda line: None)
+    rows = []
+    for name in names if names is not None else sorted(BENCH_CONFIGS):
+        try:
+            row = precompile(name)
+        except Exception as e:  # noqa: BLE001 — reported per kernel
+            row = {"kernel": name, "error": repr(e)}
+            echo(f"  {name:<16} FAILED: {e!r}")
+        else:
+            echo(
+                f"  {name:<16} expected={row['expected']:<4} "
+                f"wall={row['wall_s']:.3f}s"
+            )
+        rows.append(row)
+    return rows

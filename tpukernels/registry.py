@@ -33,8 +33,10 @@ from typing import Callable, Dict
 # stdlib-only (no jax), so importing it here keeps `import tpukernels`
 # jax-free; gives _populate its fault-injection point and journals
 # real import failures as health events (docs/RESILIENCE.md).
-# tuning.space and the observability layer (docs/OBSERVABILITY.md)
-# are likewise stdlib-only at import time.
+# tuning.space, the observability layer (docs/OBSERVABILITY.md) and
+# the AOT layer (docs/PERF.md §compile discipline) are likewise
+# stdlib-only at import time.
+from tpukernels import aot as _aot
 from tpukernels.obs import metrics as _obs_metrics
 from tpukernels.obs import trace as _trace
 from tpukernels.resilience import faults, journal
@@ -83,6 +85,43 @@ def tunables(name: str) -> "_tuning_space.SearchSpace":
 def tunable_kernels():
     _populate()
     return sorted(_TUNABLES)
+
+
+def dispatch(name: str, *args, **statics):
+    """Run one kernel call through the process-wide compiled-executable
+    memo (docs/PERF.md §compile discipline).
+
+    Positional ``args`` are traced operands (callers canonicalize host
+    scalars — ``jnp.float32(alpha)`` — so the memo key matches the
+    precompiled avatar exactly); keyword ``statics`` select the
+    program (iters, nbins, steps, dt, eps). The first call at a given
+    (shape, dtype, statics) compiles once through the AOT choke point;
+    every later call from ANY entry path — a C-shim dispatch after a
+    bench child, a tuning candidate after a prewarm — reuses the
+    compiled executable. With ``TPK_AOT_CACHE=0`` this is exactly
+    ``lookup(name)(*args, **statics)``: the plain eager wrapper, no
+    memo, no manifest."""
+    fn = lookup(name)
+    if not _aot.enabled():
+        return fn(*args, **statics)
+    return _aot.run_cached(name, fn, args, statics)
+
+
+def precompile(name: str) -> dict:
+    """Compile ``name``'s registered benchmark config ahead of time
+    (``aot.BENCH_CONFIGS`` avatars — nothing allocates, nothing
+    executes) into the same memo :func:`dispatch` reads. Exposed
+    beside the callables so ``tools/prewarm.py`` and the supervisor's
+    prewarm step are registry-driven, not a hand-kept kernel list."""
+    lookup(name)  # populate + surface import failures as the real cause
+    return _aot.precompile(name)
+
+
+def precompilable_kernels():
+    """Registered kernels with a benchmark config to precompile —
+    the registry-driven prewarm surface."""
+    _populate()
+    return sorted(n for n in _REGISTRY if n in _aot.BENCH_CONFIGS)
 
 
 def resolve_params(name: str, shape=None, dtype=None) -> dict:

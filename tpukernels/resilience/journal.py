@@ -78,11 +78,12 @@ def default_path():
     )
 
 
-def path():
-    """Resolved journal file path, or None when journaling is off.
-    Re-read from the environment on every call: events are rare and
-    tests (and bench children) retarget the journal via env."""
-    raw = os.environ.get("TPK_HEALTH_JOURNAL")
+def resolve(raw):
+    """Resolve one TPK_HEALTH_JOURNAL value to a file path, or None
+    when it means "off". THE resolution rule — a directory value means
+    a dated file inside it — shared with callers that resolve a
+    CHILD's env rather than this process's (the tuning runner tails
+    the file its bench children append to)."""
     if raw is None or raw.strip().lower() in _DISABLED:
         return None
     if os.path.isdir(raw):
@@ -90,6 +91,13 @@ def path():
             raw, f"health_{datetime.date.today().isoformat()}.jsonl"
         )
     return raw
+
+
+def path():
+    """Resolved journal file path, or None when journaling is off.
+    Re-read from the environment on every call: events are rare and
+    tests (and bench children) retarget the journal via env."""
+    return resolve(os.environ.get("TPK_HEALTH_JOURNAL"))
 
 
 def enabled() -> bool:

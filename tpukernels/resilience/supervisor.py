@@ -103,18 +103,27 @@ class StepSpec:
     stamp (git-aware staleness; satellite of the PR-1 footgun).
     ``after`` lists steps that must have been attempted (any outcome)
     earlier in the queue — dependency edges the bash ordering implied.
+    ``cost_from="prewarm"`` makes ``cost_min`` a live estimate: each
+    queue run re-derives it from the newest per-kernel
+    ``prewarm_kernel`` compile walls in the health journal (see
+    :func:`observed_prewarm_cost_min`) so flap-window admission uses
+    measured compile cost, not a hand-guessed constant.
     """
 
     __slots__ = ("name", "shell", "gating", "timeout_s", "cost_min",
                  "value", "max_attempts_per_day", "quarantine_after",
-                 "stamp", "needs_chip", "inputs", "after")
+                 "stamp", "needs_chip", "inputs", "after", "cost_from")
 
     def __init__(self, name, shell, *, gating=True, timeout_s=1200.0,
                  cost_min=5.0, value=1.0, max_attempts_per_day=6,
                  quarantine_after=2, stamp="daily", needs_chip=True,
-                 inputs=(), after=()):
+                 inputs=(), after=(), cost_from=None):
         if stamp not in ("daily", "attempt", "never"):
             raise ValueError(f"step {name!r}: bad stamp policy {stamp!r}")
+        if cost_from not in (None, "prewarm"):
+            raise ValueError(
+                f"step {name!r}: bad cost_from {cost_from!r} "
+                "(known: prewarm)")
         self.name = name
         self.shell = shell
         self.gating = bool(gating)
@@ -127,6 +136,7 @@ class StepSpec:
         self.needs_chip = bool(needs_chip)
         self.inputs = tuple(inputs)
         self.after = tuple(after)
+        self.cost_from = cost_from
 
     @property
     def density(self) -> float:
@@ -383,6 +393,39 @@ def estimate_window_minutes(events, now=None) -> dict:
             "windows": len(windows)}
 
 
+def observed_prewarm_cost_min(events, now=None):
+    """Chip-minute cost estimate for the prewarm step from measured
+    evidence: the newest successful ``prewarm_kernel`` wall per kernel
+    inside the last 24 h (tools/prewarm.py journals one per kernel and
+    per bench metric), summed and clamped to the flap band. None when
+    the journal holds no prewarm evidence yet — the spec's shipped
+    ``cost_min`` then stands. A warm cache shrinks the estimate toward
+    zero, which is exactly the point: a prewarmed suite should be
+    admitted into windows the cold-compile guess would have deferred
+    it out of."""
+    now = time.time() if now is None else now
+    horizon = now - 24 * 3600
+    newest: dict = {}
+    for ev in events:
+        if ev.get("kind") != "prewarm_kernel":
+            continue
+        t = ev.get("t")
+        if not isinstance(t, (int, float)) or t < horizon:
+            continue
+        if ev.get("status") not in (None, "ok"):
+            continue
+        kernel, wall = ev.get("kernel"), ev.get("wall_s")
+        if kernel is None or not isinstance(wall, (int, float)):
+            continue
+        if kernel not in newest or t >= newest[kernel][0]:
+            newest[kernel] = (t, wall)
+    if not newest:
+        return None
+    total_min = sum(w for _t, w in newest.values()) / 60.0
+    lo, hi = 0.5, _WINDOW_CLAMP[1]
+    return round(min(max(total_min, lo), hi), 2)
+
+
 # ------------------------------------------------------------------ #
 # probe + backoff schedule                                            #
 # ------------------------------------------------------------------ #
@@ -489,6 +532,7 @@ class Supervisor:
         self._settled: set = set()
         self._attempted: set = set()
         self._deferred: list = []
+        self._cost_override: dict = {}  # name -> measured cost_min
         self._last_rc: int | None = None
         self._last_wall_s: float = 0.0
         if self.state["events"] and announce:
@@ -573,6 +617,18 @@ class Supervisor:
         return [s for s in pending
                 if all(a in self._attempted for a in s.after)]
 
+    def _cost_min(self, spec) -> float:
+        """Effective chip-minute cost for admission: this run's
+        measured refinement when one exists, else the shipped
+        estimate. Kept OFF the spec object: PRODUCTION_QUEUE specs are
+        module-level and shared by every Supervisor a watch process
+        builds — mutating them would make later runs' "prior" the last
+        estimate instead of the shipped cost."""
+        return self._cost_override.get(spec.name, spec.cost_min)
+
+    def _density(self, spec) -> float:
+        return spec.value / max(self._cost_min(spec), 0.01)
+
     def plan(self, remaining_min: float, may_force: bool):
         """Pick the next step for the remaining window budget: highest
         value-per-chip-minute among schedulable steps whose cost fits
@@ -586,9 +642,10 @@ class Supervisor:
         sched = self._schedulable(pending)
         if not sched:
             return None, False
-        sched.sort(key=lambda s: -s.density)
+        sched.sort(key=lambda s: -self._density(s))
         fits = [s for s in sched
-                if not s.needs_chip or s.cost_min <= remaining_min]
+                if not s.needs_chip
+                or self._cost_min(s) <= remaining_min]
         if fits:
             return fits[0], False
         if may_force:
@@ -603,7 +660,7 @@ class Supervisor:
                                attempt=st["attempts"],
                                gating=spec.gating, forced=forced,
                                timeout_s=spec.timeout_s,
-                               cost_min=spec.cost_min)
+                               cost_min=self._cost_min(spec))
         journal.emit("step_start", step=spec.name,
                      attempt=st["attempts"], gating=spec.gating,
                      forced=forced)
@@ -675,6 +732,20 @@ class Supervisor:
         exit-code contract value (RC_* above)."""
         events, _bad = journal.load_events(self._history_paths())
         est = estimate_window_minutes(events)
+        # measured-cost refinement: steps that opted in (cost_from)
+        # re-derive their chip-minute estimate from journal evidence
+        # BEFORE admission, so the value-density ordering and the
+        # window fit both see real compile walls. Journal-only (not
+        # checkpointed): an estimate is scheduling input, not state.
+        for spec in self.specs:
+            if spec.cost_from == "prewarm":
+                obs = observed_prewarm_cost_min(events)
+                if obs is not None and obs != spec.cost_min:
+                    journal.emit("step_cost_estimated", step=spec.name,
+                                 cost_min=obs,
+                                 prior_cost_min=spec.cost_min,
+                                 basis="prewarm_kernel")
+                    self._cost_override[spec.name] = obs
         journal.emit("window_estimate", minutes=est["minutes"],
                      basis=est["basis"], windows=est["windows"])
         print(f"supervisor: healthy-window estimate "

@@ -32,6 +32,7 @@ import subprocess
 import sys
 import time
 
+from tpukernels import _cachedir
 from tpukernels.obs import metrics as obs_metrics
 from tpukernels.obs import trace
 from tpukernels.resilience import journal, watchdog
@@ -78,6 +79,51 @@ def probe_identity(env, timeout_s=240):
         return json.loads(r.stdout.strip().splitlines()[-1])
     except (ValueError, IndexError):
         return None
+
+
+def _journal_file(env) -> str | None:
+    """The health-journal file the bench CHILDREN will append to under
+    ``env``, or None when journaling is off — the runner tails it to
+    measure each candidate's AOT hit ratio (the children's
+    ``aot_hit``/``aot_miss`` events are the only cross-process compile
+    evidence; stdout must stay byte-identical by contract). Resolution
+    — including the directory-valued form — is the journal module's
+    own rule, applied to the child env instead of ours."""
+    return journal.resolve(env.get("TPK_HEALTH_JOURNAL"))
+
+
+def _journal_size(path) -> int:
+    if path is None:
+        return 0
+    try:
+        return os.stat(path).st_size
+    except OSError:
+        return 0
+
+
+def _aot_hit_ratio(path, offset):
+    """hits/(hits+misses) over journal events appended past byte
+    ``offset``, or None when journaling is off / no compile happened
+    (a fully warm candidate emits hits only — ratio 1.0; a genuinely
+    new block shape shows up as < 1.0)."""
+    if path is None:
+        return None
+    hits = misses = 0
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            for line in f.read().splitlines():
+                try:
+                    kind = json.loads(line).get("kind")
+                except ValueError:
+                    continue
+                hits += kind == "aot_hit"
+                misses += kind == "aot_miss"
+    except OSError:
+        return None
+    if hits + misses == 0:
+        return None
+    return round(hits / (hits + misses), 3)
 
 
 def run_candidate(metric, env, timeout_s):
@@ -132,6 +178,13 @@ def tune(
     if smoke:
         env0.update(_SMOKE_ENV)
     env0["TPK_TUNING_CACHE"] = "0"  # children never read mid-sweep
+    # every candidate re-enters a cold process; the shared persistent
+    # compilation cache (docs/PERF.md §compile discipline) means only
+    # genuinely NEW block shapes compile — candidate N+1 re-lowers but
+    # never re-pays the backend compile for programs candidate N
+    # already built. setdefault semantics: an explicit cache dir in
+    # base_env wins.
+    _cachedir.ensure_compilation_cache(env0)
     if timeout_s is None:
         timeout_s = float(
             os.environ.get("TPK_TUNE_TIMEOUT_S", "60" if smoke else "420")
@@ -193,11 +246,21 @@ def tune(
         env = dict(env0)
         env.update(space.env_for(params))
         t0 = time.monotonic()
+        # re-resolved per candidate: a directory-valued journal
+        # rotates to a new dated file at midnight, and a long sweep
+        # must tail the file THIS candidate's children append to
+        jpath = _journal_file(env0)
+        j0 = _journal_size(jpath)
         # candidate params ride on the span so a trace of the sweep
         # shows where the sweep's wall clock went per configuration
         with trace.span(f"tune/{kernel}", **params):
             value, status = run_candidate(space.metric, env, timeout_s)
         elapsed = round(time.monotonic() - t0, 2)
+        # the child's aot_hit/aot_miss events landed in the shared
+        # journal past j0: its compile-cache hit ratio is the
+        # chip-minute story of this candidate (1.0 = fully warm, the
+        # sweep spent its wall measuring; <1.0 = new block shapes)
+        aot_ratio = _aot_hit_ratio(jpath, j0)
         obs_metrics.inc(
             "tuning.candidates_ok" if value is not None
             else "tuning.candidates_failed"
@@ -209,6 +272,7 @@ def tune(
             value=value,
             status=status,
             elapsed_s=elapsed,
+            aot_hit_ratio=aot_ratio,
         )
         shown = (
             f"{value:12.2f}" if value is not None else f"  FAIL ({status})"
@@ -216,8 +280,11 @@ def tune(
         echo(
             "  ".join(f"{k}={v}" for k, v in params.items())
             + f"  {shown}"
+            + (f"  [aot hit {aot_ratio:.0%}]" if aot_ratio is not None
+               else "")
         )
-        rows.append({"params": params, "value": value, "status": status})
+        rows.append({"params": params, "value": value, "status": status,
+                     "aot_hit_ratio": aot_ratio})
 
     # candidates() puts the shipped defaults first; if a space ever
     # ships infeasible defaults (pruned), there is no control row and
