@@ -354,8 +354,11 @@ def bench_nbody(n=65536):
 
 
 def bench_scan_hist(n=1 << 22):
-    from tpukernels.kernels.histogram import histogram
-    from tpukernels.kernels.scan import inclusive_scan
+    # the combined wrapper resolves TPK_SCANHIST_FUSE (off = the two
+    # proven kernels, exactly the old metric path; on = the fused
+    # single-pass kernel), so the autotuner sweeps the fuse axis
+    # through this real metric path (docs/TUNING.md)
+    from tpukernels.kernels.scan_histogram import scan_histogram
 
     rng = np.random.default_rng(3)
     x = jnp.asarray(rng.integers(0, 256, n), jnp.int32)
@@ -364,8 +367,7 @@ def bench_scan_hist(n=1 << 22):
         def f(x):
             def body(i, carry):
                 xc, acc = carry
-                s = inclusive_scan(xc)
-                h = histogram(xc, 256)
+                s, h = scan_histogram(xc, 256)
                 # parity of a data-dependent sum; xor keeps values in
                 # [0,256) while chaining each iteration on the last
                 acc = (acc + s[-1] + h[0]) & 1
@@ -597,6 +599,7 @@ _METRIC_KERNEL_SOURCES = {
     "scan_hist_melem_s": (
         "tpukernels/kernels/scan.py",
         "tpukernels/kernels/histogram.py",
+        "tpukernels/kernels/scan_histogram.py",
     ),
     "nbody_ginter_s": ("tpukernels/kernels/nbody.py",),
     "stencil2d_mcells_s": ("tpukernels/kernels/stencil.py",),

@@ -316,7 +316,12 @@ def test_trend_nested_artifact_contributes_and_dedupes(tmp_path):
     v = trend.analyze_repo(root)["stencil2d_mcells_s"]
     assert v["valid_points"] == 1  # three copies, one point
     assert v["latest"] == 131799.49
-    assert v["verdict"] == "ok"
+    # trend-clean, but ~20% of the analytic VPU roofline — the
+    # non-gating headroom verdict (tests/test_roofline.py proves the
+    # gating/transition rules; here just that real-repo-shaped data
+    # lands on it instead of reading "ok" forever)
+    assert v["verdict"] == "below_roofline"
+    assert v["roofline"]["below"] is True
 
 
 def test_trend_round_tail_fallback(tmp_path):

@@ -1,0 +1,99 @@
+"""Registry completeness lint (ISSUE 6 satellite, tier-1).
+
+Every kernel in the registry must carry the full contract surface —
+TUNABLES, an ``aot.BENCH_CONFIGS`` avatar, a ``KERNEL_SOURCES`` row,
+and a roofline entry — either directly or through
+``registry.DERIVED_KERNELS`` (scan_exclusive rides scan's). A new
+kernel (the fused scan_histogram was the first customer) cannot
+silently skip tuning, prewarm, staleness tracking, or the roofline
+table.
+
+Also asserts the widened-TUNABLES acceptance contracts: the AOT
+executable-cache key is distinct per pipeline/fuse variant (the
+tunable env fingerprint), and a capped --smoke sweep reaches the
+pipeline-depth axis.
+"""
+
+import numpy as np
+import pytest
+
+from tpukernels import aot, registry
+from tpukernels.tuning import roofline
+
+
+def test_registry_contract_complete():
+    names = registry.names()
+    assert "scan_histogram" in names  # the newest contract customer
+    for name in names:
+        base = registry.DERIVED_KERNELS.get(name, name)
+        assert base in names, f"{name}: derived base {base} missing"
+        space = registry.tunables(base)  # KeyError = contract breach
+        assert name in aot.BENCH_CONFIGS, (
+            f"{name} has no aot.BENCH_CONFIGS avatar (prewarm skips it)"
+        )
+        assert aot.KERNEL_SOURCES.get(name), (
+            f"{name} has no KERNEL_SOURCES row (manifest staleness "
+            "cannot be tracked)"
+        )
+        metric = roofline.KERNEL_METRIC.get(base)
+        assert metric in roofline.MODELS, (
+            f"{name} has no roofline entry (its captures would read "
+            "'ok' forever)"
+        )
+        # the spaces' own metric binding must agree with the roofline
+        # mapping — one kernel, one metric of record
+        if space.metric is not None:
+            assert space.metric == metric, (name, space.metric, metric)
+
+
+def test_derived_kernels_are_registered_and_tunable_through_base():
+    for derived, base in registry.DERIVED_KERNELS.items():
+        assert derived in registry.names()
+        with pytest.raises(KeyError, match="TUNABLES"):
+            registry.tunables(derived)
+        assert registry.tunables(base) is not None
+
+
+def test_aot_key_distinct_per_pipeline_variant(monkeypatch):
+    """Acceptance: each TUNABLES-selected variant (sgemm depth/order,
+    stencil3d depth, scan_histogram fuse) compiles under its OWN
+    executable-cache key — the tunable env fingerprint rides the key,
+    so a depth-2 candidate can never be served the depth-1
+    executable."""
+    registry.names()  # populate, so the fingerprint sees all TUNABLES
+    x = np.zeros((8, 8), np.float32)
+    keys = {}
+    for env in (
+        None,
+        ("TPK_SGEMM_DEPTH", "2"),
+        ("TPK_SGEMM_DEPTH", "3"),
+        ("TPK_SGEMM_ORDER", "ji"),
+        ("TPK_STENCIL_DEPTH", "2"),
+        ("TPK_SCANHIST_FUSE", "on"),
+    ):
+        for var in ("TPK_SGEMM_DEPTH", "TPK_SGEMM_ORDER",
+                    "TPK_STENCIL_DEPTH", "TPK_SCANHIST_FUSE"):
+            monkeypatch.delenv(var, raising=False)
+        if env is not None:
+            monkeypatch.setenv(*env)
+        aot.reset()
+        keys[env] = aot.cache_key("sgemm", (x,), kind="cpu")
+        if env is not None:
+            assert f"{env[0]}={env[1]}" in keys[env]
+    aot.reset()
+    assert len(set(keys.values())) == len(keys), keys
+
+
+def test_smoke_sweep_reaches_pipeline_depth():
+    """Acceptance: `autotune --kernel stencil3d --smoke` (capped at 3
+    candidates by the runner) sweeps pipeline depth — the depth axis
+    is declared right after the control's k, so the first three
+    candidates are depth 1/2/3 at the k of record. scan_histogram's
+    2-candidate space likewise covers fuse off/on inside the cap."""
+    cands, pruned = registry.tunables("stencil3d").candidates()
+    assert pruned == 0
+    assert cands[:3] == [
+        {"k": 8, "depth": 1}, {"k": 8, "depth": 2}, {"k": 8, "depth": 3},
+    ]
+    fuse_cands, _ = registry.tunables("scan_histogram").candidates()
+    assert fuse_cands == [{"fuse": "off"}, {"fuse": "on"}]

@@ -1,0 +1,293 @@
+"""CPU suite for the analytic roofline models (docs/PERF.md
+§rooflines) and the below_roofline trend verdict.
+
+Pins the FLOPs/bytes formulas against hand-computed values for each
+BASELINE.json benchmark config, the shared sgemm byte arithmetic
+(ISSUE 6 satellite: one helper feeds the VMEM feasibility model AND
+the roofline byte count), and the verdict rules: below_roofline fires
+only from an ok verdict (never no_data / invalidated / regression /
+impossible), never gates (`obs_report --check` rc stays 0), and
+respects TPK_ROOFLINE_MIN_FRAC.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpukernels.tuning import roofline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+V5 = roofline.PEAKS["tpu_v5_lite"]
+
+
+# ---------------------------------------------------------------- #
+# the arithmetic, pinned per BASELINE.json config                   #
+# ---------------------------------------------------------------- #
+
+def test_sgemm_formulas_and_peak():
+    m = roofline.MODELS["sgemm_gflops"]
+    assert m.config == (1024, 1024, 1024)
+    assert m.flops(1024, 1024, 1024) == 2 * 1024**3        # 2·m·n·k
+    assert m.hbm_bytes(1024, 1024, 1024) == 16 * 1024**2   # 4·4·1024²
+    p = roofline.peak("sgemm_gflops", kind="tpu_v5_lite")
+    # 184 TF / 3 passes over 2.147 GFLOP -> the BASELINE.json ceiling
+    assert p["bound"] == "compute"
+    assert round(p["peak"]) == 61333
+    # at a tiny K the byte leg dominates: bound flips to bandwidth
+    tiny = roofline.RooflineModel(
+        metric="x", kernel="sgemm", config=(1024, 1024, 8),
+        flops=m.flops, hbm_bytes=m.hbm_bytes, work=m.work,
+        compute="mxu_f32",
+    )
+    f = tiny.flops(*tiny.config) / (V5["mxu_flops"] / 3)
+    b = tiny.hbm_bytes(*tiny.config) / (V5["hbm_gb_s"] * 1e9)
+    assert b > f  # the flip this model class must be able to express
+
+
+def test_sgemm_bytes_per_block_shared_with_vmem_model():
+    """ONE formula, two consumers: the kernels/sgemm.py VMEM model and
+    the roofline HBM count both derive from sgemm_bytes_per_block."""
+    from tpukernels.kernels.sgemm import _vmem_bytes
+
+    blk = roofline.sgemm_bytes_per_block(256, 2048, 1024)
+    assert blk == {
+        "a": 4 * 256 * 1024,
+        "b": 4 * 1024 * 2048,
+        "c": 8 * 256 * 2048,
+        "acc": 4 * 256 * 2048,
+    }
+    # VMEM model = double-buffered a+b pairs + c + acc = the
+    # documented 24 MiB control figure
+    control = {"bm": 256, "bn": 2048, "bk": 1024, "depth": 1}
+    assert _vmem_bytes(control) == 24 * 1024 * 1024
+    assert (
+        _vmem_bytes(control)
+        == 2 * (blk["a"] + blk["b"]) + blk["c"] + blk["acc"]
+    )
+    # manual-pipeline depth multiplies only the streamed pair
+    assert (
+        _vmem_bytes({**control, "depth": 3})
+        == 3 * (blk["a"] + blk["b"]) + blk["c"] + blk["acc"]
+    )
+    # roofline HBM = one visit per distinct block, acc excluded
+    whole = roofline.sgemm_bytes_per_block(1024, 1024, 1024)
+    assert roofline.sgemm_hbm_bytes(1024, 1024, 1024) == (
+        whole["a"] + whole["b"] + whole["c"]
+    )
+
+
+@pytest.mark.parametrize(
+    "metric,flops,hbm_bytes,peak_value,bound",
+    [
+        # stencil2d 4096²: 6 VPU ops/cell/sweep, 1 B/cell/sweep (k=8)
+        ("stencil2d_mcells_s", 6.0 * 4096**2, 4096**2,
+         3.9e12 / 6 / 1e6, "compute"),
+        # stencil3d 384³: 8 ops/cell, 1 B/cell
+        ("stencil3d_mcells_s", 8.0 * 384**3, 384**3,
+         3.9e12 / 8 / 1e6, "compute"),
+        # nbody 65536: 20 flops/interaction, j-set VMEM-resident
+        ("nbody_ginter_s", 20.0 * 65536**2, 28.0 * 65536,
+         3.9e12 / 20 / 1e9, "compute"),
+        # scan+hist 2²²: 12 B/elem unfused -> HBM-bound
+        ("scan_hist_melem_s", 1536.0 * 2**22, 12.0 * 2**22,
+         819e9 / 12 / 1e6, "bandwidth"),
+        # saxpy stream 2²⁶: the metric IS GB/s, peak = HBM BW
+        ("saxpy_stream_gb_s", 2.0 * 2**26, 12.0 * 2**26,
+         819.0, "bandwidth"),
+    ],
+)
+def test_metric_formulas_and_peaks(metric, flops, hbm_bytes,
+                                   peak_value, bound):
+    m = roofline.MODELS[metric]
+    assert m.flops(*m.config) == flops
+    assert m.hbm_bytes(*m.config) == hbm_bytes
+    p = roofline.peak(metric, kind="tpu_v5_lite")
+    assert p["bound"] == bound
+    assert p["peak"] == pytest.approx(peak_value, rel=1e-9)
+
+
+def test_saxpy_config_of_record_is_documented_artifact():
+    """The VMEM-resident 2²⁰ config legitimately beats the HBM
+    roofline: reported, never verdict-ed."""
+    p = roofline.peak("saxpy_gb_s", kind="tpu_v5_lite")
+    assert p["artifact"] is True and p["peak"] == pytest.approx(819.0)
+    from tpukernels.obs import trend
+
+    # measured median 9,114 GB/s >> 819: no below_roofline, and the
+    # artifact flag would suppress it even below threshold
+    assert trend._roofline_check("saxpy_gb_s", 9114.0)["below"] is False
+    assert trend._roofline_check("saxpy_gb_s", 10.0)["below"] is False
+
+
+def test_every_registry_kernel_metric_is_modeled():
+    # KERNEL_METRIC -> MODELS is closed (the registry lint's other half)
+    for kernel, metric in roofline.KERNEL_METRIC.items():
+        assert metric in roofline.MODELS, (kernel, metric)
+
+
+def test_resolve_kind_fallbacks(monkeypatch):
+    monkeypatch.delenv("TPK_ROOFLINE_DEVICE", raising=False)
+    row, kind, basis = roofline.resolve_kind()
+    assert kind == roofline.EVIDENCE_KIND and basis == "exact"
+    row, kind, basis = roofline.resolve_kind("tpu_v7_megapod")
+    assert row is roofline.PEAKS["tpu_v5_lite"]
+    assert basis == "assumed-tpu_v5_lite"
+    row, kind, basis = roofline.resolve_kind("gracehopper")
+    assert row is roofline.PEAKS["cpu"] and basis == "cpu-fallback"
+    monkeypatch.setenv("TPK_ROOFLINE_DEVICE", "cpu")
+    row, kind, basis = roofline.resolve_kind()
+    assert kind == "cpu" and basis == "exact"
+
+
+def test_min_frac_env_fail_loud(monkeypatch):
+    monkeypatch.delenv("TPK_ROOFLINE_MIN_FRAC", raising=False)
+    assert roofline.min_frac() == 0.5
+    monkeypatch.setenv("TPK_ROOFLINE_MIN_FRAC", "0.25")
+    assert roofline.min_frac() == 0.25
+    for bad in ("abc", "-0.1", "1.5"):
+        monkeypatch.setenv("TPK_ROOFLINE_MIN_FRAC", bad)
+        with pytest.raises(ValueError, match="TPK_ROOFLINE_MIN_FRAC"):
+            roofline.min_frac()
+
+
+# ---------------------------------------------------------------- #
+# trend verdict rules (fixtures mirror tests/test_obs.py)           #
+# ---------------------------------------------------------------- #
+
+def _fixture_root(tmp_path, baseline=None, logs=None, rounds=None):
+    root = tmp_path / "repo"
+    (root / "docs" / "logs").mkdir(parents=True)
+    (root / "BASELINE.json").write_text(json.dumps(baseline or {}))
+    for fname, line in (logs or {}).items():
+        (root / "docs" / "logs" / fname).write_text(json.dumps(line))
+    for n, rec in (rounds or {}).items():
+        (root / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
+    return str(root)
+
+
+def _line(details, **extra):
+    return {"metric": "sgemm_gflops_per_chip", "value": None,
+            "unit": "GFLOPS", "details": details, **extra}
+
+
+def test_below_roofline_fires_only_from_ok(tmp_path, monkeypatch):
+    from tpukernels.obs import trend
+
+    monkeypatch.delenv("TPK_ROOFLINE_MIN_FRAC", raising=False)
+    root = _fixture_root(
+        tmp_path,
+        baseline={"measured": {"stencil2d_mcells_s": 129996}},
+        logs={"bench_2026-08-01_000000.json": _line(
+            {"stencil2d_mcells_s": 129996.0})},
+    )
+    v = trend.analyze_repo(root)["stencil2d_mcells_s"]
+    assert v["verdict"] == "below_roofline"
+    assert v["roofline"]["frac"] == pytest.approx(
+        129996.0 / 650000.0, rel=1e-6
+    )
+    assert any("BELOW ROOFLINE" in f and "non-gating" in f
+               for f in v["flags"])
+    # a loosened threshold turns the same series back to plain ok
+    monkeypatch.setenv("TPK_ROOFLINE_MIN_FRAC", "0.1")
+    v = trend.analyze_repo(root)["stencil2d_mcells_s"]
+    assert v["verdict"] == "ok"
+    assert v["roofline"]["below"] is False  # still recorded
+
+
+def test_below_roofline_never_fires_on_no_data_or_invalidated(tmp_path):
+    """The satellite fixture: tunnel-down rounds and
+    invalidated-at-source values stay no_data — the roofline check
+    must not touch them (there is no value to judge)."""
+    from tpukernels.obs import trend
+
+    null_round = {"n": 1, "parsed": _line(
+        {"error": "TPU backend unreachable"})}
+    root = _fixture_root(
+        tmp_path,
+        baseline={
+            "measured": {"stencil2d_mcells_s": 129996},
+            "ceilings": {"sgemm_gflops": 61333},
+        },
+        logs={"bench_2026-08-01_000000.json": _line(
+            {"sgemm_gflops": None},
+            invalidated={"sgemm_gflops": [72698.96, "drift"]},
+        )},
+        rounds={1: null_round, 2: null_round},
+    )
+    verdicts = trend.analyze_repo(root)
+    assert verdicts["stencil2d_mcells_s"]["verdict"] == "no_data"
+    assert "roofline" not in verdicts["stencil2d_mcells_s"]
+    assert verdicts["sgemm_gflops"]["verdict"] == "no_data"
+    assert "roofline" not in verdicts["sgemm_gflops"]
+
+
+def test_below_roofline_never_masks_regression_or_impossible(tmp_path):
+    from tpukernels.obs import trend
+
+    root = _fixture_root(
+        tmp_path,
+        baseline={
+            "measured": {"stencil2d_mcells_s": 129996},
+            "ceilings": {"sgemm_gflops": 61333},
+        },
+        logs={
+            "bench_2026-08-01_000000.json": _line(
+                {"stencil2d_mcells_s": 129996.0,
+                 "sgemm_gflops": 72698.96}),
+            "bench_2026-08-02_000000.json": _line(
+                {"stencil2d_mcells_s": 100000.0}),
+        },
+    )
+    verdicts = trend.analyze_repo(root)
+    # 23% drop: regression wins even though 100000 is also <50% of
+    # the roofline
+    assert verdicts["stencil2d_mcells_s"]["verdict"] == "regression"
+    assert verdicts["sgemm_gflops"]["verdict"] == "impossible"
+
+
+def test_obs_report_check_rc0_on_below_roofline(tmp_path):
+    """The acceptance fixture: a below-roofline-only repo keeps
+    --check rc 0 (non-gating), and the real repo's --roofline section
+    renders the machine-checked table."""
+    root = _fixture_root(
+        tmp_path,
+        baseline={"measured": {"stencil2d_mcells_s": 129996}},
+        logs={"bench_2026-08-01_000000.json": _line(
+            {"stencil2d_mcells_s": 129996.0})},
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--check", "--root", root],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "below_roofline (non-gating)" in r.stdout
+
+
+def test_obs_report_roofline_table_covers_baseline_configs(tmp_path):
+    """The --roofline table covers every modeled metric — in
+    particular all 5 BASELINE.json benchmark configs — with the
+    analytic peak and (where evidence exists) % of roofline, and the
+    run leaves roofline_computed journal evidence."""
+    journal = tmp_path / "health.jsonl"
+    env = dict(os.environ, TPK_HEALTH_JOURNAL=str(journal))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--roofline"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    for metric in roofline.MODELS:  # the 5 configs + stream/3d rows
+        assert metric in r.stdout
+    assert "analytic peak" in r.stdout and "% of roofline" in r.stdout
+    events = [json.loads(ln) for ln in
+              journal.read_text().splitlines() if ln.strip()]
+    (ev,) = [e for e in events if e.get("kind") == "roofline_computed"]
+    assert ev["device_kind"] == "tpu_v5_lite"
+    assert ev["min_frac"] == 0.5
+    assert set(ev["metrics"]) == set(roofline.MODELS)
+    assert round(ev["metrics"]["sgemm_gflops"]["peak"]) == 61333

@@ -139,3 +139,104 @@ def test_histogram_matches_jnp_reference(rng):
     np.testing.assert_array_equal(
         np.asarray(histogram(x, 32)), np.asarray(histogram_reference(x, 32))
     )
+
+
+# ------------------------------------------------------------------ #
+# fused single-pass scan+histogram (kernels/scan_histogram.py)       #
+# ------------------------------------------------------------------ #
+
+@pytest.mark.parametrize("fuse", ["off", "on"])
+@pytest.mark.parametrize(
+    "n,nbins",
+    [
+        (100000, 256),
+        (999, 16),
+        (4096, 1024),   # > 256 bins: beyond the MXU path's reach
+        (300000, 200),  # nbins not dividing the chunk budget
+        (7, 4),         # sub-lane problem: single padded block
+        (0, 16),        # empty input
+    ],
+)
+def test_scan_histogram_exact(rng, monkeypatch, n, nbins, fuse):
+    from tpukernels.kernels.scan_histogram import (
+        scan_histogram,
+        scan_histogram_reference,
+    )
+
+    monkeypatch.setenv("TPK_SCANHIST_FUSE", fuse)
+    x = jnp.asarray(rng.integers(0, nbins, n), dtype=jnp.int32)
+    s, h = scan_histogram(x, nbins)
+    sr, hr = scan_histogram_reference(x, nbins)
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(sr))
+    np.testing.assert_array_equal(np.asarray(h), np.asarray(hr))
+    assert np.asarray(h).sum() == n
+
+
+def test_scan_histogram_fused_pad_correction(monkeypatch):
+    """The fused path pads with ZEROS (scan-neutral) and subtracts the
+    pad count from bin 0 — an all-zeros input is the worst case for
+    over/under-correction."""
+    from tpukernels.kernels.scan_histogram import scan_histogram
+
+    monkeypatch.setenv("TPK_SCANHIST_FUSE", "on")
+    x = jnp.zeros(1000, jnp.int32)
+    s, h = scan_histogram(x, 8)
+    assert int(np.asarray(h)[0]) == 1000
+    assert int(np.asarray(h).sum()) == 1000
+    np.testing.assert_array_equal(np.asarray(s), np.zeros(1000))
+    # out-of-range and negative values count nothing, scan keeps them
+    x = jnp.asarray(np.array([-5, 3, 99, 3, 0], np.int32))
+    s, h = scan_histogram(x, 4)
+    np.testing.assert_array_equal(np.asarray(h), [1, 0, 0, 2])
+    np.testing.assert_array_equal(
+        np.asarray(s), np.cumsum([-5, 3, 99, 3, 0])
+    )
+
+
+def test_scan_histogram_fuse_off_is_the_two_kernel_path(rng):
+    """fuse=off (the shipped default) must equal the standalone
+    kernels exactly — it IS them."""
+    from tpukernels.kernels.scan_histogram import scan_histogram
+
+    x = jnp.asarray(rng.integers(0, 32, 5000), dtype=jnp.int32)
+    s, h = scan_histogram(x, 32)  # default: no env set
+    np.testing.assert_array_equal(
+        np.asarray(s), np.asarray(inclusive_scan(x))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(h), np.asarray(histogram(x, 32))
+    )
+
+
+def test_scan_histogram_bad_fuse_env_fails_loud(monkeypatch):
+    from tpukernels.kernels.scan_histogram import scan_histogram
+
+    monkeypatch.setenv("TPK_SCANHIST_FUSE", "maybe")
+    with pytest.raises(ValueError, match="TPK_SCANHIST_FUSE"):
+        scan_histogram(jnp.zeros(16, jnp.int32), 8)
+
+
+@pytest.mark.parametrize("impl,acc", [("mxu", "i8"), ("vpu", "i8"),
+                                      ("vpu", "f32")])
+def test_scan_histogram_fused_honors_hist_knobs(rng, monkeypatch,
+                                                impl, acc):
+    """The fused kernel's histogram half resolves histogram's own
+    impl/acc TUNABLES (shared hist_mxu_block/hist_vpu_block helpers),
+    so TPK_HIST_IMPL/ACC mean the same thing on both entry points —
+    including the fail-loud mxu/nbins validation."""
+    from tpukernels.kernels.scan_histogram import (
+        scan_histogram,
+        scan_histogram_reference,
+    )
+
+    monkeypatch.setenv("TPK_SCANHIST_FUSE", "on")
+    monkeypatch.setenv("TPK_HIST_IMPL", impl)
+    monkeypatch.setenv("TPK_HIST_ACC", acc)
+    x = jnp.asarray(rng.integers(0, 200, 50000), dtype=jnp.int32)
+    s, h = scan_histogram(x, 200)
+    sr, hr = scan_histogram_reference(x, 200)
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(sr))
+    np.testing.assert_array_equal(np.asarray(h), np.asarray(hr))
+    if impl == "mxu":
+        with pytest.raises(ValueError, match="nbins"):
+            scan_histogram(x, 1024)

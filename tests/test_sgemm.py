@@ -88,3 +88,58 @@ def test_tile_preference_knobs(rng, monkeypatch):
         monkeypatch.setenv("TPK_SGEMM_BM", bad)
         with pytest.raises(ValueError, match="TPK_SGEMM_BM"):
             sgemm(1.0, a, b, 0.0, c)
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+@pytest.mark.parametrize("precision,rtol,atol",
+                         [("float32", 2e-5, 2e-4), ("high", 1e-4, 1e-3)])
+def test_sgemm_pipelined_depth_matches_reference(
+    rng, monkeypatch, depth, precision, rtol, atol
+):
+    """The manual ping-pong DMA pipeline (TPK_SGEMM_DEPTH >= 2) is a
+    different program (pl.ANY operands + slab ring) and must meet the
+    same per-precision golden contracts as the BlockSpec path — with a
+    small bk so the K stream is genuinely multi-block (nk=3) and the
+    prologue/prefetch/slot-reuse schedule is exercised."""
+    monkeypatch.setenv("TPK_SGEMM_DEPTH", str(depth))
+    monkeypatch.setenv("TPK_SGEMM_BK", "128")
+    m, k, n = 128, 384, 256
+    a = jnp.asarray(rng.standard_normal((m, k)), dtype=jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n)), dtype=jnp.float32)
+    c = jnp.asarray(rng.standard_normal((m, n)), dtype=jnp.float32)
+    out = sgemm(1.5, a, b, 0.5, c, precision=precision)
+    ref = sgemm_reference(1.5, a, b, 0.5, c)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=rtol, atol=atol
+    )
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_sgemm_dimension_order_matches_reference(rng, monkeypatch, depth):
+    """TPK_SGEMM_ORDER=ji permutes the grid (which operand
+    re-streams); results must be identical on both the BlockSpec and
+    the pipelined path, unaligned shapes included."""
+    monkeypatch.setenv("TPK_SGEMM_ORDER", "ji")
+    monkeypatch.setenv("TPK_SGEMM_DEPTH", str(depth))
+    if depth > 1:
+        monkeypatch.setenv("TPK_SGEMM_BK", "128")
+    m, k, n = 100, 300, 200  # unaligned -> padding path
+    a = jnp.asarray(rng.standard_normal((m, k)), dtype=jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n)), dtype=jnp.float32)
+    c = jnp.asarray(rng.standard_normal((m, n)), dtype=jnp.float32)
+    out = sgemm(1.0, a, b, -0.5, c)
+    ref = sgemm_reference(1.0, a, b, -0.5, c)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_sgemm_bad_pipeline_knobs_fail_loud(rng, monkeypatch):
+    a = jnp.zeros((16, 16), jnp.float32)
+    monkeypatch.setenv("TPK_SGEMM_DEPTH", "abc")
+    with pytest.raises(ValueError, match="TPK_SGEMM_DEPTH"):
+        sgemm(1.0, a, a, 0.0, a)
+    monkeypatch.delenv("TPK_SGEMM_DEPTH")
+    monkeypatch.setenv("TPK_SGEMM_ORDER", "kij")
+    with pytest.raises(ValueError, match="TPK_SGEMM_ORDER"):
+        sgemm(1.0, a, a, 0.0, a)

@@ -131,3 +131,46 @@ def test_boundary_held_fixed(rng):
     np.testing.assert_array_equal(out[-1], xn[-1])
     np.testing.assert_array_equal(out[:, 0], xn[:, 0])
     np.testing.assert_array_equal(out[:, -1], xn[:, -1])
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+def test_jacobi3d_pipeline_depth_bitwise_identical(rng, depth):
+    """The ring-buffered slab prefetch (TPK_STENCIL_DEPTH >= 2) only
+    reorders DMA against compute — results must be BITWISE identical
+    to the copy-wait-compute path on a genuinely blocked, multi-block
+    grid (the prologue, steady-state prefetch and slot-reuse schedule
+    all execute)."""
+    from tpukernels.kernels import stencil as _st
+
+    x = jnp.asarray(
+        rng.standard_normal((64, 32, 2048)), dtype=jnp.float32
+    )
+    assert 64 * 32 * 2048 * 4 > _st._SMALL_BYTES  # blocked path
+    base = jacobi3d(x, 4, k=2, depth=1)
+    out = jacobi3d(x, 4, k=2, depth=depth)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+    ref = jacobi3d_reference(x, 4)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_jacobi3d_depth_env_knob_and_bz_budget(rng, monkeypatch):
+    """TPK_STENCIL_DEPTH resolves through the tuning subsystem, and
+    _pick_bz divides the slab budget by depth so depth slabs + out
+    blocks stay inside the same 32 MiB that sized depth 1."""
+    from tpukernels.kernels import stencil as _st
+
+    for depth in (1, 2, 3):
+        bz = _st._pick_bz(384, 384, 8, depth)
+        planes_budget = (32 * 1024 * 1024) // (4 * 384 * 384)
+        assert depth * (bz + 16) + 2 * bz <= planes_budget + depth
+    assert _st._pick_bz(64, 2048, 2, 1) > _st._pick_bz(64, 2048, 2, 3)
+    monkeypatch.setenv("TPK_STENCIL_DEPTH", "2")
+    x = jnp.asarray(rng.standard_normal((64, 32, 2048)), jnp.float32)
+    out = np.asarray(jacobi3d(x, 3))
+    monkeypatch.delenv("TPK_STENCIL_DEPTH")
+    np.testing.assert_array_equal(out, np.asarray(jacobi3d(x, 3)))
+    monkeypatch.setenv("TPK_STENCIL_DEPTH", "abc")
+    with pytest.raises(ValueError, match="TPK_STENCIL_DEPTH"):
+        jacobi3d(x, 1)

@@ -70,6 +70,8 @@ def test_sgemm_vmem_arithmetic_and_pruning():
     shipped control needs 24 MiB of a 32 MiB budget, bn=2048 with
     bk=2048 is over budget (the combination the old sgemm_tune grid
     called infeasible), and candidates() prunes exactly those."""
+    import itertools
+
     from tpukernels.kernels.sgemm import TUNABLES, _vmem_bytes
 
     control = {"bm": 256, "bn": 2048, "bk": 1024}
@@ -78,20 +80,43 @@ def test_sgemm_vmem_arithmetic_and_pruning():
     bad = {"bm": 128, "bn": 2048, "bk": 2048}
     assert _vmem_bytes(bad) > TUNABLES.vmem_budget_bytes
     assert not TUNABLES.feasible(bad)
+    # the widened axes (ISSUE 6): depth multiplies the A/B slab pair
+    # residency — triple buffering at the control blocks is over
+    # budget (~34.6 MiB), so depth=3 only probes with smaller tiles
+    assert not TUNABLES.feasible({**control, "depth": 3})
+    assert TUNABLES.feasible(
+        {"bm": 256, "bn": 1024, "bk": 512, "depth": 3}
+    )
 
     cands, pruned = TUNABLES.candidates()
-    assert cands[0] == control  # defaults first = the control row
-    assert pruned == 3  # the three bm values paired with bn=bk=2048
+    full_control = {**control, "depth": 1, "order": "ij"}
+    assert cands[0] == full_control  # defaults first = the control row
+    # pruned = the model's own count over the declared product (the
+    # bn=bk=2048 combos at every depth/order, plus the depth-3 rows
+    # whose slab pair blows the budget at the wide tiles)
+    expect_pruned = sum(
+        not TUNABLES.feasible(dict(zip(
+            ("bm", "bn", "bk", "depth", "order"), combo
+        )))
+        for combo in itertools.product(
+            *(t.values for t in TUNABLES.tunables)
+        )
+    )
+    assert pruned == expect_pruned == 26
     assert all(
         not (c["bn"] == 2048 and c["bk"] == 2048) for c in cands
     )
-    # the old tools/sgemm_tune.py documented grid is a subset
+    # the old tools/sgemm_tune.py documented grid survives as the
+    # depth=1/order=ij slice
     old_grid = [
         (256, 2048, 1024), (128, 2048, 1024), (512, 2048, 1024),
         (256, 2048, 512), (256, 1024, 1024), (256, 1024, 2048),
         (512, 1024, 1024),
     ]
-    as_tuples = {(c["bm"], c["bn"], c["bk"]) for c in cands}
+    as_tuples = {
+        (c["bm"], c["bn"], c["bk"]) for c in cands
+        if c["depth"] == 1 and c["order"] == "ij"
+    }
     assert set(old_grid) <= as_tuples
 
 
@@ -273,14 +298,16 @@ def test_resolve_precedence(tuning_cache_dir, monkeypatch):
     cache.put(params={"bm": 128, "bn": 1024, "bk": 512}, space=TUNABLES,
               shape=shape, dtype=dtype, kind=cache.device_kind())
     tspace._JOURNALED.clear()
+    # knobs the entry lacks (the widened depth/order axes) fall back
+    # to shipped defaults, per tunable
     assert resolve(TUNABLES, shape, dtype) == {
-        "bm": 128, "bn": 1024, "bk": 512,
+        "bm": 128, "bn": 1024, "bk": 512, "depth": 1, "order": "ij",
     }
 
     # 3. a set env knob beats the cache for ITS tunable only
     monkeypatch.setenv("TPK_SGEMM_BM", "512")
     assert resolve(TUNABLES, shape, dtype) == {
-        "bm": 512, "bn": 1024, "bk": 512,
+        "bm": 512, "bn": 1024, "bk": 512, "depth": 1, "order": "ij",
     }
 
     # registry exposes the same path
@@ -295,8 +322,8 @@ def test_registry_tunables_surface():
     from tpukernels import registry
 
     assert set(registry.tunable_kernels()) == {
-        "sgemm", "vector_add", "scan", "histogram", "nbody",
-        "stencil2d", "stencil3d",
+        "sgemm", "vector_add", "scan", "histogram", "scan_histogram",
+        "nbody", "stencil2d", "stencil3d",
     }
     assert registry.tunables("sgemm").metric == "sgemm_gflops"
     with pytest.raises(KeyError, match="TUNABLES"):
@@ -328,7 +355,7 @@ def test_autotune_smoke_writes_cache_and_bench_reads_it(tmp_path):
     assert key in data["entries"]
     entry = data["entries"][key]
     assert entry["smoke"] is True
-    assert set(entry["params"]) == {"bm", "bn", "bk"}
+    assert set(entry["params"]) == {"bm", "bn", "bk", "depth", "order"}
 
     journal = tmp_path / "health.jsonl"
     cand = _events(journal, "tuning_candidate")
@@ -347,7 +374,10 @@ def test_autotune_smoke_writes_cache_and_bench_reads_it(tmp_path):
     assert resolved, "bench --one did not consult the tuning cache"
     last = resolved[-1]
     assert last["kernel"] == "sgemm"
-    assert last["sources"] == {"bm": "cache", "bn": "cache", "bk": "cache"}
+    assert last["sources"] == {
+        "bm": "cache", "bn": "cache", "bk": "cache",
+        "depth": "cache", "order": "cache",
+    }
     assert last["params"] == entry["params"]
 
     # env beats cache, per tunable

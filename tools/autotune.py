@@ -14,10 +14,13 @@ minutes):
 Each candidate runs through the real metric path (`bench.py --one
 <metric>` — slope method, median of samples, CPU-fallback refusal) in
 a killable subprocess via the resilience watchdog, so one wedged
-candidate costs TPK_TUNE_TIMEOUT_S and nothing more. Candidates whose
-analytic VMEM need exceeds the kernel's budget are pruned before any
-chip time is spent; a promotion requires beating the shipped-default
-control row by >3% on the bench medians (runner.PROMOTE_MARGIN).
+candidate costs TPK_TUNE_TIMEOUT_S and nothing more. The axes are no
+longer block sizes alone: pipeline depth (sgemm/stencil3d), sgemm
+grid order and scan_histogram fusion are ordinary sweep values
+(docs/TUNING.md §surface). Candidates whose analytic VMEM need
+exceeds the kernel's budget are pruned before any chip time is spent;
+a promotion requires beating the shipped-default control row by >3%
+on the bench medians (runner.PROMOTE_MARGIN).
 
 --smoke runs the identical sweep/cache/journal machinery on CPU
 interpret mode (TPK_BENCH_SMOKE collapses repeat counts; values are

@@ -187,6 +187,18 @@ def _fmt(ev):
         return (f"{ts} [pid {pid}] step {ev.get('step')} chip-minute "
                 f"cost re-estimated {ev.get('prior_cost_min')} -> "
                 f"{ev.get('cost_min')} min ({ev.get('basis')})")
+    if kind == "roofline_computed":
+        mets = ev.get("metrics") or {}
+        below = [
+            m for m, r in sorted(mets.items())
+            if isinstance(r, dict)
+            and isinstance(r.get("frac"), (int, float))
+            and r["frac"] < (ev.get("min_frac") or 0)
+        ]
+        return (f"{ts} [pid {pid}] roofline computed for "
+                f"{len(mets)} metric(s) on {ev.get('device_kind')} "
+                f"({ev.get('basis')}, threshold {ev.get('min_frac')})"
+                + (f" - below: {','.join(below)}" if below else ""))
     if kind == "tuning_resolved":
         return (f"{ts} [pid {pid}] tuning resolved for "
                 f"{ev.get('kernel')}: {ev.get('params')} "

@@ -1,37 +1,45 @@
 """Observability report: bench trend + span/metric summary + verdicts.
 
 Usage:
-    python tools/obs_report.py [--check] [--root DIR] [--journal FILE]
-                               [--eps FLOAT]
+    python tools/obs_report.py [--check] [--roofline] [--root DIR]
+                               [--journal FILE] [--eps FLOAT]
 
-Three sections (docs/OBSERVABILITY.md):
+Sections (docs/OBSERVABILITY.md):
 
 1. **Trend table** — per-metric time series over ``BENCH_r*.json`` +
    ``docs/logs/bench_*.json`` (``tpukernels/obs/trend.py``) judged
    against the BASELINE.json measured medians and physical ceilings.
-2. **Span breakdown** — per-phase wall time aggregated from ``span``
+2. **Roofline table** — achieved vs the analytic per-kernel roofline
+   peak (``tpukernels/tuning/roofline.py``: FLOPs + minimum HBM bytes
+   per config of record against the device peaks), with % of roofline
+   and the binding resource. ``--roofline`` prints this section alone
+   (the supervisor's non-gating ``roofline_report`` step).
+3. **Span breakdown** — per-phase wall time aggregated from ``span``
    events in the health journal (default: the newest
    ``docs/logs/health_*.jsonl``; spans exist only for runs traced
    with ``TPK_TRACE=1``).
-3. **Supervisor step breakdown** — per-step wall time from the
+4. **Supervisor step breakdown** — per-step wall time from the
    ``step/<name>`` spans plus attempts/outcomes/quarantine state from
    the supervisor's ``step_*`` events (docs/RESILIENCE.md
    §supervisor).
-4. **AOT compile cache** — hit/miss traffic, compile walls on each,
+5. **AOT compile cache** — hit/miss traffic, compile walls on each,
    stale-entry rejections and prewarm outcomes from the ``aot_*`` /
    ``prewarm_*`` events (docs/PERF.md §compile discipline).
-5. **Metric snapshots** — the last ``metrics`` event per process:
+6. **Metric snapshots** — the last ``metrics`` event per process:
    counters (probe retries, watchdog kills, tuning-cache traffic),
    gauges, latency histograms.
 
 Exit-code signaling (``tools/tpu_revalidate.sh`` runs ``--check``
 non-gating and keys a WARN off it):
-    0 — every metric ``ok`` or ``no_data`` (nothing measurable went
-        backwards; tunnel-down nulls are retryable, not failures);
+    0 — every metric ``ok``, ``below_roofline`` or ``no_data``
+        (nothing measurable went backwards; tunnel-down nulls are
+        retryable, and below-roofline is a headroom signal, not a
+        failure);
     1 — at least one ``regression`` or ``impossible`` verdict.
 
-``--check`` prints only the non-ok verdict lines (machine/CI mode);
-the default mode prints the full report. ``--eps`` widens/narrows the
+``--check`` prints only the non-ok verdict lines (machine/CI mode;
+``below_roofline`` lines print as non-gating information); the
+default mode prints the full report. ``--eps`` widens/narrows the
 trend band (default: the ceiling epsilon, ``trend.CEILING_EPS``).
 """
 
@@ -46,6 +54,7 @@ sys.path.insert(0, _REPO)
 
 from tpukernels.obs import trace, trend  # noqa: E402
 from tpukernels.resilience import journal as _journal  # noqa: E402
+from tpukernels.tuning import roofline as _roofline  # noqa: E402
 
 
 def _fmt_val(v):
@@ -69,6 +78,34 @@ def trend_section(verdicts, out):
         )
         for flag in v["flags"]:
             out.append(f"    {flag}")
+
+
+def roofline_section(verdicts, out):
+    """Machine-checked roofline table (docs/PERF.md §rooflines):
+    achieved = the trend series' newest valid value per metric over
+    every committed BENCH artifact; peak = the analytic model at the
+    config of record. The % column is the headroom story the
+    below_roofline verdict keys on."""
+    rows = _roofline.report_rows(verdicts)
+    out.append("")
+    kind = rows[0]["device_kind"] if rows else "?"
+    basis = rows[0]["basis"] if rows else "?"
+    out.append(
+        f"== roofline (analytic peaks for {kind}, {basis}; "
+        f"threshold {_roofline.min_frac():.0%}) =="
+    )
+    hdr = (f"{'metric':<22} {'achieved':>13} {'analytic peak':>14} "
+           f"{'% of roofline':>14}  bound")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        frac = f"{r['frac']:.1%}" if r["frac"] is not None else "-"
+        out.append(
+            f"{r['metric']:<22} {_fmt_val(r['achieved']):>13} "
+            f"{r['peak']:>14,.0f} {frac:>14}  {r['bound']}"
+        )
+        if r["note"]:
+            out.append(f"    {r['note']}")
 
 
 def span_section(events, out):
@@ -203,6 +240,7 @@ def metrics_section(events, out):
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     check = "--check" in argv
+    roofline_only = "--roofline" in argv
     root, journal_paths, eps = _REPO, None, trend.CEILING_EPS
     it = iter(argv)
     try:
@@ -213,7 +251,7 @@ def main(argv=None):
                 journal_paths = [next(it)]
             elif a == "--eps":
                 eps = float(next(it))
-            elif a != "--check":
+            elif a not in ("--check", "--roofline"):
                 print(__doc__, file=sys.stderr)
                 print(f"obs_report: unknown argument {a!r}",
                       file=sys.stderr)
@@ -246,19 +284,35 @@ def main(argv=None):
             print(f"{name}: {v['verdict']}")
             for flag in v["flags"]:
                 print(f"  {flag}")
+        below = {
+            n: v for n, v in verdicts.items()
+            if v["verdict"] == "below_roofline"
+        }
+        for name, v in below.items():
+            # informational, never part of the rc — a kernel at 20% of
+            # roofline is headroom to earn, not a regression to gate on
+            print(f"{name}: below_roofline (non-gating)")
         ok = sum(1 for v in verdicts.values() if v["verdict"] == "ok")
         nodata = sum(
             1 for v in verdicts.values() if v["verdict"] == "no_data"
         )
         print(
             f"obs_report --check: {len(bad)} failing, {ok} ok, "
+            f"{len(below)} below-roofline (non-gating), "
             f"{nodata} no-data (no-data is retryable, not a failure)"
         )
+        return 1 if bad else 0
+
+    if roofline_only:
+        out = []
+        roofline_section(verdicts, out)
+        print("\n".join(line for line in out if line))
         return 1 if bad else 0
 
     out = []
     events, _bad = _journal.load_events(journal_paths)
     trend_section(verdicts, out)
+    roofline_section(verdicts, out)
     span_section(events, out)
     step_section(events, out)
     aot_section(events, out)

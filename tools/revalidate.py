@@ -115,6 +115,15 @@ printf '%s\\n' "$bench_out" | tail -1 \\
 python tools/obs_report.py --check && echo "obs trend check: OK"
 """, gating=False, stamp="never", timeout_s=300, cost_min=1, value=5,
       needs_chip=False, after=("bench",)),
+    # 1c. roofline table over the day's committed evidence
+    #     (docs/PERF.md §rooflines): achieved vs analytic peak per
+    #     kernel, below_roofline flagged non-gating. CPU-only, daily —
+    #     the table only changes when bench evidence or the model does.
+    S("roofline_report", """
+python tools/obs_report.py --roofline && echo "roofline report: OK"
+""", gating=False, stamp="daily", timeout_s=300, cost_min=1, value=4,
+      needs_chip=False, after=("bench",),
+      inputs=("tpukernels/tuning/roofline.py", "tools/obs_report.py")),
     # 2. C acceptance gate: serial/omp + real TPU rows + fake mesh
     S("c_gate", """
 set -e -o pipefail

@@ -6,14 +6,20 @@ autotuning subsystem (docs/TUNING.md).
 
 This entry point is kept so the revalidation docs (docs/NEXT.md,
 BASELINE.md methodology notes) stay valid verbatim. Everything the old
-one-off shell documented moved into the subsystem:
+one-off shell documented moved into the subsystem — and the sweep is
+no longer block sizes alone: the sgemm space now also searches the
+pipeline `depth` (1 = the BlockSpec path of record, 2/3 = the manual
+ping-pong DMA variant, `TPK_SGEMM_DEPTH`) and the grid dimension
+`order` (`TPK_SGEMM_ORDER` ij/ji), per docs/TUNING.md §surface:
 
 - the grid rationale (bm 128/512 probes the A-reload vs
   accumulator-locality trade, bk 512 probes accumulator turnarounds,
   bn 1024 halves B residency, bk 2048 infeasible with bn 2048) is now
   `kernels/sgemm.py` TUNABLES — the sweep values plus the analytic
-  32 MiB VMEM model that PRUNES the infeasible combos instead of
-  burning a remote-compile failure on them;
+  32 MiB VMEM model (shared with the roofline byte arithmetic,
+  docs/PERF.md §rooflines) that PRUNES the infeasible combos —
+  including over-deep pipelines at wide tiles — instead of burning a
+  remote-compile failure on them;
 - the killable-subprocess-per-config discipline is
   `tpukernels/tuning/runner.py` on the resilience watchdog;
 - the ">3% over the control before promoting" guidance is enforced in

@@ -421,6 +421,11 @@ KERNEL_SOURCES = {
     "scan": ("tpukernels/kernels/scan.py",),
     "scan_exclusive": ("tpukernels/kernels/scan.py",),
     "histogram": ("tpukernels/kernels/histogram.py",),
+    "scan_histogram": (
+        "tpukernels/kernels/scan_histogram.py",
+        "tpukernels/kernels/scan.py",
+        "tpukernels/kernels/histogram.py",
+    ),
     "nbody": ("tpukernels/kernels/nbody.py",),
 }
 
@@ -508,6 +513,13 @@ BENCH_CONFIGS = {
         "statics": {},
     },
     "histogram": {
+        "args": (("i32", (1 << 22,)),),
+        "statics": {"nbins": 256},
+    },
+    "scan_histogram": {
+        # the combined benchmark pass (capi's scan_histogram adapter /
+        # bench_scan_hist); the fuse knob rides the env fingerprint so
+        # fused and unfused precompile as distinct programs
         "args": (("i32", (1 << 22,)),),
         "statics": {"nbins": 256},
     },

@@ -308,8 +308,19 @@ def _adapt_scan_histogram(p, arrs):
     x, scan_out, counts = arrs
     n, mesh = _mesh_ctx()
     xd = _upload_1d(x, n, mesh)
-    s = _run_scan(xd, bool(p.get("exclusive")), n, mesh)
-    h = _run_histogram(xd, int(p["nbins"]), n, mesh)
+    if n == 1 and not p.get("exclusive"):
+        # single-device inclusive pass dispatches the registry's
+        # combined kernel, so the TPK_SCANHIST_FUSE knob (and any
+        # promoted tuning entry) rides the C path too — fuse=off
+        # inside the wrapper IS the old two-kernel dispatch
+        from tpukernels import registry
+
+        s, h = registry.dispatch(
+            "scan_histogram", xd, nbins=int(p["nbins"])
+        )
+    else:
+        s = _run_scan(xd, bool(p.get("exclusive")), n, mesh)
+        h = _run_histogram(xd, int(p["nbins"]), n, mesh)
     np.copyto(scan_out, _to_host(s))
     np.copyto(counts, _to_host(h))
 

@@ -71,13 +71,13 @@ TUNABLES = SearchSpace(
 
 # ------------------------------------------------------------ MXU path
 
-def _hist_mxu_kernel(x_ref, o_ref):
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
-
+def hist_mxu_block(x_ref):
+    """(128, 128) f32 joint (hi, lo) nibble counts of one (bm, 128)
+    int32 block ref (bm a multiple of 8·_MXU_T) — the in-kernel MXU
+    accumulation shared by :func:`_hist_mxu_kernel` and the fused
+    ``kernels/scan_histogram.py`` kernel (one formula, two consumers,
+    like ``scan.scan_block``). Callers merge into int32 and extract
+    the segment diagonal via :func:`joint_to_hist`."""
     bm = x_ref.shape[0]
     # constants: R replicates sublane s to rows [16s, 16s+16); hvec is
     # the per-row nibble value those rows test against
@@ -106,14 +106,31 @@ def _hist_mxu_kernel(x_ref, o_ref):
             preferred_element_type=jnp.float32,
         )
 
-    joint = jax.lax.fori_loop(
+    return jax.lax.fori_loop(
         0,
         bm // (8 * _MXU_T),
         group_body,
         jnp.zeros((128, 128), jnp.float32),
     )
+
+
+def joint_to_hist(joint, nbins):
+    """Collapse the (128, 128) joint (hi, lo) matrix to the (nbins,)
+    histogram: joint[16s+h, 16s'+l] — only same-segment (s == s')
+    pairs count."""
+    diag = jnp.einsum("shsl->hl", joint.reshape(8, 16, 8, 16))
+    return diag.reshape(256)[:nbins]
+
+
+def _hist_mxu_kernel(x_ref, o_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
     # per-block counts are <= bm*128 < 2^24: exact in f32; merge in i32
-    o_ref[:] += joint.astype(jnp.int32)
+    o_ref[:] += hist_mxu_block(x_ref).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("nbins", "interpret"))
@@ -137,29 +154,26 @@ def _hist_mxu(x2, nbins, interpret=False):
         ),
         interpret=interpret,
     )(x2)
-    # joint[16s+h, 16s'+l]: only same-segment (s == s') pairs count
-    diag = jnp.einsum("shsl->hl", joint.reshape(8, 16, 8, 16))
-    return diag.reshape(256)[:nbins]
+    return joint_to_hist(joint, nbins)
 
 
 # ------------------------------------------------------------ VPU path
 
-def _hist_kernel(nbins, chunk, acc_dtype, x_ref, o_ref):
-    i = pl.program_id(0)
+def hist_vpu_block(x_ref, nbins, chunk, acc_dtype):
+    """(1, nbins) counts of one (bm, 128) int32 block ref (bm a chunk
+    multiple) — the in-kernel VPU accumulation shared by
+    :func:`_hist_kernel` and the fused ``kernels/scan_histogram.py``
+    kernel.
 
-    @pl.when(i == 0)
-    def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
-
+    3D broadcast compare: (chunk, 128, 1) == (1, 1, nbins) keeps bins
+    on the lane dim and needs no layout-hostile reshape. The
+    compare+accumulate per (element, bin) is the VPU issue-rate
+    floor; acc_dtype picks the one-hot/accumulator type (int8 halves
+    VMEM; float32 counts are exact below 2^24 per block and may issue
+    at a different VPU rate — see TPK_HIST_ACC). The inner fori_loop
+    keeps only a (chunk, 128, nbins) slab live while the block stays
+    large enough to amortize grid-step overhead."""
     bm = x_ref.shape[0]
-    # 3D broadcast compare: (chunk, 128, 1) == (1, 1, nbins) keeps bins
-    # on the lane dim and needs no layout-hostile reshape. The
-    # compare+accumulate per (element, bin) is the VPU issue-rate
-    # floor; acc_dtype picks the one-hot/accumulator type (int8 halves
-    # VMEM; float32 counts are exact below 2^24 per block and may issue
-    # at a different VPU rate — see TPK_HIST_ACC). The inner fori_loop
-    # keeps only a (chunk, 128, nbins) slab live while the block stays
-    # large enough to amortize grid-step overhead.
     bins = jax.lax.broadcasted_iota(jnp.int32, (1, 1, nbins), 2)
     sum_dtype = jnp.float32 if acc_dtype == jnp.float32 else jnp.int32
 
@@ -169,8 +183,34 @@ def _hist_kernel(nbins, chunk, acc_dtype, x_ref, o_ref):
         return acc + jnp.sum(onehot, axis=(0, 1), dtype=sum_dtype)[None, :]
 
     zero = jnp.zeros((1, nbins), sum_dtype)
-    total = jax.lax.fori_loop(0, bm // chunk, body, zero)
-    o_ref[:] += total.astype(jnp.int32)
+    return jax.lax.fori_loop(0, bm // chunk, body, zero)
+
+
+def _hist_kernel(nbins, chunk, acc_dtype, x_ref, o_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += hist_vpu_block(x_ref, nbins, chunk, acc_dtype).astype(
+        jnp.int32
+    )
+
+
+def resolve_impl(impl, nbins: int) -> str:
+    """The nbins-dependent impl default ('mxu' only exists for
+    nbins <= 256) + the fail-loud validity check — shared by
+    :func:`histogram` and the fused scan_histogram wrapper so the two
+    entry points can never disagree about what TPK_HIST_IMPL means."""
+    if impl is None:
+        impl = "mxu" if nbins <= 256 else "vpu"
+    if impl == "mxu" and nbins > 256:
+        raise ValueError(
+            f"TPK_HIST_IMPL=mxu supports nbins <= 256, got {nbins} "
+            "(the hi/lo nibble decomposition is 16x16)"
+        )
+    return impl
 
 
 def _pick_chunk(nbins: int, acc_dtype) -> int:
@@ -225,14 +265,7 @@ def histogram(x, nbins: int, interpret: bool | None = None):
     params = resolve(
         TUNABLES, shape=(int(x.size), int(nbins)), dtype="int32"
     )
-    impl = params["impl"]
-    if impl is None:
-        impl = "mxu" if nbins <= 256 else "vpu"
-    if impl == "mxu" and nbins > 256:
-        raise ValueError(
-            f"TPK_HIST_IMPL=mxu supports nbins <= 256, got {nbins} "
-            "(the hi/lo nibble decomposition is 16x16)"
-        )
+    impl = resolve_impl(params["impl"], nbins)
     acc_name = params["acc"]
     x = x.reshape(-1).astype(jnp.int32)
     n = x.size

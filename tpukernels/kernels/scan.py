@@ -97,14 +97,12 @@ def _tri_ones(n: int):
     return (rk <= ck).astype(jnp.float32)
 
 
-def _scan_kernel(x_ref, o_ref, carry_ref):
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        carry_ref[0] = jnp.zeros((), x_ref.dtype)
-
-    block = x_ref[:]
+def scan_block(block):
+    """Carry-free inclusive scan of one (bm, lanes) block in row-major
+    element order; returns ``(scanned, block_total)``. The in-kernel
+    computation shared by :func:`_scan_kernel` and the fused
+    single-pass ``kernels/scan_histogram.py`` — callers add their own
+    cross-block carry."""
     lanes = block.shape[1]
     u = _tri_ones(lanes)
     if jnp.issubdtype(block.dtype, jnp.integer):
@@ -143,10 +141,21 @@ def _scan_kernel(x_ref, o_ref, carry_ref):
     # (no cross-lane relayout), unlike the lane shifts the MXU replaced.
     row_tot_b = jnp.broadcast_to(row_tot, block.shape)
     row_prefix_incl = _cumsum_log(row_tot_b, axis=0)[:, :1]
-    o_ref[:] = within + (row_prefix_incl - row_tot) + carry_ref[0]
     # negative int indexing lowers to dynamic_slice (no TPU lowering);
     # a full reduction is supported and equivalent
-    carry_ref[0] = carry_ref[0] + jnp.sum(row_tot)
+    return within + (row_prefix_incl - row_tot), jnp.sum(row_tot)
+
+
+def _scan_kernel(x_ref, o_ref, carry_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        carry_ref[0] = jnp.zeros((), x_ref.dtype)
+
+    scanned, total = scan_block(x_ref[:])
+    o_ref[:] = scanned + carry_ref[0]
+    carry_ref[0] = carry_ref[0] + total
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))

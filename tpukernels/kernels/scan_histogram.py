@@ -1,0 +1,204 @@
+"""Fused single-pass prefix scan + histogram (SURVEY.md C7, combined).
+
+The paper's "CUB-style" benchmark times scan AND histogram over the
+same input; today's metric path dispatches them as two kernels, so x
+streams from HBM twice (scan read + histogram read) on top of the scan
+output write — 12 B/elem. This module fuses both into ONE Pallas pass:
+each (bm, 128) block is read once, fed to the shared MXU scan
+(``scan.scan_block``) and to the shared histogram accumulation
+(``histogram.hist_mxu_block`` for nbins <= 256 — the 8x-faster nibble
+path the standalone kernel defaults to; ``histogram.hist_vpu_block``
+above, or under ``TPK_HIST_IMPL=vpu``) in the same grid step —
+8 B/elem, lifting the bandwidth roofline of the ``scan_hist_melem_s``
+metric by 1.5x (docs/PERF.md §rooflines). The histogram impl/acc
+knobs resolve through histogram's own TUNABLES here too, so the two
+entry points can never disagree about what TPK_HIST_IMPL/ACC mean.
+The decoupled-lookback machinery CUB needs does not apply: the TPU
+grid is sequential per core, so the scan carry stays an SMEM scalar
+exactly as in ``kernels/scan.py``.
+
+The ``fuse`` knob (``TPK_SCANHIST_FUSE``, default ``off``) keeps the
+two-kernel dispatch of record as the shipped default — the fused
+variant is an autotuner-searchable experiment (docs/TUNING.md): the
+sweep measures it on the real ``scan_hist_melem_s`` path and promotes
+it only if it beats the control by >3% on chip. Both paths are exact
+for int32 (the benchmark's dtype) and golden-checked against the
+cumsum/bincount oracles.
+
+Padding: the wrapper pads with ZEROS (scan-neutral) and subtracts the
+pad count from bin 0 afterwards — one pad value cannot satisfy both
+halves (scan needs 0, histogram needs out-of-range), so the histogram
+half is corrected instead (on the MXU path the zero pads land on the
+joint matrix's (hi=0, lo=0) segment diagonal, i.e. bin 0 again).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tpukernels.compat import pl, pltpu
+from tpukernels.kernels import histogram as _hist
+from tpukernels.kernels.scan import _BLOCK_ROWS, inclusive_scan, scan_block
+from tpukernels.tuning import SearchSpace, Tunable, resolve
+from tpukernels.utils import cdiv, default_interpret
+from tpukernels.utils.shapes import LANES
+
+# Declarative search space (docs/TUNING.md): one categorical knob —
+# "off" dispatches the two proven kernels (scan + histogram, each with
+# its own TUNABLES), "on" runs the fused single-pass kernel below. The
+# knob rides the AOT cache key via the tunable env fingerprint, so the
+# fused and unfused programs cache as distinct executables.
+TUNABLES = SearchSpace(
+    kernel="scan_histogram",
+    metric="scan_hist_melem_s",
+    bench_shape=(1 << 22, 256),
+    bench_dtype="int32",
+    sources=(
+        "tpukernels/kernels/scan_histogram.py",
+        "tpukernels/kernels/scan.py",
+        "tpukernels/kernels/histogram.py",
+    ),
+    tunables=(
+        Tunable("fuse", env="TPK_SCANHIST_FUSE", default="off",
+                values=("off", "on"), choice=True),
+    ),
+)
+
+
+def _fused_kernel(impl, nbins, chunk, acc_dtype,
+                  x_ref, o_scan_ref, o_hist_ref, carry_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        carry_ref[0] = jnp.zeros((), x_ref.dtype)
+        o_hist_ref[:] = jnp.zeros_like(o_hist_ref)
+
+    # scan half: the shared MXU block scan + SMEM carry (scan.py)
+    scanned, total = scan_block(x_ref[:])
+    o_scan_ref[:] = scanned + carry_ref[0]
+    carry_ref[0] = carry_ref[0] + total
+
+    # histogram half on the SAME resident block, via the shared
+    # accumulation helpers (one formula per path, two consumers):
+    # MXU nibble counts into the (128, 128) joint matrix, or the VPU
+    # one-hot compare into (1, nbins). Per-block counts stay exact
+    # (bm*128 < 2^24 in f32 / int32 sums); blocks merge in int32.
+    if impl == "mxu":
+        o_hist_ref[:] += _hist.hist_mxu_block(x_ref).astype(jnp.int32)
+    else:
+        o_hist_ref[:] += _hist.hist_vpu_block(
+            x_ref, nbins, chunk, acc_dtype
+        ).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("impl", "nbins", "acc_name", "block_rows",
+                     "interpret"),
+)
+def _fused_2d(x2, impl, nbins, acc_name, block_rows, interpret=False):
+    acc_dtype = jnp.float32 if acc_name == "f32" else jnp.int8
+    chunk = _hist._pick_chunk(nbins, acc_dtype)
+    hist_shape = (128, 128) if impl == "mxu" else (1, nbins)
+    grid = (x2.shape[0] // block_rows,)
+    return pl.pallas_call(
+        functools.partial(_fused_kernel, impl, nbins, chunk, acc_dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+            jax.ShapeDtypeStruct(hist_shape, jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (block_rows, LANES), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (block_rows, LANES), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                hist_shape, lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ),
+        scratch_shapes=[pltpu.SMEM((1,), x2.dtype)],
+        interpret=interpret,
+    )(x2)
+
+
+def scan_histogram(x, nbins: int, interpret: bool | None = None):
+    """(inclusive_scan(x), histogram(x, nbins)) for int32 values —
+    the combined benchmark pass. The `fuse` knob resolves through the
+    tuning subsystem (env TPK_SCANHIST_FUSE > tuned cache > shipped
+    default "off"): "off" dispatches the two standalone kernels,
+    "on" runs the fused single-pass kernel (one HBM read of x). The
+    fused histogram half honors histogram's own impl/acc knobs with
+    the same defaults and fail-loud validation as the standalone
+    kernel."""
+    if interpret is None:
+        interpret = default_interpret()
+    nbins = int(nbins)
+    params = resolve(
+        TUNABLES, shape=(int(x.size), nbins), dtype="int32"
+    )
+    x = x.reshape(-1).astype(jnp.int32)
+    if params["fuse"] == "off":
+        return (
+            inclusive_scan(x, interpret=interpret),
+            _hist.histogram(x, nbins, interpret=interpret),
+        )
+    n = x.size
+    if n == 0:
+        # mirror histogram's empty-input guard: a zero-step grid would
+        # never run the init step
+        return jnp.zeros((0,), jnp.int32), jnp.zeros((nbins,), jnp.int32)
+    hparams = _hist.resolve(
+        _hist.TUNABLES, shape=(n, nbins), dtype="int32"
+    )
+    impl = _hist.resolve_impl(hparams["impl"], nbins)
+    acc_name = hparams["acc"]
+    rows = max(cdiv(n, LANES), 1)
+    if impl == "mxu":
+        # the nibble groups walk 8·_MXU_T = 128 rows per step
+        step = 8 * _hist._MXU_T
+        bm = min(_hist._MXU_BM, max(step, (rows // step) * step))
+    else:
+        # bm must be a chunk multiple (the in-kernel VPU loop), so no
+        # trailing rows are dropped
+        chunk = _hist._pick_chunk(
+            nbins, jnp.float32 if acc_name == "f32" else jnp.int8
+        )
+        bm = max(chunk, (_BLOCK_ROWS // chunk) * chunk)
+        if rows < bm:  # small problems: one chunk-aligned block
+            bm = max(chunk, (rows // chunk) * chunk)
+    padded = cdiv(rows, bm) * bm * LANES
+    if padded != n:
+        x = jnp.pad(x, (0, padded - n))  # zeros: scan-neutral
+    s, h = _fused_2d(
+        x.reshape(-1, LANES), impl, nbins, acc_name, bm,
+        interpret=interpret,
+    )
+    if impl == "mxu":
+        h = _hist.joint_to_hist(h, nbins)
+    else:
+        h = h.reshape(-1)
+    pad_elems = padded - n
+    if pad_elems:
+        # the zero padding counted into bin 0; take it back out
+        h = h.at[0].add(jnp.int32(-pad_elems))
+    return s.reshape(-1)[:n], h
+
+
+def scan_histogram_reference(x, nbins: int):
+    """jnp oracle pair (mirrors the serial-C running sum + counts)."""
+    from tpukernels.kernels.histogram import histogram_reference
+    from tpukernels.kernels.scan import inclusive_scan_reference
+
+    x = x.reshape(-1).astype(jnp.int32)
+    return inclusive_scan_reference(x), histogram_reference(x, nbins)

@@ -42,28 +42,39 @@ from tpukernels.utils import cdiv, default_interpret
 
 
 def _vmem_bytes(params, shape=None):
-    """Analytic double-buffered VMEM need of a (bm, bn, bk) tile
-    PREFERENCE — the 32 MiB arithmetic the old tools/sgemm_tune.py
-    documented in prose, now the search space's feasibility filter.
+    """Analytic VMEM need of a (bm, bn, bk) tile PREFERENCE — the
+    32 MiB arithmetic the old tools/sgemm_tune.py documented in prose,
+    now the search space's feasibility filter. The per-block byte
+    components are the SHARED arithmetic in
+    ``tuning/roofline.py.sgemm_bytes_per_block`` (the roofline's HBM
+    byte count derives from the same helper — one formula, two
+    consumers).
 
     Model (bf16_3x, the config of record): the K-streamed A and B
-    hi/lo bf16 block pairs are pipeline double-buffered (x2); the C
-    and out f32 blocks revisit per (i, j) and count once, as does the
-    f32 accumulator scratch:
+    hi/lo bf16 block pairs are multiple-buffered — x2 on the default
+    BlockSpec-pipelined path (Pallas double-buffers; ``depth`` 1) and
+    x``depth`` on the manual ping-pong DMA path (depth 2/3) — while
+    the C/out f32 blocks and the f32 accumulator scratch count once:
 
-        8*bm*bk  (A hi+lo, buffered) + 8*bk*bn  (B hi+lo, buffered)
-        + 12*bm*bn  (C + out + acc)
+        buf*(4*bm*bk + 4*bk*bn)  (A + B hi/lo pairs, buffered)
+        + 12*bm*bn               (C + out + acc)
 
-    Control (256, 2048, 1024) = 24 MiB inside the 32 MiB budget;
-    bn=2048 with bk=2048 puts B alone at 32 MiB — the combination the
-    old tuner grid documented as infeasible. Deliberately SHAPE-BLIND
-    (`shape` ignored): _pick_block clamps preferences per dim at call
-    time, so a clamped candidate is merely redundant in a sweep, never
-    wrong — while shape-aware arithmetic at the 1024^3 config of
-    record would clamp everything feasible and stop pruning the
-    combos that matter at larger N."""
-    bm, bn, bk = params["bm"], params["bn"], params["bk"]
-    return 8 * bm * bk + 8 * bk * bn + 12 * bm * bn
+    Control (256, 2048, 1024, depth 1) = 24 MiB inside the 32 MiB
+    budget; bn=2048 with bk=2048 puts the B pair alone at 32 MiB — the
+    combination the old tuner grid documented as infeasible (and
+    depth=3 at the control blocks lands at ~34.6 MiB, so triple
+    buffering only probes with the smaller tiles). Deliberately
+    SHAPE-BLIND (`shape` ignored): _pick_block clamps preferences per
+    dim at call time, so a clamped candidate is merely redundant in a
+    sweep, never wrong — while shape-aware arithmetic at the 1024^3
+    config of record would clamp everything feasible and stop pruning
+    the combos that matter at larger N."""
+    from tpukernels.tuning.roofline import sgemm_bytes_per_block
+
+    blk = sgemm_bytes_per_block(params["bm"], params["bn"], params["bk"])
+    depth = params.get("depth", 1)
+    buf = 2 if depth == 1 else depth  # BlockSpec path double-buffers
+    return buf * (blk["a"] + blk["b"]) + blk["c"] + blk["acc"]
 
 
 # Declarative search space (docs/TUNING.md): sweep values carry the
@@ -72,6 +83,15 @@ def _vmem_bytes(params, shape=None):
 # turnarounds at looser VMEM pressure, bn 1024 halves B residency to
 # make room for the bk/bm probes; defaults-first ordering makes the
 # control row the sweep's first candidate and --quick's base.
+#
+# Widened beyond block sizes (ISSUE 6): `depth` selects the pipeline —
+# 1 = the BlockSpec-auto-pipelined path of record (measured 60.8
+# TFLOPS), 2/3 = the manual ping-pong VMEM-slab + DMA-overlap variant
+# (_sgemm_pipelined_call) the autotuner can now search; `order` picks
+# the grid iteration order — "ij" streams B blocks per i-row (wide-bn
+# default), "ji" streams A blocks per j-column (the reload trade
+# flips when m >> n). Both ride the AOT cache key via the tunable env
+# fingerprint, so each variant compiles and caches as its own program.
 TUNABLES = SearchSpace(
     kernel="sgemm",
     metric="sgemm_gflops",
@@ -85,6 +105,10 @@ TUNABLES = SearchSpace(
                 values=(2048, 1024)),
         Tunable("bk", env="TPK_SGEMM_BK", default=1024,
                 values=(1024, 512, 2048)),
+        Tunable("depth", env="TPK_SGEMM_DEPTH", default=1,
+                values=(1, 2, 3)),
+        Tunable("order", env="TPK_SGEMM_ORDER", default="ij",
+                values=("ij", "ji"), choice=True),
     ),
     vmem_budget_bytes=32 * 1024 * 1024,
     vmem_bytes=_vmem_bytes,
@@ -174,25 +198,170 @@ def _sgemm_kernel(mode, alpha_ref, beta_ref, *refs):
         o_ref[:] = alpha_ref[0, 0] * acc_ref[:] + beta_ref[0, 0] * c_ref[:]
 
 
+def _sgemm_pipelined_kernel(
+    mode, nk, bm, bn, bk, depth, order, alpha_ref, beta_ref, *refs
+):
+    """Manual ping-pong pipeline over the K stream (depth >= 2).
+
+    The streamed A/B operands live in HBM (``pl.ANY``); each grid step
+    owns one (i, j) output tile and walks its nk K-blocks through
+    ``depth`` VMEM slab slots with explicit async copies — the DMA for
+    block kk+depth-1 is in flight while block kk feeds the MXU, the
+    slab/sem machinery the stencil blocked kernels already half-use,
+    generalized to a ring. Slot-reuse safety: the start targeting slot
+    (kk-1) % depth is issued only after iteration kk-1's accumulator
+    STORE, so the overwrite is ordered behind the last read of that
+    slot.
+
+    refs layout (python-unrolled, all indices static):
+      streamed HBM operands (ah, al, bh, bl) or (a, b)
+      c_ref, o_ref                        (VMEM blocks via BlockSpec)
+      one (depth, ...) VMEM slab per streamed operand
+      acc scratch (bm, bn) f32
+      one DMA((depth,)) semaphore array per streamed operand
+    """
+    n_ops = 4 if mode == "split3" else 2
+    hbm = refs[:n_ops]
+    c_ref, o_ref = refs[n_ops], refs[n_ops + 1]
+    slabs = refs[n_ops + 2:n_ops + 2 + n_ops]
+    acc_ref = refs[n_ops + 2 + n_ops]
+    sems = refs[n_ops + 3 + n_ops:]
+    if order == "ji":
+        j, i = pl.program_id(0), pl.program_id(1)
+    else:
+        i, j = pl.program_id(0), pl.program_id(1)
+
+    def dma(idx, kk):
+        slot = kk % depth
+        if idx < n_ops // 2:  # A-like: (m, k) operand
+            src = hbm[idx].at[pl.ds(i * bm, bm), pl.ds(kk * bk, bk)]
+        else:  # B-like: (k, n) operand
+            src = hbm[idx].at[pl.ds(kk * bk, bk), pl.ds(j * bn, bn)]
+        return pltpu.make_async_copy(
+            src, slabs[idx].at[slot], sems[idx].at[slot]
+        )
+
+    dot = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
+    for kk in range(min(depth - 1, nk)):  # prologue: fill the ring
+        for idx in range(n_ops):
+            dma(idx, kk).start()
+    for kk in range(nk):  # static unroll (nk is small at these tiles)
+        nxt = kk + depth - 1
+        if nxt < nk and nxt >= depth - 1:
+            for idx in range(n_ops):
+                dma(idx, nxt).start()
+        for idx in range(n_ops):
+            dma(idx, kk).wait()
+        slot = kk % depth
+        if mode == "split3":
+            ah, al, bh, bl = (s[slot] for s in slabs)
+            update = dot(ah, bh) + dot(ah, bl) + dot(al, bh)
+        else:
+            a, b = (s[slot] for s in slabs)
+            update = dot(a, b, precision=mode)
+        if kk == 0:
+            acc_ref[:] = update
+        else:
+            acc_ref[:] += update
+    o_ref[:] = alpha_ref[0, 0] * acc_ref[:] + beta_ref[0, 0] * c_ref[:]
+
+
+def _sgemm_pipelined_call(
+    alpha, beta, operands, c, bm, bn, bk, depth, order, mode, interpret
+):
+    """pallas_call wrapper for the manual K-pipeline: grid over (i, j)
+    only (K walks inside the kernel), streamed operands in pl.ANY,
+    C/out as ordinary VMEM blocks."""
+    m = c.shape[0]
+    n = c.shape[1]
+    k = operands[0].shape[1]
+    nk = cdiv(k, bk)
+    gm, gn = cdiv(m, bm), cdiv(n, bn)
+    if order == "ji":
+        grid = (gn, gm)
+        c_map = lambda j, i: (i, j)  # noqa: E731
+    else:
+        grid = (gm, gn)
+        c_map = lambda i, j: (i, j)  # noqa: E731
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    c_spec = pl.BlockSpec((bm, bn), c_map, memory_space=pltpu.VMEM)
+    n_ops = len(operands)
+    slab_shapes = [
+        pltpu.VMEM(
+            (depth, bm, bk) if idx < n_ops // 2 else (depth, bk, bn),
+            operands[idx].dtype,
+        )
+        for idx in range(n_ops)
+    ]
+    return pl.pallas_call(
+        functools.partial(
+            _sgemm_pipelined_kernel, mode, nk, bm, bn, bk, depth, order
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        grid=grid,
+        in_specs=[smem, smem] + [any_spec] * n_ops + [c_spec],
+        out_specs=c_spec,
+        scratch_shapes=slab_shapes
+        + [pltpu.VMEM((bm, bn), jnp.float32)]
+        + [pltpu.SemaphoreType.DMA((depth,)) for _ in range(n_ops)],
+        compiler_params=CompilerParams(
+            # manual DMAs + ring-slot reuse assume sequential steps
+            dimension_semantics=("arbitrary", "arbitrary"),
+            # depth slabs of the A/B pairs + C/out/acc: the TUNABLES
+            # vmem model prunes candidates past 32 MiB; 64 leaves
+            # Mosaic headroom for spills without the unrolled-slab
+            # compile blowup docs/PERF.md warns about
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=4 * (m * k + k * n + 2 * m * n),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(alpha, beta, *operands, c)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("bm", "bn", "bk", "precision", "interpret")
+    jax.jit,
+    static_argnames=("bm", "bn", "bk", "depth", "order", "precision",
+                     "interpret"),
 )
 def _sgemm_padded(
-    alpha, beta, a, b, c, bm, bn, bk, precision="high", interpret=False
+    alpha, beta, a, b, c, bm, bn, bk, depth=1, order="ij",
+    precision="high", interpret=False,
 ):
     m, k = a.shape
     _, n = b.shape
-    grid = (cdiv(m, bm), cdiv(n, bn), cdiv(k, bk))
+    if precision == "high":
+        a_hi, a_lo = _split_bf16(a)
+        b_hi, b_lo = _split_bf16(b)
+        operands, mode = (a_hi, a_lo, b_hi, b_lo), "split3"
+    else:
+        operands, mode = (a, b), precision
+    if depth > 1:
+        return _sgemm_pipelined_call(
+            alpha, beta, operands, c, bm, bn, bk, depth, order, mode,
+            interpret,
+        )
+    # depth 1: the BlockSpec-auto-pipelined path of record. `order`
+    # permutes the two parallel grid dims (and with them which operand
+    # re-streams): "ij" walks j fastest per i-row, "ji" the transpose.
+    if order == "ji":
+        grid = (cdiv(n, bn), cdiv(m, bm), cdiv(k, bk))
+        a_map = lambda j, i, kk: (i, kk)  # noqa: E731
+        b_map = lambda j, i, kk: (kk, j)  # noqa: E731
+        c_map = lambda j, i, kk: (i, j)  # noqa: E731
+    else:
+        grid = (cdiv(m, bm), cdiv(n, bn), cdiv(k, bk))
+        a_map = lambda i, j, kk: (i, kk)  # noqa: E731
+        b_map = lambda i, j, kk: (kk, j)  # noqa: E731
+        c_map = lambda i, j, kk: (i, j)  # noqa: E731
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
-    a_spec = pl.BlockSpec(
-        (bm, bk), lambda i, j, kk: (i, kk), memory_space=pltpu.VMEM
-    )
-    b_spec = pl.BlockSpec(
-        (bk, bn), lambda i, j, kk: (kk, j), memory_space=pltpu.VMEM
-    )
-    c_spec = pl.BlockSpec(
-        (bm, bn), lambda i, j, kk: (i, j), memory_space=pltpu.VMEM
-    )
+    a_spec = pl.BlockSpec((bm, bk), a_map, memory_space=pltpu.VMEM)
+    b_spec = pl.BlockSpec((bk, bn), b_map, memory_space=pltpu.VMEM)
+    c_spec = pl.BlockSpec((bm, bn), c_map, memory_space=pltpu.VMEM)
     common = dict(
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
         grid=grid,
@@ -216,19 +385,17 @@ def _sgemm_padded(
         ),
         interpret=interpret,
     )
-    if precision == "high":
-        a_hi, a_lo = _split_bf16(a)
-        b_hi, b_lo = _split_bf16(b)
+    if mode == "split3":
         return pl.pallas_call(
             functools.partial(_sgemm_kernel, "split3"),
             in_specs=[smem, smem, a_spec, a_spec, b_spec, b_spec, c_spec],
             **common,
-        )(alpha, beta, a_hi, a_lo, b_hi, b_lo, c)
+        )(alpha, beta, *operands, c)
     return pl.pallas_call(
-        functools.partial(_sgemm_kernel, precision),
+        functools.partial(_sgemm_kernel, mode),
         in_specs=[smem, smem, a_spec, b_spec, c_spec],
         **common,
-    )(alpha, beta, a, b, c)
+    )(alpha, beta, *operands, c)
 
 
 def sgemm(
@@ -267,14 +434,17 @@ def sgemm(
     # hi+lo pair would blow the 32 MiB VMEM budget. Small bm keeps
     # A+C+acc in the remaining headroom.
     #
-    # Tile PREFERENCES resolve through the tuning subsystem (env
-    # TPK_SGEMM_{BM,BN,BK} > tuned cache entry for this
-    # shape/dtype/device > the TUNABLES defaults above); alignment
-    # and padding safety stay with _pick_block either way.
+    # Tile PREFERENCES and pipeline knobs resolve through the tuning
+    # subsystem (env TPK_SGEMM_{BM,BN,BK,DEPTH,ORDER} > tuned cache
+    # entry for this shape/dtype/device > the TUNABLES defaults
+    # above); alignment and padding safety stay with _pick_block
+    # either way.
     prefs = resolve(TUNABLES, shape=(m, k, n), dtype=a.dtype.name)
     bm = _pick_block(m, prefs["bm"], 8)
     bn = _pick_block(n, prefs["bn"], 128)
     bk = _pick_block(k, prefs["bk"], 128)
+    depth = max(1, prefs["depth"])
+    order = prefs["order"]
     pm, pn, pk = (cdiv(m, bm) * bm, cdiv(n, bn) * bn, cdiv(k, bk) * bk)
     if (pm, pk) != (m, k):
         a = jnp.pad(a, ((0, pm - m), (0, pk - k)))
@@ -286,6 +456,7 @@ def sgemm(
     beta2 = jnp.asarray(beta, jnp.float32).reshape(1, 1)
     out = _sgemm_padded(
         alpha2, beta2, a, b, c, bm, bn, bk,
+        depth=depth, order=order,
         precision=precision, interpret=interpret,
     )
     return out[:m, :n]

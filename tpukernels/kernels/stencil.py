@@ -55,14 +55,20 @@ from tpukernels.utils import cdiv, default_interpret
 from tpukernels.utils.shapes import LANES
 
 # Declarative search spaces (docs/TUNING.md): the temporal-blocking
-# depth k (sweeps fused per HBM pass) is the one knob worth sweeping —
+# depth k (sweeps fused per HBM pass) is the 2D knob worth sweeping —
 # docs/PERF.md records k>8 as VPU-bound (parked, docs/NEXT.md item 4),
-# so the sweep stays within the ghost-band bound. Slab geometry
-# (bm/bz) self-adapts to the VMEM budget in the pickers below and is
-# deliberately NOT a tunable: an env-forced slab that ignores the
-# budget arithmetic would fail remote compile, not run slower. No
-# vmem model for the same reason — every candidate is feasible by
-# construction.
+# so the sweep stays within the ghost-band bound; the hand-rolled 2D
+# ping-pong was built and REJECTED by measurement (107 vs 130
+# Gcells/s, docs/PERF.md), so 2D gets no pipeline knob. 3D adds
+# `depth` (ISSUE 6): 1 = today's copy-wait-compute slab, 2/3 = the
+# ring-buffered slab prefetch (_jacobi3d_blocked_kernel) overlapping
+# block zi+1's DMA with block zi's sweeps — the z-axis geometry has
+# no out_specs pipelining to lose, unlike the rejected 2D rewrite.
+# Slab geometry (bm/bz) self-adapts to the VMEM budget in the pickers
+# below and is deliberately NOT a tunable: an env-forced slab that
+# ignores the budget arithmetic would fail remote compile, not run
+# slower. No vmem model for the same reason — every candidate is
+# feasible by construction (_pick_bz divides the budget by depth).
 TUNABLES = (
     SearchSpace(
         kernel="stencil2d",
@@ -84,6 +90,8 @@ TUNABLES = (
         tunables=(
             Tunable("k", env="TPK_STENCIL_K", default=8,
                     values=(8, 6, 4, 2)),
+            Tunable("depth", env="TPK_STENCIL_DEPTH", default=1,
+                    values=(1, 2, 3)),
         ),
     ),
 )
@@ -103,16 +111,17 @@ def _pick_bm(wp: int) -> int:
     return max(8, min(512, bm // 8 * 8))
 
 
-def _pick_bz(hp: int, wp: int, k: int = 1) -> int:
-    """z-planes per 3D block: slab (bz+2k) + two out blocks of bz
-    planes inside a 32 MiB budget. Thin slabs lose most of their
+def _pick_bz(hp: int, wp: int, k: int = 1, depth: int = 1) -> int:
+    """z-planes per 3D block: ``depth`` slabs of (bz+2k) planes + two
+    out blocks of bz planes inside a 32 MiB budget — at depth 1
+    exactly the old (total - 2k) // 3. Thin slabs lose most of their
     planes to ghost recompute (at 16 MiB / 384² the ghost fraction
     was 57% and measured 65 Gcells/s vs 83.6 at 32 MiB); 40+ MiB fails
     remote compile with VMEM exhaustion, and very large unrolled
     slabs (tried up to ~96 MiB) sent Mosaic compile times through
     the roof."""
     total_planes = (32 * 1024 * 1024) // (4 * hp * wp)
-    bz = (total_planes - 2 * k) // 3
+    bz = (total_planes - 2 * k * depth) // (2 + depth)
     return max(1, min(32, bz))
 
 
@@ -322,7 +331,9 @@ def _jacobi3d_small_kernel(d, h, w, x_ref, o_ref):
     o_ref[:] = jnp.where(_mask3d(0, dp, hp, wp, d, h, w, 0), out, x)
 
 
-def _jacobi3d_blocked_kernel(d, h, w, bz, g, k, x_hbm, o_ref, slab, sem):
+def _jacobi3d_blocked_kernel(
+    d, h, w, bz, g, k, depth, x_hbm, o_ref, slab, sem
+):
     # Temporal blocking in z: the HBM array carries a FIXED ghost depth
     # g (set by the wrapper's padding) while k <= g sweeps run per pass
     # — the remainder pass (k = iters % g) reuses the same geometry
@@ -330,14 +341,41 @@ def _jacobi3d_blocked_kernel(d, h, w, bz, g, k, x_hbm, o_ref, slab, sem):
     # sweep count. Same containment argument as the 2D kernel: the h/w
     # extents are fully in-slab, so only z edges go stale, one plane
     # inward per sweep, bounded by g.
+    #
+    # Pipelining (depth >= 2, TPK_STENCIL_DEPTH): the slab is a ring
+    # of `depth` slots persisting across the sequential grid — step 0
+    # fills slots for blocks 0..depth-2, every step starts block
+    # zi+depth-1's DMA before waiting on its own, so the next slab
+    # streams in while this one sweeps. Slot-reuse safety: the start
+    # issued at step zi targets slot (zi-1) % depth, whose last reader
+    # (step zi-1) already committed its o_ref store — grid steps are
+    # sequential on TPU. depth == 1 degenerates to start-then-wait in
+    # the same step, byte-identical to the unpipelined original.
     zi = pl.program_id(0)
+    nblk = pl.num_programs(0)
     planes = bz + 2 * g
-    hp, wp = slab.shape[1], slab.shape[2]
-    copy = pltpu.make_async_copy(x_hbm.at[pl.ds(zi * bz, planes)], slab, sem)
-    copy.start()
-    copy.wait()
+    hp, wp = slab.shape[2], slab.shape[3]
+
+    def dma(b, slot):
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(b * bz, planes)], slab.at[slot], sem.at[slot]
+        )
+
+    if depth > 1:
+        @pl.when(zi == 0)
+        def _prologue():
+            for b in range(min(depth - 1, nblk)):
+                dma(b, b % depth).start()
+    nxt = zi + depth - 1
+
+    @pl.when(nxt < nblk)
+    def _prefetch():
+        dma(nxt, nxt % depth).start()
+
+    slot = zi % depth
+    dma(zi, slot).wait()
     mask = _mask3d(zi * bz, planes, hp, wp, d, h, w, g)
-    cur = slab[:]
+    cur = slab[slot]
     for _ in range(k):  # static unroll
         zm = jnp.concatenate([cur[:1], cur[:-1]], axis=0)
         zp = jnp.concatenate([cur[1:], cur[-1:]], axis=0)
@@ -357,13 +395,15 @@ def _sweep3d_small(x, d, h, w, interpret):
     )(x)
 
 
-def _sweep3d_blocked(x, d, h, w, bz, g, k, interpret):
+def _sweep3d_blocked(x, d, h, w, bz, g, k, depth, interpret):
     # x: (Dp + 2g, hp, wp) with g ghost planes at each end; runs k <= g
-    # fused sweeps per HBM pass
+    # fused sweeps per HBM pass through a `depth`-slot slab ring
     dp2, hp, wp = x.shape
     nblk = (dp2 - 2 * g) // bz
     out = pl.pallas_call(
-        functools.partial(_jacobi3d_blocked_kernel, d, h, w, bz, g, k),
+        functools.partial(
+            _jacobi3d_blocked_kernel, d, h, w, bz, g, k, depth
+        ),
         out_shape=jax.ShapeDtypeStruct((dp2 - 2 * g, hp, wp), x.dtype),
         grid=(nblk,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
@@ -371,8 +411,8 @@ def _sweep3d_blocked(x, d, h, w, bz, g, k, interpret):
             (bz, hp, wp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((bz + 2 * g, hp, wp), x.dtype),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.VMEM((depth, bz + 2 * g, hp, wp), x.dtype),
+            pltpu.SemaphoreType.DMA((depth,)),
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
@@ -381,38 +421,54 @@ def _sweep3d_blocked(x, d, h, w, bz, g, k, interpret):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("d", "h", "w", "iters", "bz", "k", "interpret")
+    jax.jit,
+    static_argnames=("d", "h", "w", "iters", "bz", "k", "depth",
+                     "interpret"),
 )
-def _jacobi3d_jit(x, d, h, w, iters, bz, k, interpret):
+def _jacobi3d_jit(x, d, h, w, iters, bz, k, depth, interpret):
     if bz:
         passes, rem = divmod(iters, k)
         x = jax.lax.fori_loop(
             0,
             passes,
-            lambda _, v: _sweep3d_blocked(v, d, h, w, bz, k, k, interpret),
+            lambda _, v: _sweep3d_blocked(
+                v, d, h, w, bz, k, k, depth, interpret
+            ),
             x,
         )
         if rem:
-            x = _sweep3d_blocked(x, d, h, w, bz, k, rem, interpret)
+            x = _sweep3d_blocked(x, d, h, w, bz, k, rem, depth, interpret)
         return x
     sweep = lambda v: _sweep3d_small(v, d, h, w, interpret)  # noqa: E731
     return jax.lax.fori_loop(0, iters, lambda _, v: sweep(v), x)
 
 
 def jacobi3d(
-    x, iters: int, interpret: bool | None = None, k: int | None = None
+    x,
+    iters: int,
+    interpret: bool | None = None,
+    k: int | None = None,
+    depth: int | None = None,
 ):
     """Run `iters` Jacobi 7-point sweeps on (D, H, W) float32.
 
     `k` is the temporal-blocking depth (sweeps fused per HBM pass) for
     the blocked path; default 8, resolved via the tuning subsystem
-    (env TPK_STENCIL_K > tuned cache > default)."""
+    (env TPK_STENCIL_K > tuned cache > default). `depth` is the slab
+    pipeline depth — 1 (default) is the copy-wait-compute path of
+    record, 2/3 ring-buffer the slab so the next block's DMA overlaps
+    this block's sweeps (TPK_STENCIL_DEPTH; _pick_bz shrinks bz to
+    keep depth slabs inside the same VMEM budget)."""
     if interpret is None:
         interpret = default_interpret()
     d, h, w = x.shape
+    params = resolve(TUNABLES[1], shape=(d, h, w), dtype=x.dtype.name)
     if k is None:
-        k = resolve(TUNABLES[1], shape=(d, h, w), dtype=x.dtype.name)["k"]
+        k = params["k"]
+    if depth is None:
+        depth = params["depth"]
     k = max(1, min(k, 8))
+    depth = max(1, int(depth))
     wp = max(cdiv(w, LANES) * LANES, LANES)
     hp8 = cdiv(h, 8) * 8
     # joint (k, bz) pick: wide planes shrink bz toward its floor of 1,
@@ -423,7 +479,7 @@ def jacobi3d(
     # assumed the larger k (a 2 MiB plane at k=8 would collapse to
     # bz=1/k=1 when bz=4/k=2 fits).
     for kk in range(k, 0, -1):
-        bz = _pick_bz(hp8, wp, kk)
+        bz = _pick_bz(hp8, wp, kk, depth)
         if bz >= kk:  # always true by kk=1 (_pick_bz floors at 1)
             k = kk
             break
@@ -444,13 +500,14 @@ def jacobi3d(
         # tuple the kernel never materializes would let a postmortem
         # misattribute an unblocked-path hang to slab geometry.
         if blocked:
-            slab_mib = (bz + 2 * k) * hp8 * wp * 4 / 2**20
-            geom = f"slab=({bz + 2 * k},{hp8},{wp}) {slab_mib:.1f} MiB"
+            slab_mib = depth * (bz + 2 * k) * hp8 * wp * 4 / 2**20
+            geom = (f"slab=({depth}x{bz + 2 * k},{hp8},{wp}) "
+                    f"{slab_mib:.1f} MiB")
         else:
             geom = "slab=none"
         print(
             f"# jacobi3d: d={d} h={h} w={w} blocked={blocked} bz={bz} "
-            f"k={k} {geom} "
+            f"k={k} depth={depth} {geom} "
             f"vmem_limit={_COMPILER_PARAMS.vmem_limit_bytes // 2**20} MiB",
             file=sys.stderr,
             flush=True,
@@ -466,7 +523,7 @@ def jacobi3d(
         else x
     )
     out = _jacobi3d_jit(
-        x, d, h, w, int(iters), bz if blocked else 0, k, interpret
+        x, d, h, w, int(iters), bz if blocked else 0, k, depth, interpret
     )
     if blocked:
         out = out[k : k + d]

@@ -38,6 +38,15 @@ Verdicts per metric (:func:`analyze`):
   the same 1% epsilon the ceiling logic uses.
 - ``no_data`` — no valid measurement anywhere in the series (all
   nulls / tunnel-down / invalidated). Retryable, never a failure.
+- ``below_roofline`` — the metric is trend-``ok`` (nothing regressed,
+  nothing impossible) but its newest valid value sits under
+  ``TPK_ROOFLINE_MIN_FRAC`` (default 0.5) of the analytic roofline
+  peak for its config of record (``tuning/roofline.py``). A NON-GATING
+  headroom signal: ``tools/obs_report.py --check`` keeps rc 0, and the
+  verdict can only ever replace ``ok`` — never ``no_data``,
+  ``regression`` or ``impossible`` (test-proven). Metrics whose config
+  of record legitimately beats the HBM roofline (the VMEM-resident
+  saxpy artifact) are reported but never verdict-ed.
 - ``ok`` — otherwise.
 
 The bands mirror bench.py's constants — ``CEILING_EPS`` must equal
@@ -52,6 +61,10 @@ import glob
 import json
 import os
 import re
+
+# stdlib-only at import, like this module — the analytic per-kernel
+# roofline models the below_roofline verdict judges against
+from tpukernels.tuning import roofline
 
 CEILING_EPS = 0.01   # == bench._CEILING_EPS (test-enforced mirror)
 REGRESSION_TOL = 0.15  # == bench._REGRESSION_TOL (ditto; the hard gate)
@@ -280,8 +293,49 @@ def analyze(series, baseline=None, eps=CEILING_EPS) -> dict:
                     f"gate fails below {1.0 - REGRESSION_TOL:.2f}x)"
                 )
             info["verdict"] = "regression" if regressed else "ok"
+            if info["verdict"] == "ok":
+                # the roofline check runs ONLY on an ok verdict: a
+                # regression/impossible finding is strictly more
+                # actionable, and a no_data metric has no value to
+                # judge — below_roofline can never mask or replace
+                # either (test-enforced)
+                roof = _roofline_check(metric, latest)
+                if roof is not None:
+                    info["roofline"] = roof
+                    if roof["below"]:
+                        info["verdict"] = "below_roofline"
+                        flags.append(
+                            f"BELOW ROOFLINE: latest {latest} is "
+                            f"{roof['frac']:.1%} of the analytic "
+                            f"{roof['bound']}-bound peak "
+                            f"{roof['peak']:,.0f} on "
+                            f"{roof['device_kind']} (threshold "
+                            f"{roof['min_frac']:.0%}, "
+                            "TPK_ROOFLINE_MIN_FRAC; non-gating "
+                            "headroom signal)"
+                        )
         verdicts[metric] = info
     return verdicts
+
+
+def _roofline_check(metric, latest):
+    """{peak, frac, bound, device_kind, min_frac, below} for a metric
+    with an analytic roofline model, else None. ``below`` is False for
+    documented artifact configs (VMEM-resident saxpy) no matter the
+    fraction."""
+    if metric not in roofline.MODELS:
+        return None
+    p = roofline.peak(metric)
+    frac = latest / p["peak"]
+    mf = roofline.min_frac()
+    return {
+        "peak": p["peak"],
+        "frac": frac,
+        "bound": p["bound"],
+        "device_kind": p["device_kind"],
+        "min_frac": mf,
+        "below": (not p["artifact"]) and frac < mf,
+    }
 
 
 def analyze_repo(root, eps=CEILING_EPS) -> dict:

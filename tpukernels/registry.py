@@ -47,6 +47,16 @@ _TUNABLES: Dict[str, "_tuning_space.SearchSpace"] = {}
 _IMPORT_ERRORS: Dict[str, BaseException] = {}  # kernel -> why it's absent
 _POPULATED = False
 
+# Derived registry entries ride a BASE kernel's tuning/roofline
+# surface instead of declaring their own: scan_exclusive is a
+# one-element shift of scan's output, so it tunes through scan's
+# TUNABLES and shares scan's roofline model. The registry completeness
+# lint (tests/test_registry_contract.py) resolves through this table —
+# every registered kernel must carry the full contract (TUNABLES, an
+# aot.BENCH_CONFIGS avatar, a roofline entry) either directly or via
+# its base, so a new kernel can't silently skip one.
+DERIVED_KERNELS = {"scan_exclusive": "scan"}
+
 
 def lookup(name: str) -> Callable:
     _populate()
@@ -186,12 +196,15 @@ def _populate():
     def _load_scan_hist():
         import tpukernels.kernels.scan as _scan
         import tpukernels.kernels.histogram as _histogram
+        import tpukernels.kernels.scan_histogram as _scan_histogram
 
         _REGISTRY["scan"] = _scan.inclusive_scan
         _REGISTRY["scan_exclusive"] = _scan.exclusive_scan
         _REGISTRY["histogram"] = _histogram.histogram
+        _REGISTRY["scan_histogram"] = _scan_histogram.scan_histogram
         _spaces(_scan)
         _spaces(_histogram)
+        _spaces(_scan_histogram)
 
     def _load_nbody():
         import tpukernels.kernels.nbody as _nbody
@@ -207,6 +220,9 @@ def _populate():
     with _trace.span("registry/populate"):
         _group(("vector_add", "sgemm"), _load_core, required=True)
         _group(("stencil2d", "stencil3d"), _load_stencil)
-        _group(("scan", "scan_exclusive", "histogram"), _load_scan_hist)
+        _group(
+            ("scan", "scan_exclusive", "histogram", "scan_histogram"),
+            _load_scan_hist,
+        )
         _group(("nbody",), _load_nbody)
     _POPULATED = True

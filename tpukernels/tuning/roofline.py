@@ -1,0 +1,344 @@
+"""Analytic per-kernel roofline models (docs/PERF.md §rooflines).
+
+The suite's only validated on-chip capture (stencil2d 131,799
+Mcells/s, 1.014x baseline) says the kernels are near-*baseline*; this
+module is how the repo knows whether they are near-*hardware*. For
+each bench metric it states, as plain arithmetic over the config of
+record, (a) the FLOPs one metric pass executes, (b) the minimum HBM
+bytes it must move, and (c) which machine peak binds — so the analytic
+peak metric value is
+
+    peak = work / max(flops / compute_peak, bytes / hbm_bw)
+
+and every committed capture gets a machine-checked "% of roofline"
+instead of an unexamined "ok". ``obs/trend.py`` turns a fraction under
+:func:`min_frac` (``TPK_ROOFLINE_MIN_FRAC``, default 0.5) into the
+NON-GATING ``below_roofline`` verdict; ``tools/obs_report.py
+--roofline`` renders the table. The byte formulas are pinned against
+hand-computed values per BASELINE.json config by
+``tests/test_roofline.py``.
+
+Peaks are per canonical ``device_kind`` (the tuning cache's spelling:
+lowered, spaces -> underscores). The evidence device of record is the
+v5-lite row — BASELINE.json's medians were measured there — and a
+documented CPU fallback row exists so reports and tests run on any
+host; an unknown TPU kind assumes the v5-lite row (flagged in
+``basis``), anything else falls back to CPU. The fallback rows are
+order-of-magnitude placeholders for plumbing, never evidence.
+
+Stdlib-only at import time, like the rest of ``tpukernels.tuning`` —
+``obs/trend.py`` (also stdlib-only) imports this module directly.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable
+
+from tpukernels.resilience import journal
+
+DEFAULT_MIN_FRAC = 0.5  # below this fraction of roofline -> verdict
+
+# Machine peaks per canonical device_kind. The v5-lite numbers are the
+# measured/derived figures docs/PERF.md §hardware-model records: MXU
+# 184 TFLOPS measured single-pass bf16 (fp32 multiplicands emulate at
+# 1/passes of that), VPU 8x128 lanes x ~4 ops/cycle x 0.94 GHz, HBM
+# ~819 GB/s.
+PEAKS = {
+    "tpu_v5_lite": {
+        "mxu_flops": 184e12,
+        "mxu_passes_f32": 3,  # bf16_3x: the fp32-operand config of record
+        "vpu_ops": 3.9e12,
+        "hbm_gb_s": 819.0,
+    },
+    # Documented CPU FALLBACK row: single-core order-of-magnitude
+    # numbers (one AVX-512 port stream) so the roofline plumbing runs
+    # on any host. Chip conclusions never come from this row.
+    "cpu": {
+        "mxu_flops": 100e9,
+        "mxu_passes_f32": 1,
+        "vpu_ops": 50e9,
+        "hbm_gb_s": 20.0,
+    },
+}
+
+# The BASELINE.json "measured" medians were captured on v5 lite; trend
+# verdicts judge committed evidence against this row unless
+# TPK_ROOFLINE_DEVICE overrides it.
+EVIDENCE_KIND = "tpu_v5_lite"
+
+
+def resolve_kind(kind=None):
+    """(peaks_row, requested_kind, basis) for a device kind string.
+
+    basis: "exact" (a PEAKS row), "assumed-<row>" (unknown TPU kind
+    borrowing the evidence row), or "cpu-fallback"."""
+    if kind is None:
+        kind = os.environ.get("TPK_ROOFLINE_DEVICE") or EVIDENCE_KIND
+    if kind in PEAKS:
+        return PEAKS[kind], kind, "exact"
+    if kind.startswith("tpu"):
+        return PEAKS[EVIDENCE_KIND], kind, f"assumed-{EVIDENCE_KIND}"
+    return PEAKS["cpu"], kind, "cpu-fallback"
+
+
+def min_frac() -> float:
+    """The below_roofline threshold (TPK_ROOFLINE_MIN_FRAC, default
+    0.5). Fail-loud parse, the TPK_* knob contract."""
+    raw = os.environ.get("TPK_ROOFLINE_MIN_FRAC")
+    if raw is None:
+        return DEFAULT_MIN_FRAC
+    try:
+        val = float(raw)
+    except ValueError:
+        val = -1.0
+    if not 0.0 <= val <= 1.0:
+        raise ValueError(
+            f"TPK_ROOFLINE_MIN_FRAC={raw!r}: expected a float in [0, 1]"
+        )
+    return val
+
+
+# ------------------------------------------------------------------ #
+# shared sgemm byte arithmetic (the tuning VMEM model's other half)  #
+# ------------------------------------------------------------------ #
+
+def sgemm_bytes_per_block(bm: int, bn: int, bk: int) -> dict:
+    """Byte components of one (bm, bn, bk) sgemm tile — the ONE place
+    this arithmetic lives (ISSUE 6 satellite: the 32 MiB VMEM model in
+    kernels/sgemm.py and the roofline byte count below both derive
+    from it instead of hand-maintaining twin formulas).
+
+    ``a``/``b`` are the K-streamed operand blocks as bf16 hi+lo pairs
+    (4 B/elem — the same traffic as the f32 originals); ``c`` is the
+    f32 C-in + out pair; ``acc`` the f32 accumulator scratch
+    (VMEM-only, never HBM traffic)."""
+    return {
+        "a": 4 * bm * bk,
+        "b": 4 * bk * bn,
+        "c": 8 * bm * bn,
+        "acc": 4 * bm * bn,
+    }
+
+
+def sgemm_hbm_bytes(m: int, n: int, k: int) -> float:
+    """Minimum HBM traffic of the tiled kernel = one streamed visit
+    per distinct block (Pallas re-fetches a block only when its index
+    changes), i.e. the whole problem as one "block" of the shared
+    arithmetic with the VMEM-only accumulator excluded:
+    4·(m·k + k·n + 2·m·n) — the same figure kernels/sgemm.py reports
+    to XLA via ``pl.CostEstimate``."""
+    blk = sgemm_bytes_per_block(m, n, k)
+    return float(blk["a"] + blk["b"] + blk["c"])
+
+
+# ------------------------------------------------------------------ #
+# per-metric models                                                  #
+# ------------------------------------------------------------------ #
+
+@dataclass(frozen=True)
+class RooflineModel:
+    """Analytic roofline for one bench metric at its config of record.
+
+    ``flops``/``hbm_bytes``/``work`` are functions of the config tuple
+    (so tests can pin them at other shapes): total FLOPs of one metric
+    pass, its minimum HBM byte traffic, and the metric numerator
+    (metric value = work / seconds). ``compute`` names the peak the
+    compute leg runs against: "mxu_f32" (bf16-split fp32 operands,
+    peak/passes), "mxu" (single-pass bf16), or "vpu". ``artifact``
+    marks metrics whose config of record legitimately beats the HBM
+    roofline (VMEM-resident working sets) — reported, never
+    verdict-ed."""
+
+    metric: str
+    kernel: str
+    config: tuple
+    flops: Callable
+    hbm_bytes: Callable
+    work: Callable
+    compute: str = "vpu"
+    artifact: bool = False
+    note: str = ""
+
+
+MODELS = {
+    # 2·m·n·k metric FLOPs execute as 3 MXU passes (bf16_3x), so the
+    # compute peak is 184/3 ≈ 61.3 TFLOPS — the analytic peak lands on
+    # the BASELINE.json ceiling (61,333 GFLOPS) by construction.
+    "sgemm_gflops": RooflineModel(
+        metric="sgemm_gflops",
+        kernel="sgemm",
+        config=(1024, 1024, 1024),
+        flops=lambda m, n, k: 2.0 * m * n * k,
+        hbm_bytes=lambda m, n, k: sgemm_hbm_bytes(m, n, k),
+        work=lambda m, n, k: 2.0 * m * n * k / 1e9,
+        compute="mxu_f32",
+        note="bf16_3x: metric FLOPs run as 3 MXU passes",
+    ),
+    # SAXPY config of record (N=2^20, 8 MiB working set) stays
+    # VMEM-resident across bench reps — measured values beat the HBM
+    # roofline BY DESIGN (docs/PERF.md); the streaming metric below is
+    # the honest sustained-HBM number.
+    "saxpy_gb_s": RooflineModel(
+        metric="saxpy_gb_s",
+        kernel="vector_add",
+        config=(1 << 20,),
+        flops=lambda n: 2.0 * n,
+        hbm_bytes=lambda n: 12.0 * n,  # read x, read y, write y
+        work=lambda n: 12.0 * n / 1e9,  # the metric IS GB moved
+        compute="vpu",
+        artifact=True,
+        note="VMEM-resident config of record; exceeds the HBM "
+             "roofline by design (see saxpy_stream_gb_s)",
+    ),
+    "saxpy_stream_gb_s": RooflineModel(
+        metric="saxpy_stream_gb_s",
+        kernel="vector_add",
+        config=(1 << 26,),
+        flops=lambda n: 2.0 * n,
+        hbm_bytes=lambda n: 12.0 * n,
+        work=lambda n: 12.0 * n / 1e9,
+        compute="vpu",
+    ),
+    # Per cell per sweep: 4 neighbor adds + 1 scale + 1 boundary
+    # select = 6 VPU ops (docs/PERF.md's "~6 ops/cell/sweep"); HBM
+    # traffic is 8 B/cell/sweep divided by the temporal-blocking depth
+    # of record (k=8).
+    "stencil2d_mcells_s": RooflineModel(
+        metric="stencil2d_mcells_s",
+        kernel="stencil2d",
+        config=(4096, 4096),
+        flops=lambda h, w: 6.0 * h * w,
+        hbm_bytes=lambda h, w: 8.0 * h * w / 8.0,
+        work=lambda h, w: h * w / 1e6,
+        compute="vpu",
+        note="per sweep at temporal depth k=8",
+    ),
+    # 3D: 5 neighbor adds + 1 scale + 1 select + ~1 mask-iota
+    # amortized = 8 VPU ops/cell/sweep; same 8 B/cell/sweep over k=8.
+    "stencil3d_mcells_s": RooflineModel(
+        metric="stencil3d_mcells_s",
+        kernel="stencil3d",
+        config=(384, 384, 384),
+        flops=lambda d, h, w: 8.0 * d * h * w,
+        hbm_bytes=lambda d, h, w: 8.0 * d * h * w / 8.0,
+        work=lambda d, h, w: d * h * w / 1e6,
+        compute="vpu",
+        note="per sweep at temporal depth k=8",
+    ),
+    # 20 fp32 ops per pairwise interaction (3 sub, 3 mul+2 add for r2,
+    # eps add, rsqrt ~7, 3 FMA accumulates counted as 2 each ≈ 20 —
+    # the factor that makes the 192.7 Ginter/s median 3.85 TFLOPS,
+    # docs/PERF.md). The j-set is VMEM-resident; HBM is 7 f32 arrays.
+    "nbody_ginter_s": RooflineModel(
+        metric="nbody_ginter_s",
+        kernel="nbody",
+        config=(65536,),
+        flops=lambda n: 20.0 * n * n,
+        hbm_bytes=lambda n: 28.0 * n,
+        work=lambda n: n * n / 1e9,
+        compute="vpu",
+    ),
+    # Unfused pass of record: scan reads + writes its array, histogram
+    # re-reads it = 12 B/elem (the fused TPK_SCANHIST_FUSE=on variant
+    # cuts it to 8). MXU work (~1.5k flops/elem across the triangular
+    # scan + nibble-count matmuls) is far off the binding leg.
+    "scan_hist_melem_s": RooflineModel(
+        metric="scan_hist_melem_s",
+        kernel="scan",
+        config=(1 << 22, 256),
+        flops=lambda n, nbins: 1536.0 * n,
+        hbm_bytes=lambda n, nbins: 12.0 * n,
+        work=lambda n, nbins: n / 1e6,
+        compute="mxu",
+        note="bandwidth-bound; fused single-pass variant "
+             "(TPK_SCANHIST_FUSE=on) cuts traffic to 8 B/elem",
+    ),
+}
+
+# Registry kernel -> metric model, the completeness-lint surface
+# (tests/test_registry_contract.py): every registry kernel must map
+# here (directly, or through registry.DERIVED_KERNELS for derived
+# entries like scan_exclusive).
+KERNEL_METRIC = {
+    "vector_add": "saxpy_gb_s",
+    "sgemm": "sgemm_gflops",
+    "stencil2d": "stencil2d_mcells_s",
+    "stencil3d": "stencil3d_mcells_s",
+    "scan": "scan_hist_melem_s",
+    "histogram": "scan_hist_melem_s",
+    "scan_histogram": "scan_hist_melem_s",
+    "nbody": "nbody_ginter_s",
+}
+
+
+def _compute_peak(row: dict, compute: str) -> float:
+    if compute == "mxu_f32":
+        return row["mxu_flops"] / row["mxu_passes_f32"]
+    if compute == "mxu":
+        return row["mxu_flops"]
+    return row["vpu_ops"]
+
+
+def peak(metric: str, kind=None) -> dict:
+    """The analytic roofline for one metric on one device kind:
+    ``{metric, kernel, peak, bound, flops, hbm_bytes, device_kind,
+    basis, artifact, note}`` — ``peak`` in the metric's own units,
+    ``bound`` naming the binding leg."""
+    model = MODELS[metric]
+    row, rkind, basis = resolve_kind(kind)
+    f = model.flops(*model.config)
+    b = model.hbm_bytes(*model.config)
+    w = model.work(*model.config)
+    t_compute = f / _compute_peak(row, model.compute)
+    t_bw = b / (row["hbm_gb_s"] * 1e9)
+    t = max(t_compute, t_bw)
+    return {
+        "metric": metric,
+        "kernel": model.kernel,
+        "peak": w / t,
+        "bound": "compute" if t_compute >= t_bw else "bandwidth",
+        "flops": f,
+        "hbm_bytes": b,
+        "device_kind": rkind,
+        "basis": basis,
+        "artifact": model.artifact,
+        "note": model.note,
+    }
+
+
+def report_rows(verdicts=None, kind=None) -> list:
+    """One row per modeled metric, achieved values joined in from a
+    ``trend.analyze`` verdict table (``achieved``/``frac`` are None
+    for no-data metrics). Emits one ``roofline_computed`` journal
+    event so a traced session records which peaks the table was judged
+    against — the evidence twin of the rendered table."""
+    rows = []
+    for metric in sorted(MODELS):
+        p = peak(metric, kind)
+        v = (verdicts or {}).get(metric) or {}
+        achieved = v.get("latest")
+        frac = achieved / p["peak"] if achieved else None
+        rows.append({
+            **p,
+            "achieved": achieved,
+            "frac": frac,
+            "verdict": v.get("verdict"),
+        })
+    journal.emit(
+        "roofline_computed",
+        device_kind=rows[0]["device_kind"] if rows else None,
+        basis=rows[0]["basis"] if rows else None,
+        min_frac=min_frac(),
+        metrics={
+            r["metric"]: {
+                "peak": round(r["peak"], 1),
+                "frac": round(r["frac"], 3) if r["frac"] is not None
+                else None,
+                "bound": r["bound"],
+            }
+            for r in rows
+        },
+    )
+    return rows
