@@ -46,7 +46,7 @@ ensure_compilation_cache()
 # three timeout mechanisms live in watchdog, fault injection in
 # faults, and every wedge/partial/invalidation decision is journaled
 # as a structured health event (docs/RESILIENCE.md).
-from tpukernels.resilience import faults, journal, watchdog
+from tpukernels.resilience import faults, integrity, journal, watchdog
 
 # Observability layer (also stdlib-only, docs/OBSERVABILITY.md):
 # spans are a shared no-op unless TPK_TRACE is set (clean-path stdout
@@ -193,7 +193,24 @@ def _slope(make_fn, r_small, r_big, samples=5):
                     f"{label}.R{r}", f, a,
                     sources=_slope_sources(label),
                 )
-            np.asarray(f(*a))  # warm (and, without AOT, compile+warm)
+            warm = np.asarray(f(*a))  # warm (without AOT: compile+warm)
+            # Output-integrity guard on the warm result, strictly
+            # outside the timed octets (docs/RESILIENCE.md §output
+            # integrity): every loop body reduces through a sum, so a
+            # NaN anywhere in R iterations poisons this scalar — the
+            # tier-1 tripwire covers the whole loop program — and the
+            # first-trust canary cross-checks this metric's kernel
+            # against its jnp oracle before a window is spent timing
+            # it. Never raises; a failure is journaled + quarantined.
+            integrity.guard(
+                "bench", _SLOPE_GUARD_KERNELS.get(label), warm,
+                # on failure, also invalidate THIS metric's compiled
+                # loop programs (manifest keys "bench_<fn>.R<n>@...")
+                # — they are the executables that produced the
+                # corrupt warm result, not just the kernel's dispatch
+                # entries
+                invalidate_prefixes=(label + ".",),
+            )
             calls[r] = (f, a)
     faults.phase_fault("compile")
     if os.environ.get("TPK_BENCH_PREWARM") == "1":
@@ -604,6 +621,21 @@ _METRIC_KERNEL_SOURCES = {
     "nbody_ginter_s": ("tpukernels/kernels/nbody.py",),
     "stencil2d_mcells_s": ("tpukernels/kernels/stencil.py",),
     "stencil3d_mcells_s": ("tpukernels/kernels/stencil.py",),
+}
+
+
+# bench loop-program label -> the registry kernel its integrity
+# canary validates (_slope's guard; docs/RESILIENCE.md §output
+# integrity). Unknown labels (tests driving _slope with their own
+# make_fn) guard with kernel=None: tier-1 tripwire only.
+_SLOPE_GUARD_KERNELS = {
+    "bench_sgemm": "sgemm",
+    "bench_saxpy": "vector_add",
+    "bench_saxpy_stream": "vector_add",
+    "bench_stencil": "stencil2d",
+    "bench_stencil3d": "stencil3d",
+    "bench_scan_hist": "scan_histogram",
+    "bench_nbody": "nbody",
 }
 
 

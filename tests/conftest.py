@@ -180,6 +180,28 @@ if "TPK_AOT_CACHE_DIR" not in os.environ:
     except OSError:
         pass
 
+# Isolate the output-integrity guard's state (docs/RESILIENCE.md
+# §output integrity) the same way: chaos tests inject corruption and
+# the guard QUARANTINES the offending (kernel, config) persistently —
+# test noise must never land in the repo's real envelope manifest or
+# quarantine ledger (a suite run must also start unquarantined, or one
+# chaos test's leftovers would escalate every later dispatch to
+# every-call canary checks). Tests that assert guard state point
+# TPK_INTEGRITY_DIR at their own tmp path.
+if "TPK_INTEGRITY_DIR" not in os.environ:
+    import tempfile
+
+    _integrity_dir = os.path.join(
+        tempfile.gettempdir(), f"tpk_integrity_test_{os.getuid()}"
+    )
+    os.makedirs(_integrity_dir, exist_ok=True)
+    os.environ["TPK_INTEGRITY_DIR"] = _integrity_dir
+    for _f in ("integrity.json", "integrity_quarantine.json"):
+        try:  # a previous suite run's state must not steer this one
+            os.unlink(os.path.join(_integrity_dir, _f))
+        except OSError:
+            pass
+
 # Persist compiled executables across suite runs (the shared knob —
 # tpukernels/_cachedir.py; `import tpukernels` is deliberately
 # jax-free, so this respects the env-before-jax-import rule below).

@@ -1,0 +1,462 @@
+"""CPU chaos suite for the output-integrity guard
+(docs/RESILIENCE.md §output integrity; tpukernels/resilience/
+integrity.py).
+
+Drives the ``corrupt_output`` / ``nan_output`` fault keys through
+every guarded dispatch path — ``registry.dispatch``, bench's measure
+phases, ``capi.run_from_c``, autotune sweep candidates, and the AOT
+prewarm first-trust smoke — asserting the acceptance contract:
+detected within one call, journaled as ``output_integrity_failed``,
+the (kernel, config) quarantined with its AOT executable memo
+invalidated, NEVER a crash of the surrounding run, and clean-path
+bench stdout byte-identical whether the guard is on-and-passing or
+``TPK_INTEGRITY=0``. Plus the envelope manifest's tuning-cache-style
+staleness rules and the clean canary-vs-oracle proof for every
+registered kernel.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from test_distributed import _scrubbed_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _events(journal_path, kind=None):
+    if not os.path.exists(journal_path):
+        return []
+    recs = []
+    with open(journal_path) as f:
+        for line in f:
+            if line.strip():
+                recs.append(json.loads(line))
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    return recs
+
+
+class _Rig:
+    """Isolated guard state: tmp integrity dir + journal, fault-plan
+    control, always-restored module state."""
+
+    def __init__(self, monkeypatch, tmp_path):
+        from tpukernels.resilience import faults, integrity
+
+        self.faults = faults
+        self.integrity = integrity
+        self.dir = tmp_path / "integ"
+        self.dir.mkdir(exist_ok=True)
+        self.journal = tmp_path / "health.jsonl"
+        monkeypatch.setenv("TPK_INTEGRITY_DIR", str(self.dir))
+        monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(self.journal))
+        monkeypatch.delenv("TPK_INTEGRITY", raising=False)
+        monkeypatch.delenv("TPK_FAULT_PLAN", raising=False)
+        self._mp = monkeypatch
+        faults.reload_plan()
+        integrity.reset()
+
+    def set_plan(self, plan):
+        self._mp.setenv("TPK_FAULT_PLAN", json.dumps(plan))
+        self.faults.reload_plan()
+
+    def clear_plan(self):
+        self._mp.delenv("TPK_FAULT_PLAN", raising=False)
+        self.faults.reload_plan()
+
+    def events(self, kind=None):
+        return _events(self.journal, kind)
+
+
+@pytest.fixture
+def rig(monkeypatch, tmp_path):
+    r = _Rig(monkeypatch, tmp_path)
+    yield r
+    # module-level fault/guard state outlives monkeypatch's env restore
+    monkeypatch.delenv("TPK_FAULT_PLAN", raising=False)
+    r.faults.reload_plan()
+    r.integrity.reset()
+
+
+# ---------------------------------------------------------------- #
+# clean path: every kernel's canary matches its oracle              #
+# ---------------------------------------------------------------- #
+
+def test_all_canaries_match_oracles(rig):
+    """The guard's authority check, clean: every registry kernel's
+    canary run agrees with its jnp oracle within the documented
+    tolerance (exact for the int32 kernels). This is what makes a
+    guard failure evidence of corruption rather than flakiness."""
+    from tpukernels import registry
+
+    for name in registry.names():
+        assert rig.integrity.cross_check(name) is None, name
+    assert not rig.events("output_integrity_failed")
+
+
+def test_guard_disabled_is_single_check(rig, monkeypatch):
+    monkeypatch.setenv("TPK_INTEGRITY", "0")
+    rig.set_plan({"nan_output": {"kernel": "vector_add"}})
+    out = rig.integrity.guard(
+        "registry", "vector_add", np.ones(4, np.float32)
+    )
+    # off = untouched passthrough: no corruption applied, no events
+    assert np.all(np.isfinite(out))
+    assert not rig.events()
+
+
+# ---------------------------------------------------------------- #
+# guarded path 1: registry.dispatch                                  #
+# ---------------------------------------------------------------- #
+
+def test_registry_nan_detected_within_one_call(rig):
+    """Tier-1 tripwire: a NaN-corrupted dispatch result is detected on
+    THAT call, journaled, AOT-invalidated — and returned, not raised
+    (the surrounding run must survive)."""
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    rig.set_plan({"nan_output": {"kernel": "vector_add",
+                                 "site": "registry"}})
+    out = registry.dispatch(
+        "vector_add", jnp.float32(1.0),
+        jnp.asarray(np.ones(256, np.float32)),
+        jnp.asarray(np.ones(256, np.float32)),
+    )
+    assert not bool(jnp.isfinite(out).all())  # corrupted, returned
+    fails = rig.events("output_integrity_failed")
+    assert len(fails) == 1
+    assert fails[0]["kernel"] == "vector_add"
+    assert fails[0]["site"] == "registry"
+    assert fails[0]["tier"] == 1
+    assert rig.events("aot_invalidated")
+
+
+def test_registry_corrupt_detected_and_quarantined(rig):
+    """A FINITE corruption (tier 1 blind) is caught by the first-call
+    oracle canary; the second offense quarantines the (kernel, config)
+    persistently. scan is exact: one flipped element is proof."""
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    rig.set_plan({"corrupt_output": {"kernel": "scan",
+                                     "site": "registry"}})
+    x = jnp.asarray(np.arange(300, dtype=np.int32))
+    out1 = registry.dispatch("scan", x)           # detected: call 1
+    assert int(np.asarray(out1)[0]) != 0          # corrupt, returned
+    assert not rig.integrity.is_quarantined("scan")
+    registry.dispatch("scan", x)                  # offense 2
+    fails = rig.events("output_integrity_failed")
+    assert len(fails) == 2
+    assert all(f["tier"] in (2, 3) for f in fails)
+    quar = rig.events("output_integrity_quarantined")
+    assert len(quar) == 1 and quar[0]["kernel"] == "scan"
+    assert rig.integrity.is_quarantined("scan")
+    # persisted ledger, not process memory
+    ledger = json.load(open(rig.dir / "integrity_quarantine.json"))
+    assert any(k.startswith("scan|") for k in ledger["entries"])
+    # dropping the plan: the guard re-checks every call (suspect) and
+    # a clean result lifts the per-process escalation without crashing
+    rig.clear_plan()
+    self_clean = registry.dispatch("scan", x)
+    np.testing.assert_array_equal(
+        np.asarray(self_clean), np.cumsum(np.arange(300))
+    )
+
+
+def test_aot_memo_invalidated_on_failure(rig):
+    """The offending kernel's compiled-executable memo AND manifest
+    entries are dropped, so the next call recompiles instead of
+    re-trusting a suspect executable."""
+    import jax.numpy as jnp
+
+    from tpukernels import aot, registry
+
+    x = jnp.asarray(np.arange(300, dtype=np.int32))
+    registry.dispatch("scan", x)  # clean: memo + manifest populated
+    assert any(k[0] == "scan" for k in aot._EXEC_MEMO)
+    manifest = json.load(open(aot.manifest_path()))
+    assert any(k.startswith("scan|") for k in manifest["entries"])
+    rig.set_plan({"corrupt_output": {"kernel": "scan",
+                                     "site": "registry"}})
+    # fresh guard state: the corrupt call is a first-trust check again
+    rig.integrity.reset()
+    registry.dispatch("scan", x)
+    assert rig.events("output_integrity_failed")
+    assert not any(k[0] == "scan" for k in aot._EXEC_MEMO)
+    manifest = json.load(open(aot.manifest_path()))
+    assert not any(
+        k.startswith("scan|") for k in manifest.get("entries", {})
+    )
+
+
+# ---------------------------------------------------------------- #
+# guarded path 2: capi.run_from_c                                    #
+# ---------------------------------------------------------------- #
+
+def test_capi_corruption_detected_never_crashes(rig):
+    """The C driver's buffers are guarded after the adapter writes
+    them: a NaN in what C is about to read back is journaled at site
+    capi and the shim still returns rc 0 (errors are for real
+    failures)."""
+    from tpukernels import capi
+
+    rig.set_plan({"nan_output": {"kernel": "vector_add",
+                                 "site": "capi"}})
+    x = np.ones(256, np.float32)
+    y = np.ones(256, np.float32)
+    params = json.dumps(
+        {"alpha": 1.0,
+         "buffers": [{"shape": [256], "dtype": "f32"}] * 2}
+    )
+    rc = capi.run_from_c(
+        "vector_add", params, [x.ctypes.data, y.ctypes.data]
+    )
+    assert rc == 0
+    fails = rig.events("output_integrity_failed")
+    assert fails and fails[0]["site"] == "capi"
+    assert fails[0]["tier"] == 1
+    # the corruption landed in the driver-visible buffer (that is the
+    # thing being guarded)
+    assert not (np.isfinite(x).all() and np.isfinite(y).all())
+
+
+# ---------------------------------------------------------------- #
+# guarded path 3: bench measure phases (subprocess, real CLI)        #
+# ---------------------------------------------------------------- #
+
+def _bench_env(tmp_path, plan=None, **extra):
+    env = _scrubbed_env(fake_devices=None)
+    env["TPK_BENCH_SMOKE"] = "1"
+    env["TPK_HEALTH_JOURNAL"] = str(tmp_path / "health.jsonl")
+    integ = tmp_path / "integ"
+    integ.mkdir(exist_ok=True)
+    env["TPK_INTEGRITY_DIR"] = str(integ)
+    env.pop("TPK_FAULT_PLAN", None)
+    env.pop("TPK_INTEGRITY", None)
+    if plan is not None:
+        env["TPK_FAULT_PLAN"] = json.dumps(plan)
+    for k, v in extra.items():
+        env[k] = str(v)
+    return env
+
+
+def _run_bench(env, args=(), timeout=420):
+    return subprocess.run(
+        [sys.executable, "bench.py", *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO,
+    )
+
+
+def test_bench_measure_detects_corruption(tmp_path):
+    """A corrupt kernel under bench's measure phase is detected before
+    a window is spent timing it: the --one child still emits its JSON
+    (never a crash), the journal carries the failure at site bench,
+    and the second warm call's repeat offense quarantines the
+    config."""
+    plan = {"corrupt_output": {"kernel": "vector_add",
+                               "site": "bench"}}
+    env = _bench_env(tmp_path, plan)
+    proc = _run_bench(env, args=("--one", "saxpy_gb_s"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["name"] == "saxpy_gb_s"  # the run survived
+    assert "output-integrity FAILED" in proc.stderr
+    fails = _events(tmp_path / "health.jsonl",
+                    "output_integrity_failed")
+    assert fails and all(f["site"] == "bench" for f in fails)
+    assert all(f["kernel"] == "vector_add" for f in fails)
+    # both R variants' warm results are guarded -> repeat offense ->
+    # quarantined within the one child
+    assert _events(tmp_path / "health.jsonl",
+                   "output_integrity_quarantined")
+    # the executables that PRODUCED the corrupt warm results — the
+    # compiled loop programs, manifest keys bench_saxpy.R<n>@... —
+    # are invalidated too, not just the kernel's dispatch entries
+    invalidated = _events(tmp_path / "health.jsonl", "aot_invalidated")
+    dropped = [k for e in invalidated
+               for k in (e.get("manifest_dropped") or [])]
+    assert any(k.startswith("bench_saxpy.") for k in dropped), dropped
+
+
+def test_bench_nan_tripwire_covers_loop_program(tmp_path):
+    """nan_output at the bench site poisons the warm scalar itself —
+    tier 1 catches it with no oracle run at all."""
+    plan = {"nan_output": {"site": "bench"}}
+    env = _bench_env(tmp_path, plan)
+    proc = _run_bench(env, args=("--one", "saxpy_gb_s"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fails = _events(tmp_path / "health.jsonl",
+                    "output_integrity_failed")
+    assert fails and all(f["tier"] == 1 for f in fails)
+
+
+def test_clean_path_stdout_byte_identical(tmp_path):
+    """The acceptance proof: bench stdout is byte-identical with the
+    guard on-and-passing, tier-1-only, and fully off — the guard adds
+    checks, never output."""
+    outs = []
+    for integ in (None, None, "tripwire", "0"):
+        env = _bench_env(tmp_path)
+        if integ is not None:
+            env["TPK_INTEGRITY"] = integ
+        proc = _run_bench(env, args=("--one", "saxpy_gb_s"))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        outs.append(proc.stdout)
+    assert len(set(outs)) == 1
+
+
+# ---------------------------------------------------------------- #
+# guarded path 4: autotune sweep candidates                          #
+# ---------------------------------------------------------------- #
+
+def test_tuning_candidate_integrity_discards_value(tmp_path, monkeypatch):
+    """A corrupt candidate's measurement is garbage by definition: the
+    runner discards it (status "integrity"), nothing promotes, and the
+    child's guard quarantined the (kernel, candidate-config) in the
+    shared ledger under the candidate's OWN knob values."""
+    from tpukernels.tuning import runner
+
+    # the runner's own journal events (tuning_candidate) emit from
+    # THIS process; the children journal via base_env — same file
+    monkeypatch.setenv(
+        "TPK_HEALTH_JOURNAL", str(tmp_path / "health.jsonl")
+    )
+    env = _bench_env(
+        tmp_path,
+        {"corrupt_output": {"kernel": "vector_add", "site": "bench"}},
+    )
+    summary = runner.tune(
+        "vector_add", smoke=True, max_candidates=2, base_env=env,
+    )
+    rows = summary["rows"]
+    assert rows, summary
+    assert all(r["status"] == "integrity" for r in rows), rows
+    assert all(r["value"] is None for r in rows)
+    assert summary["promoted"] is None
+    cands = _events(tmp_path / "health.jsonl", "tuning_candidate")
+    assert cands and all(c["integrity_failed"] for c in cands)
+    ledger = json.load(
+        open(tmp_path / "integ" / "integrity_quarantine.json")
+    )
+    keys = list(ledger["entries"])
+    assert any(k.startswith("vector_add|") and "TPK_SAXPY_ROWS" in k
+               for k in keys), keys
+
+
+# ---------------------------------------------------------------- #
+# AOT first-trust smoke (prewarm path)                               #
+# ---------------------------------------------------------------- #
+
+def test_precompile_first_trust_smoke_check(rig):
+    """aot.precompile blesses a warm executable with no dispatch
+    following — the first-trust canary must run THERE, and a failure
+    invalidates what it was about to bless (never raises: prewarm
+    reports per kernel)."""
+    from tpukernels import registry
+
+    rig.set_plan({"corrupt_output": {"kernel": "scan", "site": "aot"}})
+    row = registry.precompile("scan")  # returns normally
+    assert row["kernel"] == "scan"
+    fails = rig.events("output_integrity_failed")
+    assert fails and fails[0]["site"] == "aot"
+    assert rig.events("aot_invalidated")
+
+
+# ---------------------------------------------------------------- #
+# envelope manifest: roundtrip, tier-2 checks, staleness             #
+# ---------------------------------------------------------------- #
+
+def test_envelope_roundtrip_and_tier2_detection(rig):
+    """A recorded envelope turns the exact kernels' deep check into
+    the bitwise tier-2 fingerprint compare — corruption is caught
+    against the PERSISTED oracle record, no oracle re-run."""
+    rig.integrity.record_envelope("scan")
+    assert rig.integrity.envelope("scan") is not None
+    ran, failure = rig.integrity.fingerprint_check("scan")
+    assert ran and failure is None  # clean kernel matches the oracle
+    rig.set_plan({"corrupt_output": {"kernel": "scan"}})
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    rig.integrity.reset()
+    registry.dispatch(
+        "scan", jnp.asarray(np.arange(64, dtype=np.int32))
+    )
+    fails = rig.events("output_integrity_failed")
+    assert fails and fails[-1]["tier"] == 2
+    assert "checksum" in fails[-1]["detail"]
+
+
+def test_envelope_staleness_rejected_loudly(rig, monkeypatch):
+    """The tuning-cache validation rules verbatim: a jax-version
+    mismatch dismisses the envelope with a journal event and stderr
+    note, and the guard degrades to the live oracle — never trusts a
+    stale record."""
+    rig.integrity.record_envelope("scan")
+    p = rig.integrity.manifest_path()
+    data = json.load(open(p))
+    for ent in data["entries"].values():
+        ent["jax"] = "0.0.0-stale"
+    with open(p, "w") as f:
+        json.dump(data, f)
+    assert rig.integrity.envelope("scan") is None
+    rej = rig.events("output_integrity_rejected")
+    assert rej and "0.0.0-stale" in rej[0]["reason"]
+    ran, _failure = rig.integrity.fingerprint_check("scan")
+    assert ran is False  # caller falls through to tier 3
+    assert rig.integrity.cross_check("scan") is None
+
+
+def test_record_all_covers_registry(rig):
+    from tpukernels import registry
+
+    rows = rig.integrity.record_all()
+    assert {r["kernel"] for r in rows} >= set(registry.names())
+    assert not [r for r in rows if "error" in r], rows
+    assert len(rig.events("output_integrity_envelope")) == len(rows)
+
+
+# ---------------------------------------------------------------- #
+# reports narrate the new evidence                                   #
+# ---------------------------------------------------------------- #
+
+def test_reports_narrate_integrity_events(rig, tmp_path):
+    import jax.numpy as jnp
+
+    from tpukernels import registry
+
+    rig.set_plan({"corrupt_output": {"kernel": "scan",
+                                     "site": "registry"}})
+    x = jnp.asarray(np.arange(128, dtype=np.int32))
+    registry.dispatch("scan", x)
+    registry.dispatch("scan", x)  # second offense -> quarantine
+    rep = subprocess.run(
+        [sys.executable, "tools/health_report.py", str(rig.journal)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    for needle in ("OUTPUT INTEGRITY FAILED", "QUARANTINED",
+                   "aot executables INVALIDATED",
+                   "output-integrity failure(s)"):
+        assert needle in rep.stdout, (needle, rep.stdout)
+    # obs_report --check gates rc 1 on the confirmed corruption —
+    # a wrong answer stops a queue exactly like a regression
+    empty_root = tmp_path / "emptyroot"
+    (empty_root / "docs" / "logs").mkdir(parents=True)
+    check = subprocess.run(
+        [sys.executable, "tools/obs_report.py", "--check",
+         "--root", str(empty_root), "--journal", str(rig.journal)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert check.returncode == 1, check.stdout + check.stderr
+    assert "output_integrity_failed" in check.stdout

@@ -410,6 +410,46 @@ def test_journal_kinds_lint_catches_undocumented(tmp_path):
     assert "phase2_Start" in r.stdout
 
 
+def test_env_knobs_lint_passes_on_this_repo():
+    """ISSUE 7 satellite, tier-1: every TPK_* knob referenced in
+    production code appears in the docs/KNOBS.md catalog table."""
+    r = _run_tool("env_knobs.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all documented" in r.stdout
+
+
+def test_env_knobs_lint_catches_undocumented(tmp_path):
+    root = tmp_path / "mini"
+    (root / "docs").mkdir(parents=True)
+    (root / "tools").mkdir()
+    (root / "bench.py").write_text(
+        'import os\nv = os.environ.get("TPK_BOGUS_KNOB")\n'
+        '"""prose mentioning TPK_NOT_A_REFERENCE must not count"""\n'
+    )
+    (root / "tools" / "x.sh").write_text(
+        'echo "${TPK_SHELL_KNOB:-}"\n'
+    )
+    (root / "docs" / "KNOBS.md").write_text(
+        "| `TPK_DOCUMENTED_ONLY` | - | - | stale row |\n"
+    )
+    r = _run_tool("env_knobs.py", "--root", str(root))
+    assert r.returncode == 1
+    assert "TPK_BOGUS_KNOB" in r.stdout
+    assert "bench.py:2" in r.stdout
+    assert "TPK_SHELL_KNOB" in r.stdout        # shell reads lint too
+    assert "TPK_NOT_A_REFERENCE" not in r.stdout  # docstring prose
+    assert "TPK_DOCUMENTED_ONLY" in r.stdout   # stale-row WARN
+    # documenting both clears it (the WARN alone never fails)
+    (root / "docs" / "KNOBS.md").write_text(
+        "| `TPK_BOGUS_KNOB` | - | - | x |\n"
+        "| `TPK_SHELL_KNOB` | - | - | x |\n"
+        "| `TPK_DOCUMENTED_ONLY` | - | - | stale row |\n"
+    )
+    r = _run_tool("env_knobs.py", "--root", str(root))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "WARN documented knob 'TPK_DOCUMENTED_ONLY'" in r.stdout
+
+
 # ---------------------------------------------------------------- #
 # satellites: probe_failed event, health_report breakdown           #
 # ---------------------------------------------------------------- #

@@ -2,11 +2,13 @@
 
 Every kernel in the registry must carry the full contract surface —
 TUNABLES, an ``aot.BENCH_CONFIGS`` avatar, a ``KERNEL_SOURCES`` row,
-and a roofline entry — either directly or through
-``registry.DERIVED_KERNELS`` (scan_exclusive rides scan's). A new
-kernel (the fused scan_histogram was the first customer) cannot
-silently skip tuning, prewarm, staleness tracking, or the roofline
-table.
+a roofline entry, and (ISSUE 7) an output-integrity oracle + canary
+fingerprint config — either directly or through
+``registry.DERIVED_KERNELS`` (scan_exclusive rides scan's tuning
+surface but carries its OWN oracle: its output contract differs). A
+new kernel (the fused scan_histogram was the first customer) cannot
+silently skip tuning, prewarm, staleness tracking, the roofline
+table, or the integrity guard.
 
 Also asserts the widened-TUNABLES acceptance contracts: the AOT
 executable-cache key is distinct per pipeline/fuse variant (the
@@ -18,6 +20,7 @@ import numpy as np
 import pytest
 
 from tpukernels import aot, registry
+from tpukernels.resilience import integrity
 from tpukernels.tuning import roofline
 
 
@@ -44,6 +47,25 @@ def test_registry_contract_complete():
         # mapping — one kernel, one metric of record
         if space.metric is not None:
             assert space.metric == metric, (name, space.metric, metric)
+        # output-integrity surface (docs/RESILIENCE.md §output
+        # integrity): DIRECT entries even for derived kernels —
+        # scan_exclusive's output contract is its own
+        assert name in integrity.ORACLES, (
+            f"{name} has no integrity oracle (its outputs would never "
+            "be cross-checked)"
+        )
+        assert name in integrity.CANARY_CONFIGS, (
+            f"{name} has no integrity canary config (no fingerprint "
+            "envelope, no first-trust smoke check)"
+        )
+        kind, rtol, atol = integrity.tolerance(name)
+        assert kind == "exact" or (rtol > 0 and atol > 0), (
+            name, kind, rtol, atol
+        )
+        # canary operands must actually build (a stale builder would
+        # otherwise surface only when a guard first fires)
+        assert integrity._build_args(name)
+        assert integrity.canary_key(name).startswith(name + "|")
 
 
 def test_derived_kernels_are_registered_and_tunable_through_base():

@@ -1,0 +1,160 @@
+"""Lint: every ``TPK_*`` env knob must be documented.
+
+Usage:
+    python tools/env_knobs.py          # rc 0 clean, rc 1 findings
+
+The knob population is past fifty and undocumented ones were
+accumulating: a knob that exists only in the code that reads it is an
+operator silently running with a default they cannot discover. This
+lint scans every ``TPK_*`` knob referenced in production code —
+``bench.py``, ``tests/conftest.py``, ``tpukernels/**``, ``tools/**``
+(Python via the AST: string constants that ARE a knob name, which
+skips docstring prose; shell via regex, including ``c/**``'s harness
+scripts) — and asserts each appears in the catalog table of
+docs/KNOBS.md. Runs in tier-1 via
+``tests/test_obs.py::test_env_knobs_lint`` (the journal-kind lint's
+sibling).
+
+Also warns (without failing) on documented-but-unreferenced knobs —
+usually a knob that was removed without its doc row.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DOC_REL = os.path.join("docs", "KNOBS.md")
+
+_KNOB_RE = re.compile(r"TPK_[A-Z0-9_]+")
+_DOC_KNOB_RE = re.compile(r"^\|\s*`(TPK_\w+)`", re.MULTILINE)
+
+
+def production_files(repo=_REPO):
+    """(python_files, shell_files) the lint scans. The lint's own file
+    is excluded (its docstring names knobs as prose)."""
+    py = [
+        os.path.join(repo, "bench.py"),
+        os.path.join(repo, "tests", "conftest.py"),
+    ]
+    for sub in ("tpukernels", "tools"):
+        py.extend(sorted(glob.glob(
+            os.path.join(repo, sub, "**", "*.py"), recursive=True
+        )))
+    sh = []
+    for sub in ("tools", "c"):
+        sh.extend(sorted(glob.glob(
+            os.path.join(repo, sub, "**", "*.sh"), recursive=True
+        )))
+    return (
+        [f for f in py if os.path.isfile(f)
+         and os.path.basename(f) != "env_knobs.py"],
+        [f for f in sh if os.path.isfile(f)],
+    )
+
+
+def referenced_knobs(repo=_REPO):
+    """{knob: [file:line, ...]} over production references, plus a
+    list of unparseable python files (reported, never silently
+    skipped)."""
+    knobs: dict = {}
+    unparseable = []
+    py, sh = production_files(repo)
+    for path in py:
+        rel = os.path.relpath(path, repo)
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError) as e:
+            unparseable.append(f"{rel}: {e}")
+            continue
+        for node in ast.walk(tree):
+            # exact-match string constants only: "TPK_FOO" is a knob
+            # reference (env read/write/declaration); a docstring
+            # mentioning knobs is a long string and never fullmatches
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _KNOB_RE.fullmatch(node.value)
+            ):
+                knobs.setdefault(node.value, []).append(
+                    f"{rel}:{node.lineno}"
+                )
+    for path in sh:
+        rel = os.path.relpath(path, repo)
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as e:
+            unparseable.append(f"{rel}: {e}")
+            continue
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in _KNOB_RE.finditer(line):
+                knobs.setdefault(m.group(0), []).append(f"{rel}:{i}")
+    return knobs, unparseable
+
+
+def documented_knobs(doc=None):
+    doc = doc or os.path.join(_REPO, _DOC_REL)
+    try:
+        with open(doc) as f:
+            return set(_DOC_KNOB_RE.findall(f.read()))
+    except OSError:
+        return set()
+
+
+def main(argv=None):
+    repo = _REPO
+    argv = sys.argv[1:] if argv is None else list(argv)
+    it = iter(argv)
+    for a in it:
+        if a == "--root":
+            try:
+                repo = next(it)
+            except StopIteration:
+                print("env_knobs: --root requires a value",
+                      file=sys.stderr)
+                return 2
+        else:
+            print(f"env_knobs: unknown argument {a!r}", file=sys.stderr)
+            return 2
+    knobs, unparseable = referenced_knobs(repo)
+    documented = documented_knobs(os.path.join(repo, _DOC_REL))
+    rc = 0
+    if not documented:
+        print(f"env_knobs: {_DOC_REL} has no knob catalog "
+              "(| `TPK_...` | rows) - nothing to lint against")
+        rc = 1
+    undocumented = {k: v for k, v in knobs.items() if k not in documented}
+    for knob in sorted(undocumented):
+        print(
+            f"env_knobs: knob {knob!r} is referenced but not in the "
+            f"{_DOC_REL} catalog:"
+        )
+        for where in undocumented[knob][:6]:
+            print(f"  {where}")
+        rc = 1
+    for msg in unparseable:
+        print(f"env_knobs: cannot scan {msg}")
+        rc = 1
+    unused = documented - set(knobs)
+    for knob in sorted(unused):
+        print(
+            f"env_knobs: WARN documented knob {knob!r} has no "
+            "production reference (stale doc row?)"
+        )
+    if rc == 0:
+        print(
+            f"env_knobs: OK - {len(knobs)} knobs across "
+            f"{sum(len(v) for v in knobs.values())} reference(s), all "
+            "documented"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -199,6 +199,35 @@ def _fmt(ev):
                 f"{len(mets)} metric(s) on {ev.get('device_kind')} "
                 f"({ev.get('basis')}, threshold {ev.get('min_frac')})"
                 + (f" - below: {','.join(below)}" if below else ""))
+    if kind == "output_integrity_failed":
+        return (f"{ts} [pid {pid}] OUTPUT INTEGRITY FAILED: "
+                f"{ev.get('kernel')} at {ev.get('site')} "
+                f"(tier {ev.get('tier')}: {ev.get('detail')})")
+    if kind == "output_integrity_quarantined":
+        return (f"{ts} [pid {pid}] output-integrity QUARANTINED "
+                f"{ev.get('kernel')} (config {ev.get('config')}) after "
+                f"{ev.get('failures')} failure(s) (threshold "
+                f"{ev.get('threshold')})")
+    if kind == "output_integrity_quarantined_repeat":
+        return (f"{ts} [pid {pid}] output-integrity repeat offense on "
+                f"already-quarantined {ev.get('kernel')} "
+                f"({ev.get('failures')} today)")
+    if kind == "output_integrity_envelope":
+        return (f"{ts} [pid {pid}] integrity envelope recorded for "
+                f"{ev.get('kernel')} ({ev.get('leaves')} leaf "
+                "fingerprint(s))")
+    if kind == "output_integrity_rejected":
+        return (f"{ts} [pid {pid}] integrity-envelope REJECTED "
+                f"{ev.get('key')}: {ev.get('reason')}")
+    if kind == "output_integrity_check_error":
+        return (f"{ts} [pid {pid}] integrity check ERRORED for "
+                f"{ev.get('kernel')} at {ev.get('site')}: "
+                f"{ev.get('error')} (result NOT judged)")
+    if kind == "aot_invalidated":
+        return (f"{ts} [pid {pid}] aot executables INVALIDATED for "
+                f"{ev.get('kernel')}: {ev.get('memo_dropped')} memo "
+                f"entr(ies), {len(ev.get('manifest_dropped') or [])} "
+                "manifest entr(ies)")
     if kind == "tuning_resolved":
         return (f"{ts} [pid {pid}] tuning resolved for "
                 f"{ev.get('kernel')}: {ev.get('params')} "
@@ -340,7 +369,9 @@ def summarize(events, bad=0) -> str:
         f"verdict: {wedges} wedge(s), {fires} watchdog fire(s), "
         f"{counts.get('partial_result', 0)} partial-result decision(s), "
         f"{counts.get('fault_injected', 0)} injected fault(s), "
-        f"{counts.get('step_quarantined', 0)} quarantined step(s)"
+        f"{counts.get('step_quarantined', 0)} quarantined step(s), "
+        f"{counts.get('output_integrity_failed', 0)} output-integrity "
+        "failure(s)"
     )
     return "\n".join(out)
 

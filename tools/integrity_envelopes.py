@@ -1,0 +1,123 @@
+"""Record / verify the output-integrity fingerprint envelopes.
+
+Usage:
+    python tools/integrity_envelopes.py --record [--kernels a,b]
+    python tools/integrity_envelopes.py --check  [--kernels a,b]
+    python tools/integrity_envelopes.py           # print the manifest
+
+``--record`` runs every kernel's jnp ORACLE at its canary config and
+persists the checksum/norm envelope into ``integrity.json``
+(docs/RESILIENCE.md §output integrity) — the tier-2 reference the
+dispatch-time guard and the AOT first-trust smoke check compare
+against. Envelopes are defined as the CPU oracle's fingerprints, so
+this tool pins ``JAX_PLATFORMS=cpu`` before jax loads (the
+supervisor's daily ``integrity_envelopes`` step additionally scrubs
+the axon env, which a sitecustomize-forced backend needs).
+
+``--check`` runs each kernel's canary through the REAL kernel path
+and compares against the recorded envelope (tier 2) — the manual
+"do I trust this checkout's kernels right now" smoke. rc 0 = all
+pass; rc 1 = a mismatch or a failed record; rc 2 = usage.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# the envelope authority is the CPU oracle: pin the backend BEFORE
+# anything imports jax (a pre-set JAX_PLATFORMS choice wins — the
+# operator may deliberately record TPU-side fingerprints for debug)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tpukernels.resilience import integrity  # noqa: E402
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    record = check = False
+    names = None
+    it = iter(argv)
+    for a in it:
+        if a == "--record":
+            record = True
+        elif a == "--check":
+            check = True
+        elif a == "--kernels":
+            try:
+                names = [n.strip() for n in next(it).split(",")
+                         if n.strip()]
+            except StopIteration:
+                print("integrity_envelopes: --kernels needs a value",
+                      file=sys.stderr)
+                return 2
+        else:
+            print(__doc__, file=sys.stderr)
+            print(f"integrity_envelopes: unknown argument {a!r}",
+                  file=sys.stderr)
+            return 2
+    if names:
+        unknown = [n for n in names if n not in integrity.CANARY_CONFIGS]
+        if unknown:
+            print(
+                f"integrity_envelopes: unknown kernel(s) {unknown}; "
+                f"known: {sorted(integrity.CANARY_CONFIGS)}",
+                file=sys.stderr,
+            )
+            return 2
+    if record and check:
+        print("integrity_envelopes: pick ONE of --record/--check",
+              file=sys.stderr)
+        return 2
+
+    if record:
+        print(f"recording oracle envelopes -> {integrity.manifest_path()}")
+        rows = integrity.record_all(names, echo=print)
+        failed = [r["kernel"] for r in rows if "error" in r]
+        print(
+            f"integrity envelopes: {len(rows) - len(failed)} recorded, "
+            f"{len(failed)} failed"
+            + (f" ({','.join(failed)})" if failed else "")
+        )
+        return 1 if failed else 0
+
+    if check:
+        rc = 0
+        for name in (names if names is not None
+                     else sorted(integrity.CANARY_CONFIGS)):
+            ran, failure = integrity.fingerprint_check(name)
+            if not ran:
+                print(f"  {name:<16} SKIP (no validated envelope - "
+                      "run --record first)")
+            elif failure:
+                print(f"  {name:<16} FAIL: {failure}")
+                rc = 1
+            else:
+                print(f"  {name:<16} ok")
+        print("integrity check:", "FAILED" if rc else "OK")
+        return rc
+
+    # default: render the manifest
+    data = integrity._read_json(integrity.manifest_path())
+    entries = data.get("entries") or {}
+    print(f"integrity envelope manifest: {integrity.manifest_path()} "
+          f"({len(entries)} entr(ies))")
+    for key in sorted(entries):
+        ent = entries[key]
+        print(f"  {key:<48} jax={ent.get('jax')} "
+              f"recorded_on={ent.get('recorded_on')} "
+              f"leaves={len(ent.get('fingerprints') or [])}")
+    quar = integrity.quarantined_entries()
+    if quar:
+        print(f"quarantined today ({len(quar)}):")
+        for key, ent in sorted(quar.items()):
+            print(f"  {key}: {ent.get('failures')} failure(s) - "
+                  f"{ent.get('last_detail')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

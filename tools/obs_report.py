@@ -25,7 +25,11 @@ Sections (docs/OBSERVABILITY.md):
 5. **AOT compile cache** — hit/miss traffic, compile walls on each,
    stale-entry rejections and prewarm outcomes from the ``aot_*`` /
    ``prewarm_*`` events (docs/PERF.md §compile discipline).
-6. **Metric snapshots** — the last ``metrics`` event per process:
+6. **Output integrity** — checks run / confirmed corruption events /
+   quarantined (kernel, config) entries from the
+   ``output_integrity_*`` events plus the persistent quarantine
+   ledger (docs/RESILIENCE.md §output integrity).
+7. **Metric snapshots** — the last ``metrics`` event per process:
    counters (probe retries, watchdog kills, tuning-cache traffic),
    gauges, latency histograms.
 
@@ -34,8 +38,11 @@ non-gating and keys a WARN off it):
     0 — every metric ``ok``, ``below_roofline`` or ``no_data``
         (nothing measurable went backwards; tunnel-down nulls are
         retryable, and below-roofline is a headroom signal, not a
-        failure);
-    1 — at least one ``regression`` or ``impossible`` verdict.
+        failure) AND the journal holds no confirmed
+        ``output_integrity_failed`` event;
+    1 — at least one ``regression`` or ``impossible`` verdict, or a
+        confirmed output-integrity corruption (a wrong answer is
+        worse than a slow one — it gates exactly like a regression).
 
 ``--check`` prints only the non-ok verdict lines (machine/CI mode;
 ``below_roofline`` lines print as non-gating information); the
@@ -210,6 +217,56 @@ def aot_section(events, out):
                    f"{e.get('total_wall_s')}s")
 
 
+def integrity_section(events, out):
+    """Output-integrity evidence (docs/RESILIENCE.md §output
+    integrity): guard traffic from the metrics snapshots, confirmed
+    corruption events, and today's quarantined (kernel, config)
+    ledger entries — the at-a-glance answer to "can the numbers this
+    session produced be trusted"."""
+    failed = [e for e in events
+              if e.get("kind") == "output_integrity_failed"]
+    quarantined = [e for e in events
+                   if e.get("kind") == "output_integrity_quarantined"]
+    checks = deep = errors = 0
+    last = {}
+    for e in events:
+        if e.get("kind") == "metrics":
+            last[e.get("pid")] = e
+    for e in last.values():
+        c = e.get("counters") or {}
+        checks += c.get("integrity.checks", 0)
+        deep += c.get("integrity.deep_checks", 0)
+        errors += c.get("integrity.check_errors", 0)
+    try:
+        from tpukernels.resilience import integrity as _integrity
+
+        ledger = _integrity.quarantined_entries()
+    except Exception:  # noqa: BLE001 — the report must still render
+        ledger = {}
+    if not (failed or quarantined or checks or ledger):
+        return
+    out.append("")
+    out.append(
+        f"== output integrity ({checks} check(s), {deep} canary "
+        f"check(s), {len(failed)} confirmed failure(s)) =="
+    )
+    for e in failed:
+        out.append(
+            f"  FAILED {e.get('kernel')} at {e.get('site')} "
+            f"(tier {e.get('tier')}): {e.get('detail')}"
+        )
+    if errors:
+        out.append(f"  {errors} check error(s) (results not judged - "
+                   "see output_integrity_check_error events)")
+    for key, ent in sorted(ledger.items()):
+        out.append(
+            f"  QUARANTINED {key}: {ent.get('failures')} failure(s) "
+            f"today - {ent.get('last_detail')}"
+        )
+    if not failed and not ledger:
+        out.append("  all checks passed")
+
+
 def metrics_section(events, out):
     snaps = [e for e in events if e.get("kind") == "metrics"]
     out.append("")
@@ -292,6 +349,20 @@ def main(argv=None):
             # informational, never part of the rc — a kernel at 20% of
             # roofline is headroom to earn, not a regression to gate on
             print(f"{name}: below_roofline (non-gating)")
+        # a CONFIRMED corruption gates like a regression: a wrong
+        # answer is strictly worse than a slow one, and the guard
+        # already refused to crash the run that detected it — this is
+        # where it stops a queue from going green
+        # (docs/RESILIENCE.md §output integrity)
+        events, _bad_lines = _journal.load_events(journal_paths)
+        corrupt = [e for e in events
+                   if e.get("kind") == "output_integrity_failed"]
+        for e in corrupt:
+            print(
+                f"output_integrity_failed: {e.get('kernel')} at "
+                f"{e.get('site')} (tier {e.get('tier')}): "
+                f"{e.get('detail')}"
+            )
         ok = sum(1 for v in verdicts.values() if v["verdict"] == "ok")
         nodata = sum(
             1 for v in verdicts.values() if v["verdict"] == "no_data"
@@ -299,9 +370,10 @@ def main(argv=None):
         print(
             f"obs_report --check: {len(bad)} failing, {ok} ok, "
             f"{len(below)} below-roofline (non-gating), "
-            f"{nodata} no-data (no-data is retryable, not a failure)"
+            f"{nodata} no-data (no-data is retryable, not a failure), "
+            f"{len(corrupt)} confirmed output-integrity failure(s)"
         )
-        return 1 if bad else 0
+        return 1 if bad or corrupt else 0
 
     if roofline_only:
         out = []
@@ -316,6 +388,7 @@ def main(argv=None):
     span_section(events, out)
     step_section(events, out)
     aot_section(events, out)
+    integrity_section(events, out)
     metrics_section(events, out)
     out.append("")
     if bad:

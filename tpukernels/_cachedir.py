@@ -77,3 +77,99 @@ def aot_manifest_path(env: dict | None = None) -> str:
             _REPO, ".jax_cache"
         )
     return os.path.join(d, "aot.json")
+
+
+def read_json_memoized(path: str, memo: dict) -> dict:
+    """Stat-memoized tolerant JSON reader — the read-side twin of
+    :func:`locked_json_update`, shared by the tuning cache, the AOT
+    manifest and the integrity guard's state files so the
+    memo/degradation rules cannot drift per module. ``memo`` is the
+    caller's own ``{path: (stat_key, parsed)}`` dict (per-module so
+    ``reset()``/test isolation stays local). Returns {} on
+    absent/corrupt/non-dict — unreadable state degrades to cold
+    behavior, never raises."""
+    import json
+
+    try:
+        st = os.stat(path)
+        stat_key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return {}
+    hit = memo.get(path)
+    if hit and hit[0] == stat_key:
+        return hit[1]
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    if not isinstance(data, dict):
+        data = {}
+    memo[path] = (stat_key, data)
+    return data
+
+
+def locked_json_update(path: str, mutate, load=None) -> dict:
+    """flock-serialized read-modify-write of one JSON state file —
+    THE locking discipline the tuning cache established (lock file +
+    fresh read under the lock + tmp-write + atomic replace), shared so
+    new state files (the AOT manifest edits, the integrity guard's
+    envelope/quarantine ledgers) cannot drift their own copy.
+
+    ``mutate(data)`` edits the parsed dict in place; ``load`` lets a
+    caller with a stat-memoized reader re-read under the lock (it must
+    return a plain dict, {} on absent/corrupt). Returns the written
+    dict. Stdlib-only, like everything in this module.
+    """
+    import fcntl
+    import json
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(f"{path}.lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if load is not None:
+            data = load(path)
+        else:
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                data = {}
+        if not isinstance(data, dict):
+            data = {}
+        mutate(data)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    return data
+
+
+def integrity_dir(env: dict | None = None) -> str:
+    """State directory of the output-integrity guard
+    (docs/RESILIENCE.md §output integrity;
+    ``tpukernels/resilience/integrity.py``): the fingerprint-envelope
+    manifest (``integrity.json``) and the quarantine ledger
+    (``integrity_quarantine.json``) live here, beside the caches they
+    police — unless ``TPK_INTEGRITY_DIR`` redirects (tests and chaos
+    runs point it at a tmp dir so injected corruption can never
+    quarantine the repo's real kernel configs). Same
+    read-the-env-per-call rule as the tuning/AOT paths.
+    """
+    target = os.environ if env is None else env
+    d = target.get("TPK_INTEGRITY_DIR")
+    if not d:
+        d = target.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            _REPO, ".jax_cache"
+        )
+    return d
+
+
+def integrity_manifest_path(env: dict | None = None) -> str:
+    return os.path.join(integrity_dir(env), "integrity.json")
+
+
+def integrity_quarantine_path(env: dict | None = None) -> str:
+    return os.path.join(integrity_dir(env), "integrity_quarantine.json")

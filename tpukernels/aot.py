@@ -239,28 +239,11 @@ def cache_key(name: str, args, statics=None, kind=None) -> str:
 # ------------------------------------------------------------------ #
 
 def _load_manifest(p: str) -> dict:
-    """Parsed manifest (memoized on stat); {} when absent/corrupt — an
+    """Parsed manifest via the shared stat-memoized tolerant reader
+    (``_cachedir.read_json_memoized``) — {} when absent/corrupt: an
     unreadable manifest degrades to cold-cache behavior, never raises
     (the tuning cache's contract)."""
-    import json
-
-    try:
-        st = os.stat(p)
-        stat_key = (st.st_mtime_ns, st.st_size)
-    except OSError:
-        return {}
-    memo = _MANIFEST_MEMO.get(p)
-    if memo and memo[0] == stat_key:
-        return memo[1]
-    try:
-        with open(p) as f:
-            data = json.load(f)
-    except (OSError, ValueError):
-        data = {}
-    if not isinstance(data, dict):
-        data = {}
-    _MANIFEST_MEMO[p] = (stat_key, data)
-    return data
+    return _cachedir.read_json_memoized(p, _MANIFEST_MEMO)
 
 
 def _reject(key: str, reason: str, **fields):
@@ -403,6 +386,56 @@ def compile_jitted(name: str, jitted, args, statics=None, sources=()):
             )
         _record(key, sources, lower_s, compile_s)
     return compiled
+
+
+def invalidate_kernel(name: str, prefixes=()) -> dict:
+    """Drop every compiled-executable trace of one kernel: its
+    per-process executable/jit memo entries and its persistent
+    manifest rows (key base field == ``name``, statics variants
+    included). ``prefixes`` additionally drops manifest rows whose
+    base field starts with any of them — how a bench-site integrity
+    failure also invalidates the metric's loop-program entries
+    (``bench_sgemm.R50@...``), which are the executables that actually
+    produced the corrupt warm result. Called by the output-integrity
+    guard (resilience/integrity.py) when a kernel's result fails a
+    check — the next dispatch/bench recompiles from source instead of
+    re-trusting a suspect executable, and no later process reads the
+    manifest as warm-cache evidence for it. Returns
+    ``{"memo_dropped": n, "manifest_dropped": [keys]}`` for the
+    journal record."""
+    def _matches(key: str) -> bool:
+        base = key.split("|", 1)[0]
+        return base.split("@", 1)[0] == name or any(
+            base.startswith(p) for p in prefixes
+        )
+
+    memo_keys = [k for k in _EXEC_MEMO if k[0] == name]
+    for k in memo_keys:
+        _EXEC_MEMO.pop(k, None)
+    for k in [k for k in _JIT_MEMO if k[0] == name]:
+        _JIT_MEMO.pop(k, None)
+    dropped: list = []
+    if enabled():
+        p = manifest_path()
+        if os.path.exists(p):
+            def _mutate(data):
+                entries = data.get("entries") or {}
+                dropped.extend(k for k in entries if _matches(k))
+                for k in dropped:
+                    entries.pop(k, None)
+
+            def _load(path):
+                _MANIFEST_MEMO.pop(path, None)
+                return _load_manifest(path)
+
+            _cachedir.locked_json_update(p, _mutate, load=_load)
+            _MANIFEST_MEMO.pop(p, None)
+    obs_metrics.inc("aot.invalidations")
+    journal.emit(
+        "aot_invalidated", kernel=name,
+        memo_dropped=len(memo_keys), manifest_dropped=dropped,
+    )
+    return {"memo_dropped": len(memo_keys), "manifest_dropped": dropped}
 
 
 # ------------------------------------------------------------------ #
@@ -575,6 +608,15 @@ def precompile(name: str) -> dict:
     t0 = time.perf_counter()
     _ensure_executable(name, fn, args, statics, sources)
     wall = time.perf_counter() - t0
+    # first-trust smoke check (docs/RESILIENCE.md §output integrity):
+    # a prewarm is exactly "a new process about to trust the warm
+    # cache on this device_kind", and no dispatch follows it — so the
+    # integrity canary runs HERE, and a failure invalidates the
+    # executable that was just blessed instead of letting the next
+    # healthy window measure garbage. No-op under TPK_INTEGRITY=0.
+    from tpukernels.resilience import integrity
+
+    integrity.aot_smoke(name)
     return {
         "kernel": name, "key": key, "expected": expected,
         "wall_s": round(wall, 6),

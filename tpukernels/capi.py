@@ -29,8 +29,9 @@ from tpukernels._cachedir import ensure_compilation_cache
 ensure_compilation_cache()
 
 # Resilience layer (stdlib-only, honors the env-before-jax-import
-# rule): fault-injection point + health journal for the C entry.
-from tpukernels.resilience import faults, journal
+# rule): fault-injection point + health journal for the C entry, plus
+# the output-integrity guard over the buffers the C driver reads back.
+from tpukernels.resilience import faults, integrity, journal
 
 # Observability (stdlib-only too, docs/OBSERVABILITY.md): per-kernel
 # dispatch spans/counters/latency histograms for the C entry.
@@ -434,6 +435,23 @@ def _adapt_allreduce(p, arrs):
     np.copyto(out, np.asarray(res.addressable_shards[0].data)[0])
 
 
+# Buffer indices each adapter WRITES (the driver-visible outputs the
+# integrity guard scans). Inputs are deliberately excluded: a C caller
+# may legitimately pass non-finite input data (masked elements,
+# padding garbage) and a correct kernel must not be failed —
+# let alone quarantined — for it. Unlisted kernels guard every buffer.
+_OUTPUT_BUFFERS = {
+    "vector_add": (1,),          # y (in/out)
+    "sgemm": (2,),               # c (in/out)
+    "stencil2d": (0,),           # x (in/out)
+    "stencil3d": (0,),
+    "scan": (1,),                # out
+    "histogram": (1,),           # counts
+    "scan_histogram": (1, 2),    # scan_out, counts
+    "nbody": (0, 1, 2, 3, 4, 5),  # px..vz (m is input-only)
+    "allreduce": (1,),           # out
+}
+
 _ADAPTERS = {
     "vector_add": _adapt_vector_add,
     "sgemm": _adapt_sgemm,
@@ -475,7 +493,24 @@ def run_from_c(kernel: str, params_json: str, addrs) -> int:
         journal.emit("capi_error", kernel=kernel, error=repr(e))
         raise
     # wall time includes H2D + compute + D2H — the same window the C
-    # driver's timing loop sees (module docstring "honest timing")
+    # driver's timing loop sees (module docstring "honest timing");
+    # clocked BEFORE the integrity guard so a canary check (a compile
+    # + oracle run on first-trust/sampled calls) never inflates the
+    # dispatch latency histogram
+    wall_s = time.perf_counter() - t0
+    # Output-integrity guard (docs/RESILIENCE.md §output integrity)
+    # over the very buffers the C driver is about to trust — the
+    # adapter-WRITTEN ones only (_OUTPUT_BUFFERS): tier-1 NaN/Inf
+    # scan on every call, first-trust/sampled oracle canary for
+    # registered kernels. Never raises — a corrupt result becomes a
+    # journaled, quarantined event, and the C host still gets its
+    # rc 0 (the shim's error contract is reserved for real failures).
+    out_idx = _OUTPUT_BUFFERS.get(kernel)
+    integrity.guard(
+        "capi", kernel,
+        [arrs[i] for i in out_idx if i < len(arrs)]
+        if out_idx is not None else arrs,
+    )
     obs_metrics.inc(f"capi.calls.{kernel}")
-    obs_metrics.observe(f"capi.wall_s.{kernel}", time.perf_counter() - t0)
+    obs_metrics.observe(f"capi.wall_s.{kernel}", wall_s)
     return 0

@@ -39,7 +39,7 @@ from typing import Callable, Dict
 from tpukernels import aot as _aot
 from tpukernels.obs import metrics as _obs_metrics
 from tpukernels.obs import trace as _trace
-from tpukernels.resilience import faults, journal
+from tpukernels.resilience import faults, integrity as _integrity, journal
 from tpukernels.tuning import space as _tuning_space
 
 _REGISTRY: Dict[str, Callable] = {}
@@ -110,11 +110,21 @@ def dispatch(name: str, *args, **statics):
     bench child, a tuning candidate after a prewarm — reuses the
     compiled executable. With ``TPK_AOT_CACHE=0`` this is exactly
     ``lookup(name)(*args, **statics)``: the plain eager wrapper, no
-    memo, no manifest."""
+    memo, no manifest.
+
+    Every dispatched result passes through the output-integrity guard
+    (docs/RESILIENCE.md §output integrity): an always-on NaN/Inf
+    tripwire plus first-trust/sampled oracle canary checks. The guard
+    never raises — a wrong answer becomes an
+    ``output_integrity_failed`` journal event, the kernel's AOT
+    executable memo is invalidated, and repeat offenders are
+    quarantined. ``TPK_INTEGRITY=0`` makes this a single check."""
     fn = lookup(name)
     if not _aot.enabled():
-        return fn(*args, **statics)
-    return _aot.run_cached(name, fn, args, statics)
+        out = fn(*args, **statics)
+    else:
+        out = _aot.run_cached(name, fn, args, statics)
+    return _integrity.guard("registry", name, out, statics=statics)
 
 
 def precompile(name: str) -> dict:

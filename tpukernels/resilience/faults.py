@@ -36,6 +36,26 @@ contract and examples):
 - ``"kill_supervisor": "stepname"`` (or ``{"step": ...}``) — the
   revalidation supervisor SIGKILLs ITSELF right after checkpointing
   that step's ``step_start`` — the crash-safe-resume chaos proof.
+- ``"corrupt_output": {"kernel": "sgemm", "site": "registry"}`` /
+  ``"nan_output": {...}`` — the output-integrity guard
+  (resilience/integrity.py) corrupts the guarded result it is about
+  to check: ``corrupt`` perturbs the first element by a
+  plausible-garbage delta (finite — only the oracle tiers can catch
+  it), ``nan`` poisons the first FLOAT leaf with a NaN (the tier-1
+  tripwire's prey; on a kernel with int-only outputs — scan,
+  histogram — there is no NaN to write, so it degrades to the
+  ``corrupt`` perturbation, which the canary tiers catch but tier 1
+  cannot: target float kernels for tripwire proofs).
+  ``kernel`` omitted matches any kernel; ``site`` (``registry`` |
+  ``capi`` | ``bench`` | ``aot`` — the prewarm first-trust smoke;
+  the tuning path is its candidates' bench children, so target it
+  with site ``bench`` + an ``env`` clause) omitted matches any
+  guarded path; a bare string is sugar for ``{"kernel": ...}``. The
+  same ``"env"`` clause as wedge/fail_metric narrows to one autotune
+  candidate. Because the guard's oracle canary runs through the same
+  corruption point, an injected corruption is detectable — the
+  detect → journal → quarantine chaos proof (docs/RESILIENCE.md
+  §output integrity).
 
 Fault state (probe script position, current metric) is per-process;
 plans reach bench's ``--one`` children through env inheritance. Every
@@ -200,6 +220,40 @@ def supervisor_fault(step: str):
     print(f"# fault: SIGKILL supervisor mid-{step}", file=sys.stderr,
           flush=True)
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+def output_fault(site: str, kernel):
+    """Injection point for the output-integrity guard
+    (resilience/integrity.py): returns ``"nan"`` / ``"corrupt"`` when
+    the plan wants this (site, kernel)'s guarded result corrupted, or
+    None. The GUARD applies the corruption (it owns the result's
+    representation); this only decides and journals — matching the
+    single-`_PLAN is None`-check contract of every other point."""
+    if _PLAN is None:
+        return None
+    for key, mode in (("nan_output", "nan"), ("corrupt_output", "corrupt")):
+        spec = _PLAN.get(key)
+        if not spec:
+            continue
+        if isinstance(spec, str):
+            spec = {"kernel": spec}
+        want = spec.get("kernel")
+        if want is not None and want != kernel:
+            continue
+        want_site = spec.get("site")
+        if want_site is not None and want_site != site:
+            continue
+        want_env = spec.get("env")
+        if want_env and any(
+            os.environ.get(k) != v for k, v in want_env.items()
+        ):
+            continue
+        journal.emit(
+            "fault_injected", site=f"output:{site}", kernel=kernel,
+            fault=key,
+        )
+        return mode
+    return None
 
 
 def capi_fault(kernel: str):

@@ -1,0 +1,883 @@
+"""Dispatch-time output-integrity guard (docs/RESILIENCE.md
+§output integrity).
+
+The paper's contract is that every benchmark "passes its reference
+check", yet until this module the stack verified timing, liveness and
+compile provenance but never *outputs* at dispatch time: a flapping
+chip, a miscompiled pipelined variant (TPK_SGEMM_DEPTH and friends) or
+a stale AOT executable could return plausible garbage and every layer
+— bench, trend, supervisor — would call it healthy. This module makes
+a wrong answer a detected, journaled, quarantined event instead of a
+silent one, on every guarded path: ``registry.dispatch``, the bench
+measure phases, ``capi.run_from_c`` and (through their bench
+children) autotune sweep candidates.
+
+Three tiers, cheapest always-on (``TPK_INTEGRITY``: unset/``1`` =
+full, ``tripwire`` = tier 1 only, ``0``/``off``/``none`` = off):
+
+1. **Finite tripwire** — every guarded result's float leaves are
+   scanned for NaN/Inf. One reduction per call; catches the classic
+   silent-corruption signature (a NaN launched into a fori_loop
+   poisons the whole chain, so bench's warm-call sum is a
+   whole-program tripwire).
+2. **Fingerprint bands** — per-(kernel, canary config) checksum/norm
+   envelopes recorded from the jnp oracles (the CPU-interpret golden
+   authority) into a persistent manifest (``integrity.json`` under
+   the ``_cachedir`` root, ``TPK_INTEGRITY_DIR`` redirects), keyed
+   and sha-validated exactly like ``tuning/cache.py`` and
+   ``aot.json``: a stale envelope (jax upgrade, a commit touching the
+   kernel's sources) is LOUDLY rejected and treated as absent. The
+   exact (int32) kernels compare bitwise via CRC — any flip is
+   caught, with no oracle re-run. On the guarded DISPATCH paths only
+   the exact kernels consume their envelope (float kernels go
+   straight to the stronger elementwise tier 3 at near-identical
+   cost); the float envelopes' norm bands serve
+   ``tools/integrity_envelopes.py --check`` and cross-process/device
+   drift records. The first time a process trusts a kernel's
+   compiled path on a device (first guarded call per (site, kernel);
+   ``aot.precompile``'s prewarm smoke), a tier-2/3 canary check runs
+   before results are believed.
+3. **Sampled oracle cross-check** — every Nth guarded call
+   (``TPK_INTEGRITY_SAMPLE``, default 64; 0 disables sampling but
+   keeps the first-call check) re-runs the kernel at its small canary
+   config THROUGH the same (possibly corrupted) path and compares
+   elementwise against the existing jnp oracle
+   (``sgemm_reference``/``inclusive_scan_reference``/...) within the
+   documented per-kernel tolerance.
+
+A failure NEVER crashes the surrounding run. It emits an
+``output_integrity_failed`` journal event (kernel, site, tier,
+config), invalidates the kernel's AOT executable memo + manifest
+entries (``aot.invalidate_kernel`` — the next call recompiles instead
+of re-trusting a suspect executable), and counts toward quarantine:
+``TPK_INTEGRITY_QUARANTINE_AFTER`` (default 2) failures for one
+(kernel, config) in a day demote it — loud
+``output_integrity_quarantined`` event + stderr, persisted in
+``integrity_quarantine.json``, and every later guarded call of a
+suspect kernel is canary-checked instead of sampled (the PR-4
+step-quarantine pattern applied to kernel configs). Clean-path bench
+stdout stays byte-identical whether the guard is on-and-passing or
+``TPK_INTEGRITY=0`` (test-proven like the fault/trace/AOT layers).
+
+The whole path is CPU-chaos-provable: the ``TPK_FAULT_PLAN`` keys
+``corrupt_output`` / ``nan_output`` (resilience/faults.py) corrupt
+guarded results — including the guard's own canary runs, which is
+what makes a finite corruption detectable against the clean oracle.
+
+Stdlib-only at import (numpy/jax load lazily inside the check paths),
+like every other resilience/obs/tuning module.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+import zlib
+
+from tpukernels import _cachedir
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.obs import trace
+from tpukernels.resilience import faults, journal
+
+_DISABLED = ("0", "off", "none")
+
+# per-process state (reset() for tests)
+_CALLS: dict = {}        # (site, kernel) -> guarded-call count
+_DEEP_DONE: set = set()  # (site, kernel) first-trust canary already ran
+_SUSPECT: set = set()    # kernels whose last check failed: check every call
+_QUAR_WARNED: set = set()  # quarantined keys already stderr-noted
+_REJECT_NOTED: set = set()
+_FILE_MEMO: dict = {}    # path -> (stat_key, parsed)
+
+
+def enabled() -> bool:
+    raw = os.environ.get("TPK_INTEGRITY")
+    return raw is None or raw.strip().lower() not in _DISABLED
+
+
+def tier1_only() -> bool:
+    """``TPK_INTEGRITY=tripwire``: keep the always-on finite scan but
+    skip the canary tiers — the chip-ops escape hatch when small-shape
+    canary compiles through a cold tunnel are not worth it."""
+    raw = os.environ.get("TPK_INTEGRITY")
+    return raw is not None and raw.strip().lower() == "tripwire"
+
+
+def sample_every() -> int:
+    """Every-Nth-call cadence of the sampled oracle cross-check; 0
+    disables sampling (first-trust checks still run). Fail-loud parse,
+    like every tunable knob."""
+    raw = os.environ.get("TPK_INTEGRITY_SAMPLE")
+    if raw is None or not raw.strip():
+        return 64
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"TPK_INTEGRITY_SAMPLE={raw!r}: expected a non-negative int"
+        ) from None
+    if n < 0:
+        raise ValueError(f"TPK_INTEGRITY_SAMPLE={n}: must be >= 0")
+    return n
+
+
+def quarantine_after() -> int:
+    raw = os.environ.get("TPK_INTEGRITY_QUARANTINE_AFTER")
+    if raw is None or not raw.strip():
+        return 2
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"TPK_INTEGRITY_QUARANTINE_AFTER={raw!r}: expected a "
+            "positive int"
+        ) from None
+    if n < 1:
+        raise ValueError(
+            f"TPK_INTEGRITY_QUARANTINE_AFTER={n}: must be >= 1"
+        )
+    return n
+
+
+def manifest_path() -> str:
+    return _cachedir.integrity_manifest_path()
+
+
+def quarantine_path() -> str:
+    return _cachedir.integrity_quarantine_path()
+
+
+def reset():
+    """Drop per-process state (tests only)."""
+    _CALLS.clear()
+    _DEEP_DONE.clear()
+    _SUSPECT.clear()
+    _QUAR_WARNED.clear()
+    _REJECT_NOTED.clear()
+    _FILE_MEMO.clear()
+
+
+# ------------------------------------------------------------------ #
+# canary configs + oracles (the registry completeness surface)       #
+# ------------------------------------------------------------------ #
+
+# Per-kernel canary config: deterministic small-shape inputs (seeded,
+# built by _ARG_BUILDERS below), the statics the kernel runs with, and
+# the comparison contract — "exact" for the int32 kernels (the fuzz
+# suite already pins them bitwise to their oracles) or the documented
+# (rtol, atol) for float kernels (bands wide enough for a TPU's bf16
+# matmul passes, narrow enough that any plausible-garbage corruption
+# is orders of magnitude outside them).
+# tests/test_registry_contract.py asserts every registry kernel —
+# including DERIVED_KERNELS like scan_exclusive — has a row here AND
+# in ORACLES: a new kernel cannot ship without an integrity surface.
+CANARY_CONFIGS = {
+    "vector_add": {"statics": {}, "rtol": 1e-5, "atol": 1e-5},
+    "sgemm": {"statics": {}, "rtol": 1e-3, "atol": 1e-2},
+    "stencil2d": {"statics": {"iters": 4}, "rtol": 1e-4, "atol": 1e-4},
+    "stencil3d": {"statics": {"iters": 2}, "rtol": 1e-4, "atol": 1e-4},
+    "scan": {"statics": {}, "exact": True},
+    "scan_exclusive": {"statics": {}, "exact": True},
+    "histogram": {"statics": {"nbins": 256}, "exact": True},
+    "scan_histogram": {"statics": {"nbins": 256}, "exact": True},
+    "nbody": {
+        "statics": {"dt": 1e-3, "eps": 1e-2, "steps": 1},
+        "rtol": 1e-3, "atol": 1e-3,
+    },
+}
+
+# kernel -> "module:attr" of its jnp oracle, resolved lazily (imports
+# stay stdlib-only; the oracles are the ones the golden tests already
+# trust — one authority, two consumers)
+ORACLES = {
+    "vector_add": "tpukernels.kernels.vector_add:saxpy_reference",
+    "sgemm": "tpukernels.kernels.sgemm:sgemm_reference",
+    "stencil2d": "tpukernels.kernels.stencil:jacobi2d_reference",
+    "stencil3d": "tpukernels.kernels.stencil:jacobi3d_reference",
+    "scan": "tpukernels.kernels.scan:inclusive_scan_reference",
+    "scan_exclusive": "tpukernels.kernels.scan:exclusive_scan_reference",
+    "histogram": "tpukernels.kernels.histogram:histogram_reference",
+    "scan_histogram":
+        "tpukernels.kernels.scan_histogram:scan_histogram_reference",
+    "nbody": "tpukernels.kernels.nbody:nbody_reference",
+}
+
+
+def tolerance(name: str):
+    """("exact", None, None) or ("band", rtol, atol) for one kernel's
+    canary comparison — the documented tolerance of the cross-check."""
+    cfg = CANARY_CONFIGS[name]
+    if cfg.get("exact"):
+        return ("exact", None, None)
+    return ("band", cfg["rtol"], cfg["atol"])
+
+
+def _build_args(name: str):
+    """Deterministic canary operands for one kernel (np/host values;
+    the runner converts arrays to jnp). Small, off-tile-boundary
+    shapes: padding/edge paths are where silent corruption hides."""
+    import numpy as np
+
+    rng = np.random.default_rng(20260804)
+    f32 = lambda *s: np.asarray(rng.standard_normal(s), np.float32)
+    if name == "vector_add":
+        return (0.7, f32(1000), f32(1000))
+    if name == "sgemm":
+        return (1.25, f32(40, 72), f32(72, 56), -0.5, f32(40, 56))
+    if name == "stencil2d":
+        return (f32(40, 200),)
+    if name == "stencil3d":
+        return (f32(8, 24, 132),)
+    if name in ("scan", "scan_exclusive"):
+        return (np.asarray(rng.integers(-1000, 1000, 4093), np.int32),)
+    if name in ("histogram", "scan_histogram"):
+        return (np.asarray(rng.integers(0, 256, 4093), np.int32),)
+    if name == "nbody":
+        return tuple(f32(192) for _ in range(6)) + (
+            np.asarray(rng.uniform(0.5, 1.5, 192), np.float32),
+        )
+    raise KeyError(f"no canary operands for kernel {name!r}")
+
+
+def canary_key(name: str) -> str:
+    """``kernel|shapes|dtypes|statics`` — the tuning-cache key scheme
+    over the canary operands. Device-agnostic on purpose: the envelope
+    is the ORACLE's fingerprint and the bands absorb backend drift, so
+    one recorded envelope polices every device_kind."""
+    import numpy as np
+
+    args = _build_args(name)
+    shapes, dtypes = [], []
+    for a in args:
+        if isinstance(a, np.ndarray):
+            shapes.append("x".join(str(d) for d in a.shape))
+            dtypes.append(str(a.dtype))
+        else:
+            shapes.append("-")
+    statics = CANARY_CONFIGS[name]["statics"]
+    stat = ",".join(f"{k}={v}" for k, v in sorted(statics.items())) or "-"
+    return "|".join(
+        (name, "+".join(shapes), "+".join(sorted(set(dtypes))) or "-",
+         stat)
+    )
+
+
+def _oracle(name: str):
+    import importlib
+
+    mod, attr = ORACLES[name].split(":")
+    return getattr(importlib.import_module(mod), attr)
+
+
+def _leaves(outputs):
+    if isinstance(outputs, (tuple, list)):
+        return list(outputs)
+    return [outputs]
+
+
+def fingerprint(outputs) -> list:
+    """Compact per-leaf fingerprint: shape/dtype, finiteness, CRC of
+    the raw bytes (the bitwise authority for exact kernels), and the
+    float64 norm statistics the band comparison uses."""
+    import numpy as np
+
+    rows = []
+    for leaf in _leaves(outputs):
+        a = np.asarray(leaf)
+        row = {
+            "shape": "x".join(str(d) for d in a.shape) or "-",
+            "dtype": str(a.dtype),
+            "crc": zlib.crc32(np.ascontiguousarray(a).tobytes()),
+        }
+        if np.issubdtype(a.dtype, np.floating):
+            a64 = a.astype(np.float64)
+            row["finite"] = bool(np.isfinite(a).all())
+            row["l2"] = float(np.sqrt(np.sum(a64 * a64)))
+            row["sum"] = float(np.sum(a64))
+            row["absmax"] = float(np.max(np.abs(a64))) if a.size else 0.0
+        rows.append(row)
+    return rows
+
+
+# ------------------------------------------------------------------ #
+# fingerprint-envelope manifest (tier 2)                              #
+# ------------------------------------------------------------------ #
+
+def _read_json(p: str) -> dict:
+    """Parsed state file via the shared stat-memoized tolerant reader
+    (``_cachedir.read_json_memoized``) — {} when absent/corrupt,
+    never raises (the tuning-cache contract)."""
+    return _cachedir.read_json_memoized(p, _FILE_MEMO)
+
+
+def _write_json(p: str, mutate):
+    """flock-serialized read-modify-write via the shared
+    ``_cachedir.locked_json_update`` discipline, with this module's
+    stat-memo refreshed around it."""
+    def _load(path):
+        _FILE_MEMO.pop(path, None)
+        return _read_json(path)
+
+    data = _cachedir.locked_json_update(p, mutate, load=_load)
+    _FILE_MEMO.pop(p, None)
+    return data
+
+
+def _sources(name: str):
+    """Git-epoch sources for one kernel's envelope — the same files
+    whose commits gate its tuning-cache and AOT-manifest entries."""
+    from tpukernels import aot
+
+    return aot.KERNEL_SOURCES.get(name, ())
+
+
+def _reject(key: str, reason: str, **fields):
+    memo = (key, reason)
+    if memo in _REJECT_NOTED:
+        return
+    _REJECT_NOTED.add(memo)
+    obs_metrics.inc("integrity.rejections")
+    print(f"# integrity-envelope rejected: {key} ({reason})",
+          file=sys.stderr)
+    journal.emit("output_integrity_rejected", key=key, reason=reason,
+                 **fields)
+
+
+def envelope(name: str):
+    """The validated fingerprint envelope for ``name``'s canary
+    config, or None when absent/stale. Validation mirrors the tuning
+    cache: jax version must match and no commit touching the kernel's
+    sources may postdate the entry — a stale envelope is rejected
+    loudly and treated as absent, never silently trusted."""
+    key = canary_key(name)
+    entry = _read_json(manifest_path()).get("entries", {}).get(key)
+    if not isinstance(entry, dict):
+        return None
+    import jax
+
+    if entry.get("jax") != jax.__version__:
+        _reject(
+            key,
+            f"recorded under jax {entry.get('jax')}, "
+            f"running {jax.__version__}",
+        )
+        return None
+    sources = _sources(name)
+    if sources:
+        from tpukernels.tuning import cache as tcache
+
+        sha = tcache.source_sha(tuple(sources))
+        if sha is not None and entry.get("source_sha") not in (None, sha):
+            _reject(
+                key,
+                "stale: a commit touching " + ",".join(sources)
+                + " postdates this envelope",
+                entry_sha=entry.get("source_sha"), current_sha=sha,
+            )
+            return None
+    return entry
+
+
+def record_envelope(name: str) -> dict:
+    """Record ``name``'s oracle fingerprint envelope into the
+    manifest (the daily ``integrity_envelopes`` supervisor step and
+    ``tools/integrity_envelopes.py --record``). The ORACLE — not the
+    kernel — is the recorded authority; envelopes are meant to be
+    captured on CPU where the jnp reference is golden."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpukernels.tuning import cache as tcache
+
+    args = _build_args(name)
+    statics = CANARY_CONFIGS[name]["statics"]
+    jargs = tuple(
+        jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args
+    )
+    ref = _oracle(name)(*jargs, **statics)
+    fps = fingerprint(ref)
+    key = canary_key(name)
+    sources = _sources(name)
+    entry = {
+        "fingerprints": fps,
+        "jax": jax.__version__,
+        "source_sha": tcache.source_sha(tuple(sources)) if sources
+        else None,
+        "git_head": journal.git_head(),
+        "recorded": round(time.time(), 3),
+        "recorded_on": tcache.device_kind(),
+    }
+    _write_json(
+        manifest_path(),
+        lambda data: data.setdefault("entries", {}).__setitem__(
+            key, entry
+        ),
+    )
+    journal.emit("output_integrity_envelope", kernel=name, key=key,
+                 leaves=len(fps))
+    return entry
+
+
+def record_all(names=None, echo=None):
+    """Record every kernel's envelope (or the ``names`` subset);
+    returns per-kernel rows, ``{"kernel", "error"}`` on failure — one
+    broken oracle must not abort the rest of the refresh."""
+    echo = echo or (lambda line: None)
+    rows = []
+    for name in (names if names is not None else sorted(CANARY_CONFIGS)):
+        try:
+            entry = record_envelope(name)
+        except Exception as e:  # noqa: BLE001 — reported per kernel
+            rows.append({"kernel": name, "error": repr(e)})
+            echo(f"  {name:<16} FAILED: {e!r}")
+        else:
+            rows.append({"kernel": name, "key": canary_key(name),
+                         "leaves": len(entry["fingerprints"])})
+            echo(f"  {name:<16} recorded "
+                 f"({len(entry['fingerprints'])} leaf fingerprint(s))")
+    return rows
+
+
+def _band_close(a, b, rel, absolute) -> bool:
+    return abs(a - b) <= absolute + rel * max(abs(a), abs(b))
+
+
+def _fingerprint_mismatch(name, got_rows, want_rows):
+    """Compare a canary run's fingerprints against the envelope;
+    returns a failure description or None. Exact kernels compare
+    bitwise (CRC — any flip is caught); float kernels compare the
+    norm bands (gross corruption; tier 3 is the elementwise
+    authority)."""
+    if len(got_rows) != len(want_rows):
+        return (f"leaf count {len(got_rows)} != envelope "
+                f"{len(want_rows)}")
+    kind, rtol, _atol = tolerance(name)
+    for i, (got, want) in enumerate(zip(got_rows, want_rows)):
+        if got.get("shape") != want.get("shape") or (
+            got.get("dtype") != want.get("dtype")
+        ):
+            return (f"leaf {i}: shape/dtype "
+                    f"{got.get('shape')}/{got.get('dtype')} != envelope "
+                    f"{want.get('shape')}/{want.get('dtype')}")
+        if kind == "exact":
+            if got.get("crc") != want.get("crc"):
+                return (f"leaf {i}: checksum {got.get('crc')} != "
+                        f"envelope {want.get('crc')} (exact kernel)")
+            continue
+        if got.get("finite") is not True:
+            return f"leaf {i}: non-finite values"
+        band_rel = max(1e-3, 10.0 * (rtol or 0.0))
+        for stat in ("l2", "absmax", "sum"):
+            g, w = got.get(stat), want.get(stat)
+            if g is None or w is None:
+                continue
+            scale = max(abs(want.get("absmax") or 0.0), 1.0)
+            if not _band_close(g, w, band_rel, band_rel * scale):
+                return (f"leaf {i}: {stat} {g} outside the envelope "
+                        f"band around {w}")
+    return None
+
+
+# ------------------------------------------------------------------ #
+# canary runs + the deep checks (tiers 2/3)                           #
+# ------------------------------------------------------------------ #
+
+def _run_canary(name: str, site: str):
+    """One small deterministic run of the REAL kernel path — through
+    the same output-corruption point as the guarded call (that is
+    what makes a finite injected corruption detectable against the
+    clean oracle)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpukernels import registry
+
+    args = _build_args(name)
+    statics = CANARY_CONFIGS[name]["statics"]
+    jargs = tuple(
+        jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args
+    )
+    out = registry.lookup(name)(*jargs, **statics)
+    mode = faults.output_fault(site, name)
+    if mode:
+        out = _corrupt(out, mode)
+    return jargs, statics, out
+
+
+def cross_check(name: str, site: str = "manual"):
+    """Tier-3 oracle cross-check: canary kernel run vs the jnp oracle
+    on identical inputs, elementwise within the documented tolerance.
+    Returns a failure description or None."""
+    import numpy as np
+
+    jargs, statics, out = _run_canary(name, site)
+    ref = _oracle(name)(*jargs, **statics)
+    got, want = _leaves(out), _leaves(ref)
+    if len(got) != len(want):
+        return f"kernel returned {len(got)} leaves, oracle {len(want)}"
+    kind, rtol, atol = tolerance(name)
+    for i, (g, w) in enumerate(zip(got, want)):
+        g, w = np.asarray(g), np.asarray(w)
+        if g.shape != w.shape:
+            return f"leaf {i}: shape {g.shape} != oracle {w.shape}"
+        if kind == "exact":
+            if not np.array_equal(g, w):
+                bad = int(np.sum(g != w))
+                return (f"leaf {i}: {bad} element(s) differ from the "
+                        "oracle (exact kernel)")
+        elif not np.allclose(g, w, rtol=rtol, atol=atol,
+                             equal_nan=False):
+            bad = int(np.sum(
+                ~np.isclose(g, w, rtol=rtol, atol=atol)
+            ))
+            return (f"leaf {i}: {bad} element(s) outside "
+                    f"rtol={rtol}/atol={atol} of the oracle")
+    return None
+
+
+def fingerprint_check(name: str, site: str = "manual"):
+    """Tier-2 check: canary kernel run fingerprints vs the persisted
+    oracle envelope. Returns (ran, failure): ``ran`` False when no
+    validated envelope exists (caller falls through to tier 3)."""
+    ent = envelope(name)
+    if ent is None:
+        return False, None
+    _jargs, _statics, out = _run_canary(name, site)
+    return True, _fingerprint_mismatch(
+        name, fingerprint(out), ent.get("fingerprints") or []
+    )
+
+
+def _deep_check(site: str, name: str):
+    """(tier, failure_or_None): exact kernels prefer the persisted
+    envelope's bitwise CRC (tier 2 — catches any flip, no oracle
+    re-run); float kernels and envelope-less kernels go to the live
+    elementwise oracle (tier 3 — the authority)."""
+    obs_metrics.inc("integrity.deep_checks")
+    kind, _rtol, _atol = tolerance(name)
+    if kind == "exact":
+        ran, failure = fingerprint_check(name, site)
+        if ran:
+            return 2, failure
+    return 3, cross_check(name, site)
+
+
+# ------------------------------------------------------------------ #
+# quarantine ledger                                                   #
+# ------------------------------------------------------------------ #
+
+def _config_token() -> str:
+    """The (kernel, config) quarantine key's config half: everything
+    that selects a different compiled program at the same shapes — the
+    AOT layer's tunable env fingerprint, so an autotune candidate's
+    corrupt variant quarantines under ITS knob values, not the
+    default's."""
+    try:
+        from tpukernels import aot
+
+        return aot.tunable_env_fingerprint() or "default"
+    except Exception:
+        return "default"
+
+
+def _quarantine_key(kernel, config=None) -> str:
+    return f"{kernel}|{config or _config_token()}"
+
+
+def _today() -> str:
+    return datetime.date.today().isoformat()
+
+
+def note_failure(kernel, config=None, detail=None):
+    """Count one confirmed integrity failure for (kernel, config);
+    returns (failures_today, quarantined, transitioned). Counts are
+    per-day (the PR-4 pattern: a new day is a fresh chance); the
+    ledger persists across processes via ``integrity_quarantine.json``
+    so repeat offenses accumulate across bench children and sweep
+    candidates."""
+    key = _quarantine_key(kernel, config)
+    today = _today()
+    threshold = quarantine_after()
+    state = {}
+
+    def mutate(data):
+        entries = data.setdefault("entries", {})
+        ent = entries.get(key)
+        if not isinstance(ent, dict) or ent.get("day") != today:
+            ent = {"day": today, "failures": 0, "quarantined": False}
+        ent["failures"] += 1
+        ent["last_detail"] = str(detail)[:200] if detail else None
+        ent["last_t"] = round(time.time(), 3)
+        transitioned = (
+            not ent["quarantined"] and ent["failures"] >= threshold
+        )
+        if transitioned:
+            ent["quarantined"] = True
+        entries[key] = ent
+        state.update(ent, transitioned=transitioned)
+
+    _write_json(quarantine_path(), mutate)
+    return state["failures"], state["quarantined"], state["transitioned"]
+
+
+def is_quarantined(kernel, config=None) -> bool:
+    ent = _read_json(quarantine_path()).get("entries", {}).get(
+        _quarantine_key(kernel, config)
+    )
+    return (
+        isinstance(ent, dict)
+        and ent.get("day") == _today()
+        and bool(ent.get("quarantined"))
+    )
+
+
+def quarantined_entries() -> dict:
+    """Today's quarantined (kernel, config) entries — the report
+    surface for tools/obs_report.py / health narration."""
+    today = _today()
+    return {
+        k: v
+        for k, v in _read_json(quarantine_path()).get(
+            "entries", {}
+        ).items()
+        if isinstance(v, dict) and v.get("day") == today
+        and v.get("quarantined")
+    }
+
+
+# ------------------------------------------------------------------ #
+# corruption + tripwire                                               #
+# ------------------------------------------------------------------ #
+
+def _corrupt_value(v, mode):
+    if mode == "nan":
+        return float("nan")
+    # plausible-garbage, guaranteed-visible: |delta| >= 1 even at v=0
+    return v + max(1.0, abs(float(v)))
+
+
+def _corrupt(outputs, mode):
+    """Apply one injected corruption to the first (float-preferring,
+    for ``nan``) leaf — in place for writable numpy buffers (the capi
+    views the C driver reads back), functionally otherwise."""
+    import numpy as np
+
+    leaves = _leaves(outputs)
+    idx = 0
+    if mode == "nan":
+        for i, leaf in enumerate(leaves):
+            dt = getattr(np.asarray(leaf), "dtype", None)
+            if dt is not None and np.issubdtype(dt, np.floating):
+                idx = i
+                break
+    leaf = leaves[idx]
+    if isinstance(leaf, np.ndarray) and leaf.flags.writeable:
+        flat = leaf.reshape(-1)
+        if np.issubdtype(leaf.dtype, np.floating):
+            flat[0] = _corrupt_value(float(flat[0]), mode)
+        else:
+            flat[0] = int(flat[0]) + 41
+        return outputs
+    a = np.array(np.asarray(leaf))  # writable copy (jax / read-only np)
+    was_scalar = a.ndim == 0
+    flat = a.reshape(-1)
+    if np.issubdtype(a.dtype, np.floating):
+        flat[0] = _corrupt_value(float(flat[0]), mode)
+    else:
+        flat[0] = int(flat[0]) + 41
+    new_leaf = a if not was_scalar else a[()]
+    if hasattr(leaf, "at"):  # jax array: rebuild on-device
+        import jax.numpy as jnp
+
+        new_leaf = jnp.asarray(a)
+    if isinstance(outputs, (tuple, list)):
+        out = list(outputs)
+        out[idx] = new_leaf
+        return tuple(out) if isinstance(outputs, tuple) else out
+    return new_leaf
+
+
+def _tripwire_ok(outputs) -> bool:
+    """Tier 1: every float leaf is fully finite."""
+    import math
+
+    import numpy as np
+
+    for leaf in _leaves(outputs):
+        if isinstance(leaf, float):
+            if not math.isfinite(leaf):
+                return False
+            continue
+        dt = getattr(leaf, "dtype", None)
+        if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
+            continue
+        if isinstance(leaf, np.ndarray):
+            if not bool(np.isfinite(leaf).all()):
+                return False
+        else:  # jax array: reduce on device, fetch one bool
+            import jax.numpy as jnp
+
+            if not bool(jnp.isfinite(leaf).all()):
+                return False
+    return True
+
+
+# ------------------------------------------------------------------ #
+# the guard                                                           #
+# ------------------------------------------------------------------ #
+
+def _fail(site, kernel, tier, detail, statics=None,
+          invalidate_prefixes=()):
+    obs_metrics.inc("integrity.failures")
+    if kernel:
+        _SUSPECT.add(kernel)
+    config = _config_token()
+    invalidated = {}
+    if kernel:
+        try:
+            from tpukernels import aot
+
+            invalidated = aot.invalidate_kernel(
+                kernel, prefixes=invalidate_prefixes
+            )
+        except Exception:  # noqa: BLE001 — invalidation is best-effort
+            pass
+    print(
+        f"# output-integrity FAILED: {kernel or '<unknown>'} at {site} "
+        f"(tier {tier}: {detail})",
+        file=sys.stderr,
+    )
+    journal.emit(
+        "output_integrity_failed",
+        kernel=kernel, site=site, tier=tier, detail=detail,
+        config=config, statics=dict(statics) if statics else None,
+        aot_memo_dropped=invalidated.get("memo_dropped"),
+        aot_manifest_dropped=invalidated.get("manifest_dropped"),
+    )
+    if kernel:
+        try:
+            failures, quarantined, transitioned = note_failure(
+                kernel, config, detail
+            )
+        except Exception as e:  # noqa: BLE001 — an unwritable ledger
+            # must not turn a DETECTED corruption into a crash; the
+            # output_integrity_failed event above already landed
+            obs_metrics.inc("integrity.check_errors")
+            journal.emit(
+                "output_integrity_check_error", kernel=kernel,
+                site=site, error=f"quarantine ledger write failed: {e!r}",
+            )
+            return
+        if transitioned:
+            obs_metrics.inc("integrity.quarantines")
+            print(
+                f"# output-integrity QUARANTINED: {kernel} "
+                f"(config {config}) after {failures} failure(s) today "
+                "- results from this config are suspect until the "
+                "envelope step clears it",
+                file=sys.stderr,
+            )
+            journal.emit(
+                "output_integrity_quarantined",
+                kernel=kernel, config=config, failures=failures,
+                threshold=quarantine_after(),
+            )
+        elif quarantined:
+            journal.emit(
+                "output_integrity_quarantined_repeat",
+                kernel=kernel, config=config, failures=failures,
+            )
+
+
+def guard(site: str, kernel, outputs, statics=None,
+          invalidate_prefixes=()):
+    """THE guard: called with one dispatch's result on every guarded
+    path. Applies any injected chaos corruption, runs the tiers, and
+    returns the outputs — it NEVER raises (a wrong answer must become
+    a journaled, quarantined event, not a crash of the surrounding
+    run). ``kernel`` may be None (bench driving an unknown loop
+    program): tier 1 still applies. ``invalidate_prefixes`` ride into
+    ``aot.invalidate_kernel`` on failure — bench passes its loop-
+    program label so the executables that produced the corrupt warm
+    result are dropped too, not just the kernel's dispatch entries."""
+    if not enabled():
+        return outputs
+    obs_metrics.inc("integrity.checks")
+    n = _CALLS[(site, kernel)] = _CALLS.get((site, kernel), 0) + 1
+    failure, tier = None, None
+    try:
+        mode = faults.output_fault(site, kernel)
+        if mode:
+            outputs = _corrupt(outputs, mode)
+        if not _tripwire_ok(outputs):
+            failure, tier = "non-finite value in guarded result", 1
+        elif not tier1_only() and kernel in CANARY_CONFIGS:
+            every = sample_every()
+            quarantined = kernel in _SUSPECT or is_quarantined(kernel)
+            if quarantined and kernel not in _QUAR_WARNED:
+                _QUAR_WARNED.add(kernel)
+                print(
+                    f"# output-integrity: {kernel} is quarantined/"
+                    "suspect - canary-checking every call",
+                    file=sys.stderr,
+                )
+            deep = (
+                (site, kernel) not in _DEEP_DONE
+                or quarantined
+                or (every > 0 and n % every == 0)
+            )
+            if deep:
+                with trace.span(f"integrity/canary/{kernel}",
+                                site=site):
+                    tier, failure = _deep_check(site, kernel)
+                _DEEP_DONE.add((site, kernel))
+    except Exception as e:  # noqa: BLE001 — the guard must not crash
+        obs_metrics.inc("integrity.check_errors")
+        journal.emit(
+            "output_integrity_check_error",
+            kernel=kernel, site=site, error=repr(e),
+        )
+        return outputs
+    if failure is not None:
+        try:
+            _fail(site, kernel, tier, failure, statics,
+                  invalidate_prefixes=invalidate_prefixes)
+        except Exception as e:  # noqa: BLE001 — never crash the run
+            obs_metrics.inc("integrity.check_errors")
+            journal.emit(
+                "output_integrity_check_error", kernel=kernel,
+                site=site, error=f"failure handling errored: {e!r}",
+            )
+    elif tier is not None and kernel in _SUSPECT:
+        # a clean deep check lifts the per-process escalation (the
+        # persisted quarantine ledger stays until its day rolls)
+        _SUSPECT.discard(kernel)
+    return outputs
+
+
+def aot_smoke(name: str):
+    """The first-trust smoke check for a prewarm-time compile
+    (``aot.precompile`` — no dispatch follows, so the guard's own
+    first-call check would never run). Shares the per-process
+    first-trust memo under site ``aot``; a failure invalidates the
+    executable it was about to bless."""
+    if not enabled() or tier1_only() or name not in CANARY_CONFIGS:
+        return
+    if ("aot", name) in _DEEP_DONE:
+        return
+    _DEEP_DONE.add(("aot", name))
+    try:
+        with trace.span(f"integrity/canary/{name}", site="aot"):
+            tier, failure = _deep_check("aot", name)
+        if failure is not None:
+            _fail("aot", name, tier, failure)
+    except Exception as e:  # noqa: BLE001 — never crash a prewarm
+        obs_metrics.inc("integrity.check_errors")
+        journal.emit(
+            "output_integrity_check_error",
+            kernel=name, site="aot", error=repr(e),
+        )

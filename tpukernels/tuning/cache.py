@@ -114,25 +114,10 @@ def source_sha(sources, root=None):
 
 
 def _load(p):
-    """Parsed cache file (memoized on stat); {} when absent/corrupt —
-    an unreadable cache degrades to shipped defaults, never raises."""
-    try:
-        st = os.stat(p)
-        stat_key = (st.st_mtime_ns, st.st_size)
-    except OSError:
-        return {}
-    memo = _FILE_MEMO.get(p)
-    if memo and memo[0] == stat_key:
-        return memo[1]
-    try:
-        with open(p) as f:
-            data = json.load(f)
-    except (OSError, ValueError):
-        data = {}
-    if not isinstance(data, dict):
-        data = {}
-    _FILE_MEMO[p] = (stat_key, data)
-    return data
+    """Parsed cache file via the shared stat-memoized tolerant reader
+    (``_cachedir.read_json_memoized``) — {} when absent/corrupt: an
+    unreadable cache degrades to shipped defaults, never raises."""
+    return _cachedir.read_json_memoized(p, _FILE_MEMO)
 
 
 def _reject(key, reason, **fields):

@@ -101,6 +101,51 @@ def _journal_size(path) -> int:
         return 0
 
 
+def _sweep_guard_kernels(kernel, metric):
+    """The registry kernels whose integrity failures indict THIS
+    sweep's candidates: the tuned kernel plus every kernel bound to
+    the same bench metric (tuning ``scan`` measures through
+    ``scan_hist_melem_s``, whose bench child guards the combined
+    ``scan_histogram`` pass). Filtering matters: the journal is the
+    shared dated file, and an unrelated kernel's failure in a
+    concurrent run must not discard a healthy candidate."""
+    from tpukernels.tuning import roofline
+
+    names = {kernel}
+    names.update(
+        k for k, m in roofline.KERNEL_METRIC.items() if m == metric
+    )
+    return names
+
+
+def _integrity_failures(path, offset, kernels):
+    """Count ``output_integrity_failed`` events for ``kernels``
+    appended past byte ``offset`` — the candidate child's own guard
+    confirming its results are corrupt (docs/RESILIENCE.md §output
+    integrity). The child quarantines the (kernel, candidate-knob
+    config) itself via the shared quarantine ledger; the runner's job
+    is to DISCARD the measurement so a corrupt variant can never win
+    a promotion."""
+    if path is None:
+        return 0
+    n = 0
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            for line in f.read().splitlines():
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                n += (
+                    ev.get("kind") == "output_integrity_failed"
+                    and ev.get("kernel") in kernels
+                )
+    except OSError:
+        return 0
+    return n
+
+
 def _aot_hit_ratio(path, offset):
     """hits/(hits+misses) over journal events appended past byte
     ``offset``, or None when journaling is off / no compile happened
@@ -178,6 +223,13 @@ def tune(
     if smoke:
         env0.update(_SMOKE_ENV)
     env0["TPK_TUNING_CACHE"] = "0"  # children never read mid-sweep
+    # the bench children journal to this file anyway (bench.py's CLI
+    # default); making it explicit in env0 lets the runner tail their
+    # aot_hit/aot_miss AND output_integrity_failed evidence — without
+    # it, an unset var here meant the runner read None while the
+    # children wrote the dated default. An explicit "0"/off stays off.
+    if env0.get("TPK_HEALTH_JOURNAL") is None:
+        env0["TPK_HEALTH_JOURNAL"] = journal.default_path()
     # every candidate re-enters a cold process; the shared persistent
     # compilation cache (docs/PERF.md §compile discipline) means only
     # genuinely NEW block shapes compile — candidate N+1 re-lowers but
@@ -261,6 +313,17 @@ def tune(
         # chip-minute story of this candidate (1.0 = fully warm, the
         # sweep spent its wall measuring; <1.0 = new block shapes)
         aot_ratio = _aot_hit_ratio(jpath, j0)
+        # the child's integrity guard confirmed corrupt output for
+        # this candidate's knob config: the measured value is garbage
+        # by definition — discard it (status "integrity") so max()
+        # can never promote a fast-but-wrong variant. The child
+        # already journaled output_integrity_failed and quarantined
+        # the (kernel, config) in the shared ledger.
+        integrity_failed = _integrity_failures(
+            jpath, j0, _sweep_guard_kernels(kernel, space.metric)
+        )
+        if integrity_failed and value is not None:
+            value, status = None, "integrity"
         obs_metrics.inc(
             "tuning.candidates_ok" if value is not None
             else "tuning.candidates_failed"
@@ -273,6 +336,7 @@ def tune(
             status=status,
             elapsed_s=elapsed,
             aot_hit_ratio=aot_ratio,
+            integrity_failed=integrity_failed,
         )
         shown = (
             f"{value:12.2f}" if value is not None else f"  FAIL ({status})"
@@ -284,7 +348,8 @@ def tune(
                else "")
         )
         rows.append({"params": params, "value": value, "status": status,
-                     "aot_hit_ratio": aot_ratio})
+                     "aot_hit_ratio": aot_ratio,
+                     "integrity_failed": integrity_failed})
 
     # candidates() puts the shipped defaults first; if a space ever
     # ships infeasible defaults (pruned), there is no control row and
