@@ -202,6 +202,26 @@ if "TPK_INTEGRITY_DIR" not in os.environ:
         except OSError:
             pass
 
+# Isolate the latency-SLO verdict artifact (docs/OBSERVABILITY.md
+# §latency SLOs) the same way: chaos tests inject slow-dispatch
+# faults and persist slo_breach verdicts into slo.json — the artifact
+# obs_report --check GATES on. Test noise must never land in (or gate
+# through) the repo's real verdict file, and a previous suite run's
+# breach must not flip this run's obs_report assertions. Tests that
+# assert verdict state point TPK_SLO_DIR at their own tmp path.
+if "TPK_SLO_DIR" not in os.environ:
+    import tempfile
+
+    _slo_dir = os.path.join(
+        tempfile.gettempdir(), f"tpk_slo_test_{os.getuid()}"
+    )
+    os.makedirs(_slo_dir, exist_ok=True)
+    os.environ["TPK_SLO_DIR"] = _slo_dir
+    try:  # a previous suite run's verdicts must not steer this one
+        os.unlink(os.path.join(_slo_dir, "slo.json"))
+    except OSError:
+        pass
+
 # Persist compiled executables across suite runs (the shared knob —
 # tpukernels/_cachedir.py; `import tpukernels` is deliberately
 # jax-free, so this respects the env-before-jax-import rule below).

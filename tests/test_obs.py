@@ -146,7 +146,19 @@ def test_metrics_counter_gauge_histogram_semantics():
         assert snap["counters"]["c"] == 7
         assert snap["gauges"]["g"] == 3.5
         h = snap["histograms"]["h"]
-        assert h == {"count": 3, "sum": 3.5, "min": 0.5, "max": 2.0}
+        assert (h["count"], h["sum"], h["min"], h["max"]) == (
+            3, 3.5, 0.5, 2.0)
+        # log buckets: one shared boundary scheme (bucket_index), str
+        # keys so the in-process shape equals the JSON round trip
+        assert h["buckets"] == {
+            str(metrics.bucket_index(v)): 1 for v in (2.0, 0.5, 1.0)
+        }
+        # count-weighted percentiles off the buckets, clamped to the
+        # EXACT max: p99 of 3 samples is the worst sample, never a
+        # bucket bound above it
+        assert h["p99"] == 2.0 == h["p95"]
+        assert 1.0 <= h["p50"] <= metrics.bucket_upper(
+            metrics.bucket_index(1.0))
         # snapshot is a copy, not a view
         snap["counters"]["c"] = 0
         assert metrics.snapshot()["counters"]["c"] == 7

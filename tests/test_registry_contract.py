@@ -20,6 +20,7 @@ import numpy as np
 import pytest
 
 from tpukernels import aot, registry
+from tpukernels.obs import slo
 from tpukernels.resilience import integrity
 from tpukernels.tuning import roofline
 
@@ -66,6 +67,22 @@ def test_registry_contract_complete():
         # otherwise surface only when a guard first fires)
         assert integrity._build_args(name)
         assert integrity.canary_key(name).startswith(name + "|")
+        # latency-SLO surface (ISSUE 8, docs/OBSERVABILITY.md §latency
+        # SLOs): DIRECT rows even for derived kernels — a kernel
+        # without a target would load-test to "no_data" forever. Both
+        # the chip evidence row and the any-host CPU proof row are
+        # required, and each must resolve to a positive target.
+        assert name in slo.TARGETS, (
+            f"{name} has no SLO target row (its tail latency would "
+            "never be judged)"
+        )
+        for row in slo.REQUIRED_ROWS:
+            ms = slo.TARGETS[name].get(row)
+            assert isinstance(ms, (int, float)) and ms > 0, (
+                name, row, ms
+            )
+        t, basis = slo.resolve_target_s(name, "cpu", "probe")
+        assert t and t > 0 and basis == "exact", (name, t, basis)
 
 
 def test_derived_kernels_are_registered_and_tunable_through_base():

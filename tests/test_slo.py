@@ -1,0 +1,369 @@
+"""CPU suite for the latency-SLO layer (docs/OBSERVABILITY.md
+§latency SLOs; ISSUE 8).
+
+Covers the tentpole contracts without a TPU: deterministic arrivals
+(same ``TPK_LOADGEN_SEED`` => byte-identical request schedule and
+identical histogram buckets across two runs), the log-bucket
+percentile arithmetic, SLO verdict rules (ok / slo_breach / no_data
+with the min-requests floor), the persisted ``slo.json`` artifact's
+loud staleness rejection, the ``obs_report`` rendering + ``--check``
+gating, and the headline claim: an injected ``slow_dispatch`` fault
+surfaces as a p99 breach while the p50 — the slope-style aggregate —
+stays clean, CPU-proven on the real ``registry.dispatch`` path.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from test_distributed import _scrubbed_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOADGEN = os.path.join(REPO, "tools", "loadgen.py")
+
+
+def _load_loadgen():
+    spec = importlib.util.spec_from_file_location("_loadgen", LOADGEN)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run(args, env_extra=None, timeout=120):
+    env = _scrubbed_env(None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, LOADGEN, *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env,
+    )
+
+
+def _entries(slo_dir):
+    with open(os.path.join(slo_dir, "slo.json")) as f:
+        return json.load(f)["entries"]
+
+
+# ---------------------------------------------------------------- #
+# deterministic arrivals                                            #
+# ---------------------------------------------------------------- #
+
+def test_schedule_byte_identical_per_seed(tmp_path):
+    """Same TPK_LOADGEN_SEED => byte-identical request schedule
+    (stdout of --print-schedule, literally); a different seed
+    differs. No jax, no dispatch."""
+    args = ["--mix", "all", "--arrivals", "bursty", "--rate", "40",
+            "--requests", "64", "--print-schedule"]
+    a = _run(args, {"TPK_LOADGEN_SEED": "7"})
+    b = _run(args, {"TPK_LOADGEN_SEED": "7"})
+    c = _run(args, {"TPK_LOADGEN_SEED": "8"})
+    assert a.returncode == b.returncode == c.returncode == 0, (
+        a.stderr, b.stderr, c.stderr)
+    assert a.stdout == b.stdout
+    assert a.stdout != c.stdout
+    assert len(a.stdout.splitlines()) == 64
+
+
+def test_simulated_buckets_identical_across_runs(tmp_path):
+    """Two --simulate runs with one seed land IDENTICAL histogram
+    buckets and percentiles in slo.json (virtual clock: the full
+    schedule -> histogram -> verdict pipeline is deterministic)."""
+    rows = {}
+    for tag in ("a", "b"):
+        d = tmp_path / tag
+        d.mkdir()
+        r = _run(
+            ["--mix", "scan=1,sgemm=2", "--arrivals", "diurnal",
+             "--rate", "80", "--requests", "150", "--simulate", "4"],
+            {"TPK_LOADGEN_SEED": "11", "TPK_SLO_DIR": str(d),
+             "TPK_HEALTH_JOURNAL": str(d / "health.jsonl")},
+        )
+        assert r.returncode == 0, r.stderr
+        rows[tag] = {
+            k: {f: e[f] for f in ("buckets", "count", "p50_s",
+                                  "p95_s", "p99_s", "max_s",
+                                  "verdict", "simulated")}
+            for k, e in _entries(str(d)).items()
+        }
+    assert rows["a"] == rows["b"]
+    # simulated runs live in their own |sim keyspace — they can never
+    # overwrite (and thereby un-gate) a real measurement's verdict
+    assert set(rows["a"]) == {"scan|probe|cpu|sim",
+                              "sgemm|probe|cpu|sim"}
+    for e in rows["a"].values():
+        assert e["simulated"] is True
+
+
+def test_arrival_processes_and_mix():
+    lg = _load_loadgen()
+    mix = {"scan": 3.0, "sgemm": 1.0}
+    for arrivals in lg.ARRIVALS:
+        sched = lg.build_schedule(5, arrivals, 50.0, 200, None, mix)
+        assert len(sched) == 200
+        ts = [t for t, _k in sched]
+        assert ts == sorted(ts) and ts[0] > 0
+        kinds = {k for _t, k in sched}
+        assert kinds == set(mix)
+        # the 3:1 weight must show (binomial slack is generous)
+        n_scan = sum(1 for _t, k in sched if k == "scan")
+        assert n_scan > 100
+    # duration bounds an unbounded request count
+    sched = lg.build_schedule(5, "poisson", 50.0, 0, 2.0, mix)
+    assert sched and all(t <= 2.0 for t, _k in sched)
+    with pytest.raises(ValueError, match="duration"):
+        lg.build_schedule(5, "poisson", 50.0, 0, None, mix)
+    with pytest.raises(ValueError, match="unknown arrival"):
+        lg.build_schedule(5, "uniform", 50.0, 10, None, mix)
+
+
+# ---------------------------------------------------------------- #
+# log-bucket percentiles                                            #
+# ---------------------------------------------------------------- #
+
+def test_percentiles_count_weighted_and_clamped():
+    from tpukernels.obs import metrics
+
+    metrics.reset()
+    try:
+        # 95 fast samples + five 2 s outliers: p50/p95 (ranks 50/95)
+        # read the fast bucket's upper bound, p99 (rank 99) lands in
+        # the outlier bucket but clamps to the EXACT max
+        for _ in range(95):
+            metrics.observe("lat", 0.001)
+        for _ in range(5):
+            metrics.observe("lat", 2.0)
+        h = metrics.snapshot()["histograms"]["lat"]
+        assert h["count"] == 100 and h["max"] == 2.0
+        fast_upper = metrics.bucket_upper(metrics.bucket_index(0.001))
+        assert h["p50"] == h["p95"] == round(fast_upper, 6)
+        assert h["p50"] < 0.0015
+        assert h["p99"] == 2.0
+        # non-positive samples collapse into the sentinel bucket and
+        # report 0.0, never a math domain error
+        metrics.observe("z", 0.0)
+        metrics.observe("z", -1.0)
+        hz = metrics.snapshot()["histograms"]["z"]
+        assert hz["p99"] == 0.0
+        assert list(hz["buckets"]) == [str(metrics.bucket_index(0.0))]
+    finally:
+        metrics.reset()
+
+
+# ---------------------------------------------------------------- #
+# verdict rules + artifact staleness                                #
+# ---------------------------------------------------------------- #
+
+def _hists_for(kernel, values):
+    from tpukernels.obs import metrics
+
+    metrics.reset()
+    for v in values:
+        metrics.observe(f"slo.latency_s.{kernel}", v)
+    hists = metrics.snapshot()["histograms"]
+    metrics.reset()
+    from tpukernels.obs import slo
+
+    return slo.histograms_by_kernel(hists)
+
+
+def test_judge_ok_breach_and_min_requests(monkeypatch, tmp_path):
+    from tpukernels.obs import slo
+
+    journal_path = tmp_path / "health.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(journal_path))
+    target, _basis = slo.resolve_target_s("scan", "cpu", "probe")
+    ok = slo.judge(_hists_for("scan", [target / 100] * 50),
+                   "cpu", "probe")
+    assert ok["scan"]["verdict"] == "ok"
+    # p99 over target (every sample breaches) => slo_breach + journal
+    bad = slo.judge(_hists_for("scan", [target * 4] * 50),
+                    "cpu", "probe")
+    assert bad["scan"]["verdict"] == "slo_breach"
+    ev = [json.loads(line) for line in
+          open(journal_path).read().splitlines()]
+    (breach,) = [e for e in ev if e["kind"] == "slo_breach"]
+    assert breach["kernel"] == "scan" and not breach["simulated"]
+    # a thin tail is no tail: below the min-requests floor => no_data
+    # even when every sample breaches
+    thin = slo.judge(_hists_for("scan", [target * 4] * 5),
+                     "cpu", "probe")
+    assert thin["scan"]["verdict"] == "no_data"
+    assert "min" in thin["scan"]["why"]
+    monkeypatch.setenv("TPK_SLO_MIN_REQUESTS", "5")
+    thick = slo.judge(_hists_for("scan", [target * 4] * 5),
+                      "cpu", "probe")
+    assert thick["scan"]["verdict"] == "slo_breach"
+
+
+def test_target_resolution_and_knobs(monkeypatch):
+    from tpukernels.obs import slo
+
+    exact, basis = slo.resolve_target_s("scan", "cpu", "probe")
+    assert basis == "exact"
+    # unknown TPU kind borrows the v5-lite row, flagged
+    t, basis = slo.resolve_target_s("scan", "tpu_v7", "record")
+    assert basis == "assumed-tpu_v5_lite" and t > 0
+    # unknown non-TPU kind falls back to the cpu row
+    t, basis = slo.resolve_target_s("scan", "gpu_h100", "probe")
+    assert basis == "cpu-fallback" and t == exact
+    monkeypatch.setenv("TPK_SLO_SCALE", "2.0")
+    t2, _ = slo.resolve_target_s("scan", "cpu", "probe")
+    assert t2 == pytest.approx(exact * 2)
+    monkeypatch.setenv("TPK_SLO_SCALE", "-1")
+    with pytest.raises(ValueError, match="TPK_SLO_SCALE"):
+        slo.resolve_target_s("scan", "cpu", "probe")
+    monkeypatch.delenv("TPK_SLO_SCALE")
+    monkeypatch.setenv("TPK_SLO_MIN_REQUESTS", "zero")
+    with pytest.raises(ValueError, match="TPK_SLO_MIN_REQUESTS"):
+        slo.min_requests()
+
+
+def test_stale_slo_entries_rejected_loudly(monkeypatch, tmp_path):
+    """The tuning/aot contract on slo.json: a non-simulated verdict
+    recorded under another jax version is dismissed at read with an
+    slo_rejected event — it can neither gate nor clear a queue."""
+    from tpukernels.obs import slo
+
+    journal_path = tmp_path / "health.jsonl"
+    monkeypatch.setenv("TPK_SLO_DIR", str(tmp_path))
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(journal_path))
+    slo.reset()
+    row = {
+        "verdict": "slo_breach", "count": 50, "p50_s": 1.0,
+        "p95_s": 1.0, "p99_s": 1.0, "max_s": 1.0, "buckets": {},
+        "target_p99_s": 0.1, "basis": "exact", "device_kind": "cpu",
+        "shape_class": "probe", "simulated": False,
+    }
+    slo.record({"scan": dict(row)}, jax_version="0.0.0-stale")
+    assert slo.load_entries() == {}
+    assert slo.breaches() == {}
+    ev = [json.loads(line) for line in
+          open(journal_path).read().splitlines()]
+    (rej,) = [e for e in ev if e["kind"] == "slo_rejected"]
+    assert "0.0.0-stale" in rej["reason"]
+    # a SIMULATED entry skips the jax check (it never ran jax) but
+    # still never gates
+    sim = dict(row, simulated=True)
+    slo.record({"scan": sim}, jax_version=None)
+    entries = slo.load_entries()
+    assert list(entries) == ["scan|probe|cpu|sim"]
+    assert slo.breaches() == {}
+    # and it cannot clear a REAL breach: a current-jax real breach
+    # plus a later simulated run of the same (kernel, class, kind)
+    # coexist under distinct keys — the real one keeps gating
+    import jax
+
+    slo.record({"scan": dict(row)}, jax_version=jax.__version__)
+    slo.record({"scan": dict(sim)}, jax_version=None)
+    assert set(slo.breaches()) == {"scan|probe|cpu"}
+    slo.reset()
+
+
+# ---------------------------------------------------------------- #
+# the headline: slow-dispatch fault => p99 breach, slope clean      #
+# ---------------------------------------------------------------- #
+
+def test_slow_dispatch_fault_breaches_p99_p50_clean(tmp_path):
+    """An injected latency-tail fault (1 s on every 20th dispatch)
+    breaches p99 while p50 — the slope-style aggregate — stays two
+    orders of magnitude under target; obs_report --check flips to
+    rc 1 via slo_breach. An unfaulted run of the same shape stays
+    rc 0. All on the real registry.dispatch path, CPU."""
+    from tpukernels.obs import slo
+
+    fault_dir = tmp_path / "faulted"
+    clean_dir = tmp_path / "clean"
+    fault_dir.mkdir()
+    clean_dir.mkdir()
+    plan = json.dumps(
+        {"slow_dispatch": {"kernel": "scan", "delay_s": 1.0,
+                           "every": 20}}
+    )
+    r = _run(
+        ["--kernel", "scan", "--arrivals", "poisson", "--seed", "7",
+         "--requests", "60", "--rate", "6", "--check"],
+        {"TPK_SLO_DIR": str(fault_dir), "TPK_FAULT_PLAN": plan,
+         "TPK_HEALTH_JOURNAL": str(fault_dir / "health.jsonl")},
+        timeout=300,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "BREACH: scan" in r.stdout
+    entry = _entries(str(fault_dir))["scan|probe|cpu"]
+    target = entry["target_p99_s"]
+    assert entry["verdict"] == "slo_breach"
+    assert entry["p99_s"] > target          # the tail shows the fault
+    assert entry["p50_s"] < target / 10     # the "slope" stays clean
+    # the fault fired and was journaled (self-describing chaos runs)
+    ev = [json.loads(line) for line in
+          open(fault_dir / "health.jsonl").read().splitlines()]
+    assert any(e["kind"] == "fault_injected"
+               and e.get("fault") == "slow_dispatch" for e in ev)
+    assert any(e["kind"] == "slo_probe" for e in ev)
+
+    # gating: the breach artifact flips obs_report --check to rc 1...
+    env = _scrubbed_env(None)
+    env["TPK_SLO_DIR"] = str(fault_dir)
+    chk = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert chk.returncode == 1, chk.stdout + chk.stderr
+    assert "slo_breach" in chk.stdout
+
+    # ...and an unfaulted run of the same shape stays rc 0
+    r = _run(
+        ["--kernel", "scan", "--arrivals", "poisson", "--seed", "7",
+         "--requests", "30", "--rate", "10", "--check"],
+        {"TPK_SLO_DIR": str(clean_dir),
+         "TPK_HEALTH_JOURNAL": str(clean_dir / "health.jsonl")},
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _entries(str(clean_dir))["scan|probe|cpu"]["verdict"] == "ok"
+    env["TPK_SLO_DIR"] = str(clean_dir)
+    chk = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+
+
+def test_obs_report_renders_slo_section(tmp_path):
+    """The full report gains a latency-SLO table sourced from the
+    validated artifact; simulated rows are flagged as never gating."""
+    d = tmp_path / "slo"
+    d.mkdir()
+    r = _run(
+        ["--kernel", "sgemm", "--requests", "40", "--rate", "100",
+         "--simulate", "2"],
+        {"TPK_SLO_DIR": str(d),
+         "TPK_HEALTH_JOURNAL": str(d / "health.jsonl")},
+    )
+    assert r.returncode == 0, r.stderr
+    env = _scrubbed_env(None)
+    env["TPK_SLO_DIR"] = str(d)
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py")],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert rep.returncode in (0, 1), rep.stderr
+    assert "latency SLOs" in rep.stdout
+    assert "sgemm" in rep.stdout
+    assert "simulated - never gates" in rep.stdout
+
+
+def test_loadgen_usage_errors():
+    assert _run(["--bogus"]).returncode == 2
+    assert _run(["--rate"]).returncode == 2
+    assert _run(["--shapes", "tiny"]).returncode == 2
+    assert _run(["--arrivals", "diurnal", "--period", "0",
+                 "--requests", "5", "--print-schedule"]).returncode == 2
+    r = _run(["--kernel", "not_a_kernel", "--print-schedule"])
+    assert r.returncode == 2
+    assert "unknown kernel" in r.stderr

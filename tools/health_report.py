@@ -11,8 +11,13 @@ partial-result decision, invalidation, evidence rejection, injected
 fault, tuning decision, span and metrics snapshot; this report
 reconstructs what a flapping session DID — which metrics were banked
 before the wedge, what the watchdogs killed, what the gate rejected
-and why, where the wall time went (per-phase span breakdown) — from
-the journal alone, replacing grep-the-stderr postmortems.
+and why, where the wall time went (per-phase span breakdown), which
+SLO probes ran and whether any p99 breached — from the journal
+alone, replacing grep-the-stderr postmortems.
+
+Exit codes: 0 — report rendered (its findings, including SLO
+breaches, are narrative: gating belongs to ``tools/obs_report.py
+--check``); 1 — no journal found.
 """
 
 from __future__ import annotations
@@ -25,6 +30,7 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from tpukernels.obs import slo as _slo  # noqa: E402
 from tpukernels.obs import trace as _trace  # noqa: E402
 from tpukernels.resilience import journal as _journal  # noqa: E402
 
@@ -228,6 +234,29 @@ def _fmt(ev):
                 f"{ev.get('kernel')}: {ev.get('memo_dropped')} memo "
                 f"entr(ies), {len(ev.get('manifest_dropped') or [])} "
                 "manifest entr(ies)")
+    if kind == "slo_probe":
+        v = ev.get("verdicts") or {}
+        breached = sorted(
+            k for k, r in v.items()
+            if isinstance(r, dict) and r.get("verdict") == "slo_breach"
+        )
+        return (f"{ts} [pid {pid}] slo probe: {ev.get('requests')} "
+                f"request(s), {ev.get('arrivals')} arrivals seed "
+                f"{ev.get('seed')}, {ev.get('shape_class')} shapes on "
+                f"{ev.get('device_kind')}"
+                + (" (SIMULATED)" if ev.get("simulated") else "")
+                + (f" - BREACH: {','.join(breached)}" if breached
+                   else " - tails within target"))
+    if kind == "slo_breach":
+        return (f"{ts} [pid {pid}] SLO BREACH: {ev.get('kernel')} p99 "
+                f"{_slo.fmt_ms(ev.get('p99_s'))} > target "
+                f"{_slo.fmt_ms(ev.get('target_p99_s'))} over "
+                f"{ev.get('count')} request(s)"
+                + (" (simulated - never gates)"
+                   if ev.get("simulated") else ""))
+    if kind == "slo_rejected":
+        return (f"{ts} [pid {pid}] slo verdict REJECTED "
+                f"{ev.get('key')}: {ev.get('reason')}")
     if kind == "tuning_resolved":
         return (f"{ts} [pid {pid}] tuning resolved for "
                 f"{ev.get('kernel')}: {ev.get('params')} "
@@ -371,7 +400,8 @@ def summarize(events, bad=0) -> str:
         f"{counts.get('fault_injected', 0)} injected fault(s), "
         f"{counts.get('step_quarantined', 0)} quarantined step(s), "
         f"{counts.get('output_integrity_failed', 0)} output-integrity "
-        "failure(s)"
+        "failure(s), "
+        f"{counts.get('slo_breach', 0)} SLO breach(es)"
     )
     return "\n".join(out)
 

@@ -1,0 +1,414 @@
+"""Open-loop load generator: drive the serving path, judge the tail.
+
+Usage:
+    python tools/loadgen.py --kernel sgemm --arrivals poisson \\
+                            --seed 7 --requests 200
+    python tools/loadgen.py --mix all --arrivals bursty --duration 60
+    python tools/loadgen.py --mix sgemm=3,scan=1 --rate 10 \\
+                            --requests 120 --shapes record
+    python tools/loadgen.py --requests 200 --simulate 5   # no jax
+    python tools/loadgen.py --requests 200 --print-schedule
+
+bench.py measures steady-state slope throughput; a service is judged
+on per-request latency under bursty arrivals — queueing, compile
+leaks and cache eviction hide behind a healthy slope and surface in
+p99 (docs/OBSERVABILITY.md §latency SLOs). This tool generates a
+deterministic OPEN-LOOP arrival schedule (arrivals never wait for
+service — when dispatch stalls, later requests queue and their
+latency counts the wait, so coordinated omission cannot hide a
+stall), drives ``registry.dispatch`` (the serving path of record
+until the daemon lands), records per-request latency into the
+log-bucketed ``slo.latency_s.<kernel>`` histograms
+(``tpukernels/obs/metrics.py``), judges them against the per-kernel
+SLO targets (``tpukernels/obs/slo.py``) and persists the verdicts
+into the ``slo.json`` artifact that ``tools/obs_report.py --check``
+gates on.
+
+Arrival processes (all seeded — ``--seed`` beats ``TPK_LOADGEN_SEED``
+beats 0; no wall-clock randomness, so the same seed yields a
+byte-identical request schedule):
+    poisson — exponential inter-arrival gaps at ``--rate`` req/s.
+    bursty  — on/off modulated Poisson: 1 s at 1.8x rate, 1 s at
+              0.2x rate (mean ~= rate) — the queueing stressor.
+    diurnal — sinusoidally ramped rate (0.25x..1.75x over
+              ``--period`` s, default 60) — the slow-swell shape.
+
+Shape classes: ``probe`` (default) uses the integrity layer's small
+deterministic canary operands — CPU-fast, the 60-second supervisor
+probe and the CI proof; ``record`` materializes the registered
+``aot.BENCH_CONFIGS`` avatar shapes — the real serving shapes, for
+chip windows. ``--mix all`` spreads requests uniformly over every
+registry kernel; ``k1=w1,k2=w2`` weights them.
+
+``--simulate MS`` replaces dispatch with a deterministic virtual
+clock (single-server queue, seeded service times around MS; no jax
+import): the plumbing/determinism proof. Simulated verdicts are
+persisted flagged ``simulated`` and NEVER gate.
+
+This process defaults ``TPK_INTEGRITY=tripwire`` (an explicit env
+choice wins): the sampled oracle canary checks would inject periodic
+multi-ms outliers into exactly the tail this tool measures.
+
+Exit codes: 0 — run completed (verdicts, including breaches, are the
+artifact's job; gating belongs to ``obs_report --check``);
+1 — with ``--check``, at least one non-simulated ``slo_breach``
+verdict this run; 2 — usage error.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpukernels import _cachedir  # noqa: E402
+
+# env-before-jax-import contract (tpukernels/_cachedir.py): the CLI
+# may compile on a cold cache; journal routing mirrors bench.py's and
+# revalidate.py's CLI default so the slo_probe event lands in the
+# day's health journal.
+_cachedir.ensure_compilation_cache()
+
+from tpukernels.obs import metrics as obs_metrics  # noqa: E402
+from tpukernels.obs import slo, trace  # noqa: E402
+from tpukernels.resilience import journal  # noqa: E402
+
+ARRIVALS = ("poisson", "bursty", "diurnal")
+DEFAULT_RATE = 20.0
+
+
+def default_seed() -> int:
+    """``TPK_LOADGEN_SEED`` (fail-loud parse), else 0 — the
+    deterministic-schedule contract forbids wall-clock seeding."""
+    raw = os.environ.get("TPK_LOADGEN_SEED")
+    if raw is None:
+        return 0
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"TPK_LOADGEN_SEED={raw!r}: expected an integer"
+        ) from None
+
+
+def _rate_at(arrivals: str, rate: float, t: float, period: float):
+    if arrivals == "bursty":
+        # 1 s on at 1.8x, 1 s off at 0.2x: mean ~= rate, tail rich
+        return rate * (1.8 if (t % 2.0) < 1.0 else 0.2)
+    if arrivals == "diurnal":
+        return rate * (0.25 + 1.5 * math.sin(math.pi * t / period) ** 2)
+    return rate
+
+
+def build_schedule(seed: int, arrivals: str, rate: float,
+                   requests: int, duration: float | None,
+                   mix: dict, period: float = 60.0) -> list:
+    """[(t_offset_s, kernel), ...] — the whole run, precomputed from
+    the seed alone. Stops at ``requests`` arrivals or ``duration``
+    schedule seconds, whichever comes first (requests=0 = unbounded,
+    duration must then bound the run)."""
+    if arrivals not in ARRIVALS:
+        raise ValueError(
+            f"unknown arrival process {arrivals!r}; known: {ARRIVALS}"
+        )
+    if requests <= 0 and not duration:
+        raise ValueError(
+            "loadgen: --requests 0 needs --duration to bound the run"
+        )
+    rng = random.Random(seed)
+    kernels = sorted(mix)
+    weights = [mix[k] for k in kernels]
+    out, t = [], 0.0
+    while True:
+        if requests > 0 and len(out) >= requests:
+            break
+        t += rng.expovariate(_rate_at(arrivals, rate, t, period))
+        if duration and t > duration:
+            break
+        out.append((t, rng.choices(kernels, weights)[0]))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# operand sets per shape class                                       #
+# ------------------------------------------------------------------ #
+
+def _probe_operands(kernel):
+    """The integrity layer's deterministic small canary operands
+    (one authority — the same shapes the guard's oracle checks run),
+    converted to device arrays with host scalars canonicalized the
+    way the dispatch memo expects."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpukernels.resilience import integrity
+
+    args = integrity._build_args(kernel)
+    statics = dict(integrity.CANARY_CONFIGS[kernel]["statics"])
+    jargs = tuple(
+        jnp.asarray(a) if isinstance(a, np.ndarray)
+        else jnp.float32(a) if isinstance(a, float)
+        else jnp.int32(a)
+        for a in args
+    )
+    return jargs, statics
+
+
+def _record_operands(kernel):
+    """The registered BENCH_CONFIGS avatar config materialized as
+    concrete operands (values are irrelevant to latency; ones keep
+    every kernel's output finite for the tripwire)."""
+    import jax.numpy as jnp
+
+    from tpukernels import aot
+
+    spec = aot.BENCH_CONFIGS[kernel]
+    dt = {"f32": jnp.float32, "i32": jnp.int32}
+    jargs = tuple(
+        dt[kind](1) if shape == () else jnp.ones(shape, dt[kind])
+        for kind, shape in spec["args"]
+    )
+    return jargs, dict(spec["statics"])
+
+
+def _operands(kernel, shape_class):
+    return (_record_operands if shape_class == "record"
+            else _probe_operands)(kernel)
+
+
+# ------------------------------------------------------------------ #
+# execution                                                          #
+# ------------------------------------------------------------------ #
+
+def run_simulated(schedule, seed: int, service_ms: float) -> None:
+    """Deterministic virtual-clock replay: one single-server queue,
+    service times drawn from a second seeded stream around
+    ``service_ms``. No dispatch, no jax — latency = completion -
+    scheduled arrival, exactly the open-loop arithmetic of the real
+    path, so two runs with one seed produce identical histogram
+    buckets (the determinism proof)."""
+    rng = random.Random(seed ^ 0x510510)
+    free_at = 0.0
+    for t, kernel in schedule:
+        service = service_ms / 1000.0 * (0.5 + rng.random())
+        start = max(t, free_at)
+        free_at = start + service
+        obs_metrics.inc(f"slo.requests.{kernel}")
+        obs_metrics.observe(f"slo.latency_s.{kernel}", free_at - t)
+        obs_metrics.observe(f"slo.service_s.{kernel}", service)
+
+
+def run_real(schedule, shape_class: str, echo) -> None:
+    """Drive ``registry.dispatch`` through the schedule, open-loop:
+    sleep until each request's scheduled arrival (never past it —
+    when service falls behind, later requests run back-to-back and
+    their recorded latency includes the queue wait). Each kernel's
+    (operands, statics) is built once and warmed with one untimed
+    dispatch: the SLO judges the WARM path of record the AOT layer
+    bought (a cold compile is prewarm's job, not a tail sample)."""
+    import jax
+
+    from tpukernels import registry
+
+    prepared = {}
+    for kernel in sorted({k for _t, k in schedule}):
+        prepared[kernel] = _operands(kernel, shape_class)
+        jargs, statics = prepared[kernel]
+        w0 = time.perf_counter()
+        jax.block_until_ready(registry.dispatch(kernel, *jargs, **statics))
+        echo(f"# warmed {kernel} in {time.perf_counter() - w0:.3f}s")
+    t0 = time.perf_counter()
+    for t, kernel in schedule:
+        now = time.perf_counter() - t0
+        if t > now:
+            time.sleep(t - now)
+        jargs, statics = prepared[kernel]
+        s0 = time.perf_counter()
+        jax.block_until_ready(registry.dispatch(kernel, *jargs, **statics))
+        s1 = time.perf_counter()
+        obs_metrics.inc(f"slo.requests.{kernel}")
+        obs_metrics.observe(f"slo.latency_s.{kernel}", (s1 - t0) - t)
+        obs_metrics.observe(f"slo.service_s.{kernel}", s1 - s0)
+
+
+def _parse_mix(raw: str | None, kernel: str | None) -> dict:
+    from tpukernels import aot
+
+    known = sorted(aot.BENCH_CONFIGS)
+    if kernel is not None:
+        if kernel not in known:
+            raise ValueError(
+                f"unknown kernel {kernel!r}; known: {known}"
+            )
+        return {kernel: 1.0}
+    if raw in (None, "all"):
+        return {k: 1.0 for k in known}
+    mix = {}
+    for part in raw.split(","):
+        name, _, w = part.partition("=")
+        name = name.strip()
+        if name not in known:
+            raise ValueError(
+                f"unknown kernel {name!r} in --mix; known: {known}"
+            )
+        mix[name] = float(w) if w else 1.0
+    return mix
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    kernel = mix_raw = None
+    arrivals, rate, requests = "poisson", DEFAULT_RATE, 200
+    duration = simulate_ms = None
+    seed = None
+    shape_class, period = "probe", 60.0
+    print_schedule = check = False
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--kernel":
+                kernel = next(it)
+            elif a == "--mix":
+                mix_raw = next(it)
+            elif a == "--arrivals":
+                arrivals = next(it)
+            elif a == "--rate":
+                rate = float(next(it))
+            elif a == "--requests":
+                requests = int(next(it))
+            elif a == "--duration":
+                duration = float(next(it))
+            elif a == "--period":
+                period = float(next(it))
+            elif a == "--seed":
+                seed = int(next(it))
+            elif a == "--shapes":
+                shape_class = next(it)
+            elif a == "--simulate":
+                simulate_ms = float(next(it))
+            elif a == "--print-schedule":
+                print_schedule = True
+            elif a == "--check":
+                check = True
+            else:
+                print(__doc__, file=sys.stderr)
+                print(f"loadgen: unknown argument {a!r}", file=sys.stderr)
+                return 2
+    except StopIteration:
+        print(f"loadgen: {a} requires a value", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"loadgen: bad value for {a}: {e}", file=sys.stderr)
+        return 2
+    if shape_class not in ("probe", "record"):
+        print(f"loadgen: --shapes {shape_class!r} (known: probe, "
+              "record)", file=sys.stderr)
+        return 2
+    if rate <= 0:
+        print("loadgen: --rate must be > 0", file=sys.stderr)
+        return 2
+    if period <= 0:
+        print("loadgen: --period must be > 0", file=sys.stderr)
+        return 2
+    try:
+        if seed is None:
+            seed = default_seed()
+        mix = _parse_mix(mix_raw, kernel)
+        schedule = build_schedule(
+            seed, arrivals, rate, requests, duration, mix, period
+        )
+    except ValueError as e:
+        print(f"loadgen: {e}", file=sys.stderr)
+        return 2
+
+    if print_schedule:
+        # the byte-identical determinism surface: microsecond-rounded
+        # offsets, no wall clock, no dispatch, no jax
+        for t, k in schedule:
+            print(f"{t:.6f} {k}")
+        return 0
+
+    # CLI journal default (the bench.py/revalidate.py contract) — the
+    # slo_probe evidence must land in the day's health journal
+    if os.environ.get("TPK_HEALTH_JOURNAL") is None:
+        os.environ["TPK_HEALTH_JOURNAL"] = journal.default_path()
+    # sampled oracle canaries are multi-ms outliers in exactly the
+    # tail this tool measures; the always-on tripwire stays
+    os.environ.setdefault("TPK_INTEGRITY", "tripwire")
+
+    echo = lambda line: print(line)  # noqa: E731
+    t_run0 = time.perf_counter()
+    with trace.span("loadgen/run", arrivals=arrivals, seed=seed):
+        if simulate_ms is not None:
+            run_simulated(schedule, seed, simulate_ms)
+            kind = "cpu"
+        else:
+            run_real(schedule, shape_class, echo)
+            from tpukernels.tuning import cache as tcache
+
+            kind = tcache.device_kind()
+    wall = time.perf_counter() - t_run0
+
+    per_kernel = slo.histograms_by_kernel(
+        obs_metrics.snapshot()["histograms"]
+    )
+    verdicts = slo.judge(
+        per_kernel, kind, shape_class,
+        simulated=simulate_ms is not None,
+    )
+    jax_version = None
+    if simulate_ms is None:
+        import jax
+
+        jax_version = jax.__version__
+    run_info = {
+        "arrivals": arrivals, "seed": seed, "rate": rate,
+        "requests": len(schedule), "duration": duration,
+        "wall_s": round(wall, 3),
+    }
+    artifact = slo.record(verdicts, run_info, jax_version=jax_version)
+    journal.emit(
+        "slo_probe", **run_info, device_kind=kind,
+        shape_class=shape_class,
+        simulated=simulate_ms is not None, artifact=artifact,
+        verdicts={
+            k: {"verdict": v["verdict"], "count": v["count"],
+                "p50_s": v["p50_s"], "p99_s": v["p99_s"],
+                "target_p99_s": v["target_p99_s"]}
+            for k, v in verdicts.items()
+        },
+    )
+
+    hdr = (f"{'kernel':<16} {'n':>5} {'p50_ms':>9} {'p95_ms':>9} "
+           f"{'p99_ms':>9} {'max_ms':>9} {'target':>9}  verdict")
+    print(hdr)
+    print("-" * len(hdr))
+
+    def _ms(v):
+        return slo.fmt_ms(v, 9)
+
+    breached = []
+    for k, v in verdicts.items():
+        print(f"{k:<16} {v['count']:>5} {_ms(v['p50_s'])} "
+              f"{_ms(v['p95_s'])} {_ms(v['p99_s'])} {_ms(v['max_s'])} "
+              f"{_ms(v['target_p99_s'])}  {v['verdict']}"
+              + (f" ({v['why']})" if v.get("why") else ""))
+        if v["verdict"] == "slo_breach" and not v["simulated"]:
+            breached.append(k)
+    print(
+        f"loadgen: {len(schedule)} request(s), {arrivals} arrivals, "
+        f"seed {seed}, {shape_class} shapes on {kind}"
+        + (" (SIMULATED)" if simulate_ms is not None else "")
+        + f", wall {wall:.1f}s -> {os.path.relpath(artifact)}"
+        + (f"; BREACH: {','.join(breached)}" if breached else "")
+    )
+    return 1 if (check and breached) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,20 +29,29 @@ Sections (docs/OBSERVABILITY.md):
    quarantined (kernel, config) entries from the
    ``output_integrity_*`` events plus the persistent quarantine
    ledger (docs/RESILIENCE.md §output integrity).
-7. **Metric snapshots** — the last ``metrics`` event per process:
+7. **Latency SLOs** — per-kernel p50/p99 vs target from the
+   validated ``slo.json`` verdict artifact the load generator writes
+   (``tools/loadgen.py`` + ``tpukernels/obs/slo.py``): the
+   tail-latency story the slope trend cannot see.
+8. **Metric snapshots** — the last ``metrics`` event per process:
    counters (probe retries, watchdog kills, tuning-cache traffic),
-   gauges, latency histograms.
+   gauges, latency histograms (count-weighted p50/p95/p99 + exact
+   max).
 
 Exit-code signaling (``tools/tpu_revalidate.sh`` runs ``--check``
 non-gating and keys a WARN off it):
     0 — every metric ``ok``, ``below_roofline`` or ``no_data``
         (nothing measurable went backwards; tunnel-down nulls are
         retryable, and below-roofline is a headroom signal, not a
-        failure) AND the journal holds no confirmed
-        ``output_integrity_failed`` event;
-    1 — at least one ``regression`` or ``impossible`` verdict, or a
+        failure), the journal holds no confirmed
+        ``output_integrity_failed`` event, AND no validated
+        non-simulated ``slo_breach`` verdict is on record;
+    1 — at least one ``regression`` or ``impossible`` verdict, a
         confirmed output-integrity corruption (a wrong answer is
-        worse than a slow one — it gates exactly like a regression).
+        worse than a slow one), or a confirmed p99 SLO breach (a
+        degraded tail is a regression users feel before the slope
+        moves) — all three gate identically;
+    2 — usage error (never 1: rc 1 is reserved for real findings).
 
 ``--check`` prints only the non-ok verdict lines (machine/CI mode;
 ``below_roofline`` lines print as non-gating information); the
@@ -59,6 +68,7 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from tpukernels.obs import slo as _slo  # noqa: E402
 from tpukernels.obs import trace, trend  # noqa: E402
 from tpukernels.resilience import journal as _journal  # noqa: E402
 from tpukernels.tuning import roofline as _roofline  # noqa: E402
@@ -267,6 +277,42 @@ def integrity_section(events, out):
         out.append("  all checks passed")
 
 
+def slo_section(out):
+    """Latency-SLO table from the validated ``slo.json`` verdict
+    artifact (docs/OBSERVABILITY.md §latency SLOs): per (kernel,
+    shape class, device kind) the count-weighted p50/p99 against the
+    target — the per-request tail story the slope trend is blind to.
+    Simulated rows render flagged; only real breaches gate."""
+    try:
+        entries = _slo.load_entries()
+    except Exception:  # noqa: BLE001 — the report must still render
+        entries = {}
+    if not entries:
+        return
+    out.append("")
+    out.append(f"== latency SLOs ({len(entries)} verdict(s) in "
+               f"{os.path.relpath(_slo.path())}) ==")
+    hdr = (f"{'kernel':<16} {'class':<7} {'kind':<12} {'n':>5} "
+           f"{'p50_ms':>9} {'p99_ms':>9} {'target':>9}  verdict")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+
+    def _ms(v):
+        return _slo.fmt_ms(v, 9)
+
+    for key, e in sorted(entries.items()):
+        kernel = key.split("|", 1)[0]
+        out.append(
+            f"{kernel:<16} {e.get('shape_class', '?'):<7} "
+            f"{e.get('device_kind', '?'):<12} "
+            f"{e.get('count', 0):>5} {_ms(e.get('p50_s'))} "
+            f"{_ms(e.get('p99_s'))} {_ms(e.get('target_p99_s'))}  "
+            f"{e.get('verdict')}"
+            + (" (simulated - never gates)" if e.get("simulated")
+               else "")
+        )
+
+
 def metrics_section(events, out):
     snaps = [e for e in events if e.get("kind") == "metrics"]
     out.append("")
@@ -287,10 +333,14 @@ def metrics_section(events, out):
         for k, v in sorted((e.get("gauges") or {}).items()):
             out.append(f"  gauge     {k} = {v}")
         for k, h in sorted((e.get("histograms") or {}).items()):
+            # percentiles come straight off the snapshot (the
+            # emitter's count-weighted derivation — never re-derived
+            # from buckets here)
             out.append(
                 f"  histogram {k}: count={h.get('count')} "
                 f"sum={h.get('sum')} min={h.get('min')} "
-                f"max={h.get('max')}"
+                f"max={h.get('max')} p50={h.get('p50')} "
+                f"p95={h.get('p95')} p99={h.get('p99')}"
             )
 
 
@@ -363,6 +413,29 @@ def main(argv=None):
                 f"{e.get('site')} (tier {e.get('tier')}): "
                 f"{e.get('detail')}"
             )
+        # a CONFIRMED p99 breach gates like a regression: users feel
+        # a degraded tail before the slope moves, and the validated
+        # slo.json artifact is the evidence of record
+        # (docs/OBSERVABILITY.md §latency SLOs). Degrade loudly but
+        # judge only what validates — an unreadable artifact (e.g.
+        # validation's lazy jax import failing on a journal-only
+        # host) must not fake the rc 1 this contract reserves for
+        # real findings, matching slo_section's tolerance.
+        try:
+            breaches = _slo.breaches()
+        except Exception as e:  # noqa: BLE001 — gate what validates
+            print(f"obs_report: slo verdicts unreadable, not judged "
+                  f"({e!r})", file=sys.stderr)
+            breaches = {}
+        for key, e in sorted(breaches.items()):
+            print(
+                f"{key.split('|', 1)[0]}: slo_breach (p99 "
+                f"{_slo.fmt_ms(e.get('p99_s'))} > target "
+                f"{_slo.fmt_ms(e.get('target_p99_s'))} over "
+                f"{e.get('count')} request(s), "
+                f"{e.get('shape_class')} shapes on "
+                f"{e.get('device_kind')})"
+            )
         ok = sum(1 for v in verdicts.values() if v["verdict"] == "ok")
         nodata = sum(
             1 for v in verdicts.values() if v["verdict"] == "no_data"
@@ -371,9 +444,10 @@ def main(argv=None):
             f"obs_report --check: {len(bad)} failing, {ok} ok, "
             f"{len(below)} below-roofline (non-gating), "
             f"{nodata} no-data (no-data is retryable, not a failure), "
-            f"{len(corrupt)} confirmed output-integrity failure(s)"
+            f"{len(corrupt)} confirmed output-integrity failure(s), "
+            f"{len(breaches)} confirmed SLO breach(es)"
         )
-        return 1 if bad or corrupt else 0
+        return 1 if bad or corrupt or breaches else 0
 
     if roofline_only:
         out = []
@@ -389,6 +463,7 @@ def main(argv=None):
     step_section(events, out)
     aot_section(events, out)
     integrity_section(events, out)
+    slo_section(out)
     metrics_section(events, out)
     out.append("")
     if bad:

@@ -89,6 +89,30 @@ fi
 """, gating=False, stamp="daily", timeout_s=3600, cost_min=12,
       value=50, cost_from="prewarm", max_attempts_per_day=2,
       inputs=("tpukernels", "bench.py", "tools/prewarm.py")),
+    # 0b. 60-second tail-latency probe (docs/OBSERVABILITY.md
+    #     §latency SLOs): open-loop Poisson load through
+    #     registry.dispatch at the record avatar shapes, per-request
+    #     latency histograms, p99 verdicts persisted to slo.json.
+    #     Non-gating at first (obs_check picks up a confirmed breach
+    #     as rc 1 WARN); after-edge to prewarm_all so the probe
+    #     measures the warm path, never a cold compile; never
+    #     stamped and cheap enough (cost 2 min, density just under
+    #     bench's) that EVERY healthy window buys a tail-latency
+    #     datapoint, not just a slope.
+    S("slo_probe", """
+set -o pipefail
+slo_log="docs/logs/slo_probe_$(date +%Y-%m-%d_%H%M%S).log"
+if timeout -k 10 120 python tools/loadgen.py --mix all \\
+    --arrivals poisson --duration 60 --rate 8 --requests 0 \\
+    --shapes record >"$slo_log" 2>&1; then
+  tail -1 "$slo_log"
+else
+  echo "WARN: slo probe failed rc=$? (non-gating) - $slo_log"
+  exit 1
+fi
+""", gating=False, stamp="never", timeout_s=150, cost_min=2,
+      value=12, after=("prewarm_all",),
+      inputs=("tpukernels", "tools/loadgen.py")),
     # 1. headline metrics + the 15% self-regression gate; the JSON
     #    line is persisted so an unattended recovery leaves a
     #    committable artifact. Never stamped: its own skip-captured

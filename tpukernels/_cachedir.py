@@ -167,6 +167,26 @@ def integrity_dir(env: dict | None = None) -> str:
     return d
 
 
+def slo_path(env: dict | None = None) -> str:
+    """Path of the latency-SLO verdict artifact (``slo.json``;
+    docs/OBSERVABILITY.md §latency SLOs; ``tpukernels/obs/slo.py``).
+
+    Lives beside the caches whose warm path it judges — one
+    ``slo.json`` per cache dir — unless ``TPK_SLO_DIR`` redirects it
+    (tests and throwaway loadgen runs point it at a tmp dir so a
+    chaos-injected breach can never gate the repo's real
+    ``obs_report --check``). Same read-the-env-per-call rule as the
+    tuning/AOT/integrity paths.
+    """
+    target = os.environ if env is None else env
+    d = target.get("TPK_SLO_DIR")
+    if not d:
+        d = target.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            _REPO, ".jax_cache"
+        )
+    return os.path.join(d, "slo.json")
+
+
 def integrity_manifest_path(env: dict | None = None) -> str:
     return os.path.join(integrity_dir(env), "integrity.json")
 

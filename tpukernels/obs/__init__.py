@@ -16,9 +16,16 @@ resilience layer they build on — docs/OBSERVABILITY.md):
   regressions beyond the ceiling-epsilon band, physically-impossible
   values (the 72,698-GFLOPS class of error), tunnel-down nulls as
   "no data" — never as a regression.
+- ``slo``     — per-kernel latency-SLO targets and the persisted
+  ``slo.json`` verdict artifact: judges the per-request latency
+  histograms ``tools/loadgen.py`` captures under open-loop load
+  (p99 vs target -> ``ok``/``slo_breach``/``no_data``), sha+jax
+  validated like the tuning/aot/integrity caches, gated by
+  ``obs_report --check`` exactly like a regression.
 
-CLI: ``python tools/obs_report.py`` renders the trend table, span and
-metric summaries and the regression verdicts.
+CLI: ``python tools/obs_report.py`` renders the trend table, span,
+metric and latency-SLO summaries and the regression verdicts;
+``python tools/loadgen.py`` generates the load.
 """
 
-from tpukernels.obs import metrics, trace, trend  # noqa: F401
+from tpukernels.obs import metrics, slo, trace, trend  # noqa: F401

@@ -23,9 +23,16 @@ Design constraints, in order:
    C hosts never finalize the interpreter, so ``capi.shutdown_from_c``
    calls :func:`emit_snapshot` explicitly (the same split the
    profiler-flush uses). Only a hard SIGKILL loses the snapshot.
-3. **Histograms are summaries, not buckets.** count/sum/min/max per
-   name (mean derivable) — enough for "where did the wall time go"
-   without inventing bucket boundaries per metric.
+3. **Histograms are streaming: summaries plus log buckets.** Each
+   histogram keeps count/sum/min/max (mean derivable) AND a
+   log-bucketed distribution (base 2^(1/4) ≈ 19%-wide buckets — one
+   shared boundary scheme, so two runs observing the same values
+   produce IDENTICAL buckets, the loadgen determinism contract).
+   Snapshots surface the exact max and count-weighted p50/p95/p99
+   derived from the buckets, so consumers (``tools/health_report.py``,
+   ``tpukernels/obs/slo.py``'s latency-SLO verdicts) read percentiles
+   without re-deriving bucket arithmetic. Memory stays bounded: a
+   bucket per occupied power-of-2^(1/4), never a sample list.
 
 State is per-process (bench ``--one`` children snapshot their own);
 :func:`reset` exists for tests.
@@ -33,11 +40,61 @@ State is per-process (bench ``--one`` children snapshot their own);
 
 from __future__ import annotations
 
+import math
+
 from tpukernels.resilience import journal
 
 _COUNTERS: dict = {}
 _GAUGES: dict = {}
-_HISTS: dict = {}  # name -> [count, sum, min, max]
+_HISTS: dict = {}  # name -> [count, sum, min, max, {bucket: count}]
+
+# log-bucket geometry: index = floor(log(v) / log(2^(1/4))), i.e. four
+# buckets per octave (~19% relative width — tight enough that a p99
+# read off a bucket's upper bound is honest, coarse enough that a
+# long-lived histogram stays tens of buckets). Non-positive samples
+# (clock skew could in principle produce a 0.0 wall) collapse into one
+# sentinel bucket whose upper bound is 0.
+_BUCKET_LOG = math.log(2.0) / 4.0
+_NONPOS_BUCKET = -(1 << 30)
+
+
+def bucket_index(value: float) -> int:
+    """The shared log-bucket index of one sample — exposed so tests
+    and the SLO layer agree with the recorder on boundaries."""
+    if value <= 0.0:
+        return _NONPOS_BUCKET
+    return math.floor(math.log(value) / _BUCKET_LOG)
+
+
+def bucket_upper(idx: int) -> float:
+    """Upper value bound of bucket ``idx`` (0.0 for the non-positive
+    sentinel) — what a count-weighted percentile reports."""
+    if idx == _NONPOS_BUCKET:
+        return 0.0
+    return math.exp((idx + 1) * _BUCKET_LOG)
+
+
+def percentiles(count: int, max_value: float, buckets: dict,
+                qs=(0.5, 0.95, 0.99)) -> list:
+    """Count-weighted percentiles from a log-bucket dict: the value of
+    quantile ``q`` is the upper bound of the bucket holding the
+    ceil(q*count)-th sample, clamped to the EXACT observed max (so
+    p99 of a 10-sample histogram never exceeds its real worst case).
+    Bucket keys may be ints or their str() twins (a snapshot that was
+    through JSON)."""
+    items = sorted((int(k), v) for k, v in buckets.items())
+    out = []
+    for q in qs:
+        rank = max(1, math.ceil(q * count))
+        val = max_value
+        cum = 0
+        for idx, c in items:
+            cum += c
+            if cum >= rank:
+                val = min(bucket_upper(idx), max_value)
+                break
+        out.append(val)
+    return out
 
 
 def inc(name: str, n: float = 1):
@@ -54,7 +111,8 @@ def observe(name: str, value: float):
     """Record one sample into histogram ``name``."""
     h = _HISTS.get(name)
     if h is None:
-        _HISTS[name] = [1, value, value, value]
+        _HISTS[name] = [1, value, value, value,
+                        {bucket_index(value): 1}]
     else:
         h[0] += 1
         h[1] += value
@@ -62,23 +120,36 @@ def observe(name: str, value: float):
             h[2] = value
         if value > h[3]:
             h[3] = value
+        b = bucket_index(value)
+        h[4][b] = h[4].get(b, 0) + 1
+
+
+def _hist_row(v) -> dict:
+    p50, p95, p99 = percentiles(v[0], v[3], v[4])
+    return {
+        "count": v[0],
+        "sum": round(v[1], 6),
+        "min": round(v[2], 6),
+        "max": round(v[3], 6),
+        "p50": round(p50, 6),
+        "p95": round(p95, 6),
+        "p99": round(p99, 6),
+        # str keys: the snapshot rides a JSON journal event, and a
+        # round-tripped consumer must read the same dict shape the
+        # in-process one does
+        "buckets": {str(i): c for i, c in sorted(v[4].items())},
+    }
 
 
 def snapshot() -> dict:
     """Copy of the current state: ``{"counters": {...}, "gauges":
-    {...}, "histograms": {name: {count, sum, min, max}}}``."""
+    {...}, "histograms": {name: {count, sum, min, max, p50, p95, p99,
+    buckets}}}`` — max is exact, p50/p95/p99 are count-weighted from
+    the log buckets (clamped to max)."""
     return {
         "counters": dict(_COUNTERS),
         "gauges": dict(_GAUGES),
-        "histograms": {
-            k: {
-                "count": v[0],
-                "sum": round(v[1], 6),
-                "min": round(v[2], 6),
-                "max": round(v[3], 6),
-            }
-            for k, v in _HISTS.items()
-        },
+        "histograms": {k: _hist_row(v) for k, v in _HISTS.items()},
     }
 
 

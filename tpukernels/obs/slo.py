@@ -1,0 +1,372 @@
+"""Per-kernel latency-SLO targets + the persisted verdict artifact
+(docs/OBSERVABILITY.md §latency SLOs).
+
+Every number the stack observed before this module was steady-state
+slope throughput; a service for millions of users is judged on
+per-request latency under bursty arrivals — queueing, compile leaks
+and cache eviction all hide behind a healthy slope and all show up in
+p99. This module is the judging half of the latency-SLO layer
+(``tools/loadgen.py`` is the measuring half):
+
+- :data:`TARGETS` — per-kernel p99 wall-time targets, stated per
+  ``device_kind|shape_class`` row exactly the way the roofline model
+  states peaks per device kind (``tuning/roofline.py`` is the
+  sibling table). The evidence rows of record are ``tpu_v5_lite|
+  record`` (the BENCH_CONFIGS avatar shapes on the chip the BASELINE
+  medians came from — PROVISIONAL until a chip session captures real
+  tails) and ``cpu|probe`` (the integrity-canary probe shapes on any
+  host, sized generously above measured warm-dispatch walls so a
+  clean CPU run never false-breaches). The registry completeness
+  lint (``tests/test_registry_contract.py``) requires both rows for
+  every registry kernel.
+- :func:`judge` — turns captured latency histograms (the log-bucketed
+  ``slo.latency_s.<kernel>`` histograms ``obs/metrics.py`` records)
+  into per-kernel verdicts: ``ok`` / ``slo_breach`` (count-weighted
+  p99 over target) / ``no_data`` (fewer than
+  ``TPK_SLO_MIN_REQUESTS`` samples — a thin tail is no tail). A
+  confirmed breach emits an ``slo_breach`` journal event.
+- :func:`record` / :func:`load_entries` — the persisted ``slo.json``
+  verdict artifact (path via ``TPK_SLO_DIR``, beside tuning.json/
+  aot.json/integrity.json), entries keyed
+  ``kernel|shape_class|device_kind`` (simulated runs under their own
+  ``|sim``-suffixed keys so a plumbing proof can never overwrite — and
+  thereby un-gate — a real verdict) and validated at READ time
+  against the jax version and the sha of the last commit touching the
+  kernel's sources — a stale verdict is LOUDLY rejected
+  (``slo_rejected`` stderr note + journal event, the
+  tuning/aot/integrity contract), never silently trusted.
+  ``simulated`` entries (loadgen ``--simulate`` runs: virtual clock,
+  no jax) are persisted for plumbing proofs but NEVER gate.
+- :func:`breaches` — the gating surface: ``tools/obs_report.py
+  --check`` exits 1 on any validated, non-simulated ``slo_breach``
+  entry, exactly the way it gates ``regression`` and
+  ``output_integrity_failed``.
+
+Stdlib-only at import time, like the rest of ``tpukernels.obs``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from tpukernels import _cachedir
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.resilience import journal
+
+DEFAULT_MIN_REQUESTS = 20
+
+# The device rows every kernel must state (contract-lint floor):
+# the chip evidence row and the any-host CPU proof row.
+EVIDENCE_ROW = "tpu_v5_lite|record"
+CPU_ROW = "cpu|probe"
+REQUIRED_ROWS = (CPU_ROW, EVIDENCE_ROW)
+
+# Per-kernel p99 targets in MILLISECONDS per "device_kind|shape_class"
+# row. cpu|probe rows are calibrated ~1000x above the measured warm
+# interpret-mode dispatch walls (sub-ms for most kernels, ~25 ms for
+# the MXU-nibble histogram family) so OS scheduler hiccups on a busy
+# CI host never false-breach, while an injected slow-dispatch fault
+# (docs/RESILIENCE.md §fault plans) breaches unambiguously.
+# tpu_v5_lite|record rows are PROVISIONAL: derived from the
+# BASELINE.json medians' per-pass walls plus a generous dispatch
+# margin, to be re-anchored by the supervisor's slo_probe step once a
+# healthy window captures a real tail.
+TARGETS = {
+    "vector_add": {CPU_ROW: 400.0, EVIDENCE_ROW: 10.0},
+    "sgemm": {CPU_ROW: 400.0, EVIDENCE_ROW: 50.0},
+    "stencil2d": {CPU_ROW: 400.0, EVIDENCE_ROW: 300.0},
+    "stencil3d": {CPU_ROW: 400.0, EVIDENCE_ROW: 800.0},
+    "scan": {CPU_ROW: 400.0, EVIDENCE_ROW: 60.0},
+    "scan_exclusive": {CPU_ROW: 400.0, EVIDENCE_ROW: 60.0},
+    "histogram": {CPU_ROW: 1500.0, EVIDENCE_ROW: 80.0},
+    "scan_histogram": {CPU_ROW: 1500.0, EVIDENCE_ROW: 120.0},
+    "nbody": {CPU_ROW: 400.0, EVIDENCE_ROW: 300.0},
+}
+
+_REJECT_NOTED: set = set()
+_FILE_MEMO: dict = {}
+
+
+def path() -> str:
+    return _cachedir.slo_path()
+
+
+def reset():
+    """Drop per-process state (tests)."""
+    _REJECT_NOTED.clear()
+    _FILE_MEMO.clear()
+
+
+def scale() -> float:
+    """Target multiplier (``TPK_SLO_SCALE``, default 1.0) — how an
+    operator widens every target on a known-slow host without editing
+    the table. Fail-loud parse, the TPK_* knob contract."""
+    raw = os.environ.get("TPK_SLO_SCALE")
+    if raw is None:
+        return 1.0
+    try:
+        val = float(raw)
+    except ValueError:
+        val = -1.0
+    if val <= 0.0:
+        raise ValueError(
+            f"TPK_SLO_SCALE={raw!r}: expected a float > 0"
+        )
+    return val
+
+
+def min_requests() -> int:
+    """Samples below which a histogram judges ``no_data``
+    (``TPK_SLO_MIN_REQUESTS``, default 20): p99 of a handful of
+    requests is an anecdote, not a tail."""
+    raw = os.environ.get("TPK_SLO_MIN_REQUESTS")
+    if raw is None:
+        return DEFAULT_MIN_REQUESTS
+    try:
+        val = int(raw)
+    except ValueError:
+        val = 0
+    if val < 1:
+        raise ValueError(
+            f"TPK_SLO_MIN_REQUESTS={raw!r}: expected an int >= 1"
+        )
+    return val
+
+
+def resolve_target_s(kernel: str, kind: str, shape_class: str):
+    """(target_seconds, basis) for one kernel on one device kind and
+    shape class, or (None, reason) when no row applies. Resolution
+    mirrors ``roofline.resolve_kind``: an exact ``kind|class`` row
+    wins; an unknown TPU kind borrows the v5-lite row (basis flagged
+    ``assumed-...``); anything else falls back to the cpu row for the
+    same shape class. The ``TPK_SLO_SCALE`` multiplier applies last."""
+    rows = TARGETS.get(kernel)
+    if not rows:
+        return None, "no-target-row"
+    key = f"{kind}|{shape_class}"
+    basis = "exact"
+    if key not in rows:
+        if kind.startswith("tpu"):
+            key, basis = f"tpu_v5_lite|{shape_class}", "assumed-tpu_v5_lite"
+        else:
+            key, basis = f"cpu|{shape_class}", "cpu-fallback"
+    ms = rows.get(key)
+    if not isinstance(ms, (int, float)):
+        return None, f"no-row-for-{key}"
+    return ms / 1000.0 * scale(), basis
+
+
+def fmt_ms(v, width: int | None = None) -> str:
+    """Milliseconds rendering shared by every SLO report surface
+    (loadgen's table, obs_report's section/--check lines,
+    health_report's narration) — one precision/placeholder rule, so
+    the surfaces cannot drift apart. ``width`` column-aligns
+    (``-`` placeholder); without it the compact ``12.3ms`` form
+    (``?`` placeholder)."""
+    if not isinstance(v, (int, float)):
+        return f"{'-':>{width}}" if width else "?"
+    if width:
+        return f"{v * 1e3:{width}.2f}"
+    return f"{v * 1e3:.1f}ms"
+
+
+LATENCY_PREFIX = "slo.latency_s."
+
+
+def histograms_by_kernel(hists: dict) -> dict:
+    """{kernel: histogram_row} for the ``slo.latency_s.<kernel>``
+    histograms inside one metrics snapshot (``metrics.snapshot()``
+    shape, or the same dict off a ``metrics`` journal event)."""
+    return {
+        name[len(LATENCY_PREFIX):]: row
+        for name, row in (hists or {}).items()
+        if name.startswith(LATENCY_PREFIX)
+    }
+
+
+def judge(per_kernel: dict, kind: str, shape_class: str,
+          simulated: bool = False) -> dict:
+    """Per-kernel verdict rows over captured latency histograms.
+
+    ``per_kernel`` is :func:`histograms_by_kernel` output. Each row
+    carries the count-weighted p50/p95/p99, the exact max, the
+    resolved target and one of the three verdicts. A confirmed breach
+    (enough samples, p99 over target) emits an ``slo_breach`` journal
+    event and bumps ``slo.breaches`` — the journal twin of the
+    persisted artifact row."""
+    floor = min_requests()
+    out = {}
+    for kernel in sorted(per_kernel):
+        h = per_kernel[kernel]
+        count = int(h.get("count") or 0)
+        target_s, basis = resolve_target_s(kernel, kind, shape_class)
+        row = {
+            "kernel": kernel,
+            "count": count,
+            "p50_s": h.get("p50"),
+            "p95_s": h.get("p95"),
+            "p99_s": h.get("p99"),
+            "max_s": h.get("max"),
+            "buckets": h.get("buckets") or {},
+            "target_p99_s": target_s,
+            "basis": basis,
+            "device_kind": kind,
+            "shape_class": shape_class,
+            "simulated": bool(simulated),
+        }
+        if target_s is None or count < floor or row["p99_s"] is None:
+            row["verdict"] = "no_data"
+            row["why"] = (
+                basis if target_s is None
+                else f"{count} request(s) < min {floor}"
+                if count < floor else "histogram carries no p99"
+            )
+        elif row["p99_s"] > target_s:
+            row["verdict"] = "slo_breach"
+            obs_metrics.inc("slo.breaches")
+            journal.emit(
+                "slo_breach", kernel=kernel, p99_s=row["p99_s"],
+                p50_s=row["p50_s"], target_p99_s=target_s,
+                count=count, device_kind=kind,
+                shape_class=shape_class, basis=basis,
+                simulated=bool(simulated),
+            )
+        else:
+            row["verdict"] = "ok"
+        out[kernel] = row
+    return out
+
+
+# ------------------------------------------------------------------ #
+# the persisted slo.json verdict artifact                            #
+# ------------------------------------------------------------------ #
+
+def entry_key(kernel: str, shape_class: str, kind: str,
+              simulated: bool = False) -> str:
+    """Simulated runs get their own ``|sim``-suffixed keyspace: a
+    virtual-clock plumbing proof must never OVERWRITE (and thereby
+    un-gate) a real measurement's verdict at the same
+    (kernel, shape_class, kind)."""
+    key = "|".join((kernel, shape_class, kind))
+    return key + "|sim" if simulated else key
+
+
+def _sources(kernel: str):
+    from tpukernels import aot
+
+    return aot.KERNEL_SOURCES.get(kernel, ())
+
+
+def record(verdicts: dict, run_info: dict | None = None,
+           jax_version: str | None = None) -> str:
+    """Atomically upsert one run's verdict rows into ``slo.json``
+    (flock-serialized read-modify-write, the tuning-cache
+    discipline); returns the artifact path. Each entry records the
+    evidence that scoped it — jax version (None for simulated runs),
+    per-kernel source sha, repo HEAD, wall clock, and the run's
+    arrival/seed parameters — so a later reader can validate it the
+    way tuning/aot/integrity entries are validated."""
+    from tpukernels.tuning import cache as tcache
+
+    p = path()
+    info = dict(run_info or {})
+    head = journal.git_head()
+    now = round(time.time(), 3)
+
+    def _mutate(data):
+        entries = data.setdefault("entries", {})
+        for kernel, row in verdicts.items():
+            key = entry_key(
+                kernel, row["shape_class"], row["device_kind"],
+                simulated=bool(row.get("simulated")),
+            )
+            entries[key] = {
+                **{k: v for k, v in row.items() if k != "kernel"},
+                "jax": jax_version,
+                "source_sha": tcache.source_sha(
+                    tuple(_sources(kernel))
+                ),
+                "git_head": head,
+                "recorded": now,
+                "run": info,
+            }
+
+    _cachedir.locked_json_update(p, _mutate)
+    _FILE_MEMO.pop(p, None)
+    return p
+
+
+def _reject(key: str, reason: str, **fields):
+    """Loud-rejection contract shared with the tuning/aot/integrity
+    caches: stderr note + ``slo_rejected`` journal event once per
+    process per cause, counter per occurrence."""
+    obs_metrics.inc("slo.rejections")
+    memo = (key, reason)
+    if memo in _REJECT_NOTED:
+        return
+    _REJECT_NOTED.add(memo)
+    print(f"# slo verdict rejected: {key} ({reason})", file=sys.stderr)
+    journal.emit("slo_rejected", key=key, reason=reason, **fields)
+
+
+def load_entries() -> dict:
+    """Validated ``slo.json`` entries ({key: entry}). Validation
+    mirrors the tuning cache: a non-simulated entry whose jax version
+    differs from the running one, or whose kernel sources have a newer
+    commit than its ``source_sha``, is rejected loudly and dropped —
+    a p99 captured against last week's kernel must not gate (or
+    clear) today's queue. Simulated entries skip the jax check (they
+    never ran jax) but still sha-validate."""
+    data = _cachedir.read_json_memoized(path(), _FILE_MEMO)
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        return {}
+    from tpukernels.tuning import cache as tcache
+
+    out = {}
+    jax_version = None
+    for key, entry in sorted(entries.items()):
+        if not isinstance(entry, dict):
+            continue
+        kernel = key.split("|", 1)[0]
+        if not entry.get("simulated"):
+            if jax_version is None:
+                import jax
+
+                jax_version = jax.__version__
+            if entry.get("jax") != jax_version:
+                _reject(
+                    key,
+                    f"measured under jax {entry.get('jax')}, "
+                    f"running {jax_version}",
+                )
+                continue
+        sources = _sources(kernel)
+        if sources:
+            sha = tcache.source_sha(tuple(sources))
+            if sha is not None and entry.get("source_sha") not in (
+                None, sha,
+            ):
+                _reject(
+                    key,
+                    "stale: a commit touching "
+                    + ",".join(sources)
+                    + " postdates this verdict",
+                    entry_sha=entry.get("source_sha"),
+                    current_sha=sha,
+                )
+                continue
+        out[key] = entry
+    return out
+
+
+def breaches() -> dict:
+    """The gating surface: validated, NON-simulated entries whose
+    verdict is ``slo_breach`` ({key: entry}) — what flips
+    ``obs_report --check`` to rc 1."""
+    return {
+        k: e for k, e in load_entries().items()
+        if e.get("verdict") == "slo_breach" and not e.get("simulated")
+    }

@@ -53,6 +53,13 @@ The bands mirror bench.py's constants — ``CEILING_EPS`` must equal
 ``bench._CEILING_EPS`` and ``REGRESSION_TOL`` ``bench._REGRESSION_TOL``
 (asserted by ``tests/test_obs.py``; importing bench from here would
 drag jax into a stdlib-only module).
+
+These verdicts judge slope throughput only. The per-request
+latency-tail story — what users feel before any slope moves — lives
+in the sibling ``obs/slo.py``: ``tools/obs_report.py --check`` gates
+rc 1 on a confirmed ``slo_breach`` verdict exactly as it does on
+``regression``/``impossible`` here and on a confirmed
+``output_integrity_failed`` event.
 """
 
 from __future__ import annotations

@@ -28,6 +28,7 @@ keyed per shape/dtype, which only exist at call time.
 
 from __future__ import annotations
 
+import time as _time
 from typing import Callable, Dict
 
 # stdlib-only (no jax), so importing it here keeps `import tpukernels`
@@ -118,13 +119,32 @@ def dispatch(name: str, *args, **statics):
     never raises — a wrong answer becomes an
     ``output_integrity_failed`` journal event, the kernel's AOT
     executable memo is invalidated, and repeat offenders are
-    quarantined. ``TPK_INTEGRITY=0`` makes this a single check."""
-    fn = lookup(name)
-    if not _aot.enabled():
-        out = fn(*args, **statics)
-    else:
-        out = _aot.run_cached(name, fn, args, statics)
-    return _integrity.guard("registry", name, out, statics=statics)
+    quarantined. ``TPK_INTEGRITY=0`` makes this a single check.
+
+    Dispatch is the serving path of record (until the daemon lands),
+    so it is latency-instrumented for the SLO layer
+    (docs/OBSERVABILITY.md §latency SLOs): a ``dispatch/<kernel>``
+    span (no-op unless ``TPK_TRACE``), a ``dispatch.calls.<kernel>``
+    counter and a ``dispatch.wall_s.<kernel>`` histogram per call —
+    dict updates and two clock reads, no I/O, so the clean-path
+    stdout proof holds. The wall covers fault injection, the memo
+    lookup/compile and the integrity guard; with the guard on its
+    host-side tripwire read makes the wall effectively synchronous,
+    with everything off it is async submit time."""
+    t0 = _time.perf_counter()
+    with _trace.span(f"dispatch/{name}"):
+        faults.dispatch_fault(name)
+        fn = lookup(name)
+        if not _aot.enabled():
+            out = fn(*args, **statics)
+        else:
+            out = _aot.run_cached(name, fn, args, statics)
+        out = _integrity.guard("registry", name, out, statics=statics)
+    _obs_metrics.inc(f"dispatch.calls.{name}")
+    _obs_metrics.observe(
+        f"dispatch.wall_s.{name}", _time.perf_counter() - t0
+    )
+    return out
 
 
 def precompile(name: str) -> dict:

@@ -36,6 +36,17 @@ contract and examples):
 - ``"kill_supervisor": "stepname"`` (or ``{"step": ...}``) — the
   revalidation supervisor SIGKILLs ITSELF right after checkpointing
   that step's ``step_start`` — the crash-safe-resume chaos proof.
+- ``"slow_dispatch": {"kernel": "scan", "delay_s": 0.6, "every":
+  20}`` — every ``every``-th ``registry.dispatch`` of that kernel
+  sleeps ``delay_s`` before running: the latency-tail fault the SLO
+  layer exists to catch (docs/OBSERVABILITY.md §latency SLOs). A
+  slope/throughput metric barely moves (bench's ``_slope`` loop
+  programs never pass through ``registry.dispatch``, and the mean
+  shifts by delay/every) while the p99 of an open-loop load run
+  breaches — the headline claim, CPU-proven in
+  ``tests/test_slo.py``. ``kernel`` omitted matches any; ``every``
+  defaults to 1; a bare string is ``{"kernel": ...}`` sugar; the
+  same ``"env"`` clause as wedge_metric narrows the match.
 - ``"corrupt_output": {"kernel": "sgemm", "site": "registry"}`` /
   ``"nan_output": {...}`` — the output-integrity guard
   (resilience/integrity.py) corrupts the guarded result it is about
@@ -93,6 +104,7 @@ def _load_plan():
 _PLAN = _load_plan()
 _PROBE_IDX = 0       # probe attempts consumed (per process)
 _CURRENT_METRIC = None  # set by bench's --one/--prewarm child entry
+_DISPATCH_CALLS: dict = {}  # kernel -> dispatches seen (slow_dispatch)
 
 
 def active() -> bool:
@@ -106,6 +118,7 @@ def reload_plan():
     _PLAN = _load_plan()
     _PROBE_IDX = 0
     _CURRENT_METRIC = None
+    _DISPATCH_CALLS.clear()
     return _PLAN
 
 
@@ -220,6 +233,40 @@ def supervisor_fault(step: str):
     print(f"# fault: SIGKILL supervisor mid-{step}", file=sys.stderr,
           flush=True)
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+def dispatch_fault(kernel: str):
+    """Injection point for ``registry.dispatch``: a ``slow_dispatch``
+    plan key delays every ``every``-th matching dispatch by
+    ``delay_s`` — a latency-TAIL fault, invisible to slope throughput
+    (which amortizes it) and exactly what the SLO layer's p99
+    verdicts must catch. Counting is per (process, kernel): requests
+    1..every-1 run clean, request ``every`` stalls."""
+    if _PLAN is None:
+        return
+    spec = _PLAN.get("slow_dispatch")
+    if not spec:
+        return
+    if isinstance(spec, str):
+        spec = {"kernel": spec}
+    want = spec.get("kernel")
+    if want is not None and want != kernel:
+        return
+    want_env = spec.get("env")
+    if want_env and any(
+        os.environ.get(k) != v for k, v in want_env.items()
+    ):
+        return
+    n = _DISPATCH_CALLS[kernel] = _DISPATCH_CALLS.get(kernel, 0) + 1
+    every = int(spec.get("every", 1))
+    if every > 1 and n % every:
+        return
+    delay = float(spec.get("delay_s", 0.1))
+    journal.emit(
+        "fault_injected", site="dispatch", kernel=kernel,
+        fault="slow_dispatch", delay_s=delay, call=n,
+    )
+    time.sleep(delay)
 
 
 def output_fault(site: str, kernel):
