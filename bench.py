@@ -54,6 +54,7 @@ from tpukernels.resilience import faults, integrity, journal, watchdog
 # fault layer is proven); metric counters are process-local until the
 # end-of-run snapshot lands in the health journal.
 from tpukernels.obs import metrics as obs_metrics
+from tpukernels.obs import scaling as obs_scaling
 from tpukernels.obs import trace
 
 # AOT compile layer (stdlib at import too, docs/PERF.md §compile
@@ -822,6 +823,12 @@ def main():
         deadline_s=float(os.environ.get("TPK_BENCH_DEADLINE_S", "4800")),
         fault_plan_active=faults.active(),
     )
+    # hardware attribution stamp (docs/OBSERVABILITY.md §scaling). The
+    # suite parent must never touch jax.devices() itself — that would
+    # initialize the backend this very function is about to probe in a
+    # killable subprocess — so this stamps the env-derived inventory;
+    # the --one children stamp the jax-backed one.
+    obs_scaling.emit_inventory("bench")
     with trace.span("probe/liveness"):
         alive = _tpu_alive()
     if not alive:
@@ -1344,6 +1351,14 @@ if __name__ == "__main__":
         # opens the operand-setup phase for the wedge-attribution
         # breadcrumbs (closed by _slope's 'entered' line)
         print(f"# one: {sys.argv[2]} starting", file=sys.stderr, flush=True)
+        # jax-backed hardware stamp: this child initializes the
+        # backend unconditionally in a moment, so probing is free —
+        # and the metric it emits becomes attributable to the device
+        # that produced it (docs/OBSERVABILITY.md §scaling). AFTER the
+        # breadcrumb on purpose: if the backend init hangs on a dead
+        # tunnel, the breadcrumb has already attributed the wedge to
+        # this metric's startup, not to a silent pre-metric limbo.
+        obs_scaling.emit_inventory("bench:one", probe=True)
         obs_metrics.inc(f"bench.measure.{sys.argv[2]}")
         with trace.span(f"measure/{sys.argv[2]}"):
             value = round(_with_timeout(fn), 2)

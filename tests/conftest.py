@@ -222,6 +222,28 @@ if "TPK_SLO_DIR" not in os.environ:
     except OSError:
         pass
 
+# Isolate the scaling-artifact directory (docs/OBSERVABILITY.md
+# §scaling) the same way: busbw/weak-scaling CLI runs spawned by tests
+# write scaling_*.json artifacts, and rehearsal noise must never land
+# beside the repo's committed docs/logs evidence — the files
+# obs_report trend-checks. Tests that assert artifact contents point
+# TPK_SCALING_DIR at their own tmp path.
+if "TPK_SCALING_DIR" not in os.environ:
+    import tempfile
+
+    _scaling_dir = os.path.join(
+        tempfile.gettempdir(), f"tpk_scaling_test_{os.getuid()}"
+    )
+    os.makedirs(_scaling_dir, exist_ok=True)
+    os.environ["TPK_SCALING_DIR"] = _scaling_dir
+    import glob as _glob
+
+    for _f in _glob.glob(os.path.join(_scaling_dir, "scaling_*.json")):
+        try:  # a previous suite run's artifacts must not accumulate
+            os.unlink(_f)
+        except OSError:
+            pass
+
 # Persist compiled executables across suite runs (the shared knob —
 # tpukernels/_cachedir.py; `import tpukernels` is deliberately
 # jax-free, so this respects the env-before-jax-import rule below).

@@ -407,6 +407,8 @@ def test_multiprocess_allreduce():
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
         import jax
+        from tpukernels.compat import ensure_cpu_collectives
+        ensure_cpu_collectives()  # 0.4.x jax ships CPU gloo off
         pid = int(sys.argv[1])
         jax.distributed.initialize(
             "127.0.0.1:{port}", num_processes=2, process_id=pid)
@@ -445,6 +447,8 @@ def test_multiprocess_4x2_collectives():
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
         import jax
+        from tpukernels.compat import ensure_cpu_collectives
+        ensure_cpu_collectives()  # 0.4.x jax ships CPU gloo off
         pid = int(sys.argv[1])
         jax.distributed.initialize(
             "127.0.0.1:{port}", num_processes=4, process_id=pid)
@@ -501,6 +505,8 @@ def test_multiprocess_8x1_collectives():
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         import jax
+        from tpukernels.compat import ensure_cpu_collectives
+        ensure_cpu_collectives()  # 0.4.x jax ships CPU gloo off
         pid = int(sys.argv[1])
         jax.distributed.initialize(
             "127.0.0.1:{port}", num_processes=8, process_id=pid)
@@ -541,6 +547,8 @@ def test_multiprocess_small_collectives():
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
         import jax
+        from tpukernels.compat import ensure_cpu_collectives
+        ensure_cpu_collectives()  # 0.4.x jax ships CPU gloo off
         pid = int(sys.argv[1])
         jax.distributed.initialize(
             "127.0.0.1:{port}", num_processes=2, process_id=pid)
@@ -586,6 +594,8 @@ def test_multiprocess_busbw_sweep():
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
         import jax
+        from tpukernels.compat import ensure_cpu_collectives
+        ensure_cpu_collectives()  # 0.4.x jax ships CPU gloo off
         pid = int(sys.argv[1])
         jax.distributed.initialize(
             "127.0.0.1:{port}", num_processes=2, process_id=pid)
@@ -614,6 +624,8 @@ def test_multiprocess_capi_mesh():
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
         os.environ["TPK_MESH"] = "8"
         import jax
+        from tpukernels.compat import ensure_cpu_collectives
+        ensure_cpu_collectives()  # 0.4.x jax ships CPU gloo off
         pid = int(sys.argv[1])
         jax.distributed.initialize(
             "127.0.0.1:{port}", num_processes=2, process_id=pid)

@@ -1,0 +1,498 @@
+"""CPU suite for the distributed-path scaling observability layer
+(ISSUE 9; docs/OBSERVABILITY.md §scaling, docs/DISTRIBUTED.md
+§observability).
+
+Covers the tentpole contracts without a pod: artifact schema
+roundtrip through writer → loader → verdict, fake-flag exclusion from
+gating (the PR-8 ``|sim`` pattern), the committed degraded bus-bw
+fixture series driving ``obs_report --check`` to rc 1 while fake
+artifacts alone leave it rc 0, the analytic ICI-ceiling ``impossible``
+verdict, weak-scaling efficiency threshold math, MULTICHIP
+legacy-tail parsing against a real committed round, the
+weak-scaling program catalog lint, and the byte-identical clean-path
+stdout proof for the bus-bw sweep with journaling off.
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from test_distributed import _scrubbed_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "tests", "data")
+
+from tpukernels.obs import scaling  # noqa: E402
+
+
+def _events(path, kind=None):
+    recs = [
+        json.loads(line)
+        for line in open(path).read().splitlines()
+        if line.strip()
+    ]
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    return recs
+
+
+def _root_with(tmp_path, fixtures, name="repo"):
+    """A fixture repo root whose docs/logs holds copies of committed
+    tests/data fixture artifacts ({src_name: dst_name})."""
+    root = tmp_path / name
+    logs = root / "docs" / "logs"
+    logs.mkdir(parents=True)
+    (root / "BASELINE.json").write_text("{}")
+    for src, dst in fixtures.items():
+        shutil.copy(os.path.join(DATA, src), logs / dst)
+    return str(root)
+
+
+def _run_tool(script, *args, env=None):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", script), *args],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=env,
+    )
+
+
+# ---------------------------------------------------------------- #
+# artifact schema roundtrip                                         #
+# ---------------------------------------------------------------- #
+
+def test_busbw_artifact_schema_roundtrip(tmp_path):
+    root = tmp_path / "repo"
+    out = root / "docs" / "logs"
+    out.mkdir(parents=True)
+    inv = {"source": "jax", "platform": "tpu",
+           "device_kind": "tpu_v5_lite", "n_devices": 8, "fake": False}
+    p = scaling.write_busbw_artifact(
+        [(1024, 0.001, 41.5), (4096, 0.002, 44.0)],
+        "allreduce", 8, inv, out_dir=str(out),
+    )
+    assert os.path.basename(p).startswith("scaling_busbw_allreduce_")
+    rec = json.load(open(p))
+    assert rec["schema"] == scaling.SCHEMA
+    assert rec["family"] == "busbw" and rec["fake"] is False
+    assert rec["device_inventory"]["device_kind"] == "tpu_v5_lite"
+
+    arts = scaling.load_artifacts(str(root))
+    assert len(arts) == 1
+    verdicts = scaling.analyze_busbw(arts, eps=0.01)
+    v = verdicts["busbw/allreduce/n8/1024B"]
+    assert v["verdict"] == "ok"
+    assert v["latest"] == 41.5 and v["valid_points"] == 1
+
+
+def test_weak_artifact_schema_roundtrip(tmp_path):
+    out = tmp_path / "repo" / "docs" / "logs"
+    out.mkdir(parents=True)
+    inv = {"platform": "tpu", "device_kind": "tpu_v5_lite",
+           "fake": False}
+    pts = [
+        {"program": "allreduce", "n_devices": 8, "wall_s": 0.010,
+         "per_chip_work": 4194304, "ok": True},
+        {"program": "allreduce", "n_devices": 64, "wall_s": 0.013,
+         "per_chip_work": 4194304, "ok": True},
+    ]
+    scaling.write_weak_artifact(pts, inv, out_dir=str(out))
+    arts = scaling.load_artifacts(str(tmp_path / "repo"))
+    v = scaling.analyze_weak(arts)["allreduce"]
+    assert v["verdict"] == "ok"
+    assert v["efficiency"] == pytest.approx(0.010 / 0.013, abs=1e-4)
+
+
+# ---------------------------------------------------------------- #
+# verdict rules: regression, ceiling, fake exclusion                #
+# ---------------------------------------------------------------- #
+
+DEGRADED = {
+    "scaling_busbw_allreduce_2026-08-01_000000_1.json":
+        "scaling_busbw_allreduce_2026-08-01_000000_1.json",
+    "scaling_busbw_allreduce_2026-08-02_000000_1.json":
+        "scaling_busbw_allreduce_2026-08-02_000000_1.json",
+}
+
+
+def test_degraded_busbw_fixture_is_regression(tmp_path):
+    """The committed fixture pair: 45 -> 30 GB/s at 1 MiB on 8 real
+    chips is a 33% collapse — exactly the class of silent ICI
+    degradation this layer exists to catch by machine."""
+    root = _root_with(tmp_path, DEGRADED)
+    analysis = scaling.analyze_repo(root)
+    v = analysis["busbw"]["busbw/allreduce/n8/1048576B"]
+    assert v["verdict"] == "regression"
+    assert any("REGRESSION" in f for f in v["flags"])
+    assert scaling.gating_findings(analysis)
+
+
+def test_obs_report_check_gates_degraded_busbw_rc1(tmp_path):
+    root = _root_with(tmp_path, DEGRADED)
+    r = _run_tool("obs_report.py", "--check", "--root", root)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "busbw/allreduce/n8/1048576B: regression" in r.stdout
+
+
+def test_fake_artifacts_alone_never_gate(tmp_path):
+    """Fake-device artifacts (the CPU rehearsals) are loaded and
+    reported but can only ever reach no_data — obs_report --check
+    stays rc 0 on fake evidence alone, however degraded it looks."""
+    root = _root_with(tmp_path, {
+        "scaling_busbw_fake_degraded.json":
+            "scaling_busbw_fake_2026-08-01_000000_1.json",
+    })
+    # a second, equally-degraded fake round: even a "trend" across
+    # fake artifacts must stay no_data
+    shutil.copy(
+        os.path.join(DATA, "scaling_busbw_fake_degraded.json"),
+        os.path.join(root, "docs", "logs",
+                     "scaling_busbw_fake_2026-08-02_000000_1.json"),
+    )
+    analysis = scaling.analyze_repo(root)
+    v = analysis["busbw"]["busbw/allreduce/n8/1048576B"]
+    assert v["verdict"] == "no_data"
+    assert v["valid_points"] == 0 and v["points"] >= 1
+    assert any("excluded from gating" in f for f in v["flags"])
+    assert scaling.gating_findings(analysis) == {}
+    r = _run_tool("obs_report.py", "--check", "--root", root)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_busbw_impossible_above_ici_ceiling(tmp_path):
+    """A validated capture above the analytic per-link ICI ceiling is
+    flagged impossible — the 72,698-GFLOPS class of drift error,
+    bus-bw edition."""
+    root = tmp_path / "repo"
+    out = root / "docs" / "logs"
+    out.mkdir(parents=True)
+    inv = {"platform": "tpu", "device_kind": "tpu_v5_lite",
+           "fake": False}
+    ceil, _kind, basis = scaling.ceiling_gb_s(
+        "allreduce", "tpu_v5_lite"
+    )
+    assert basis == "exact"
+    scaling.write_busbw_artifact(
+        [(1 << 20, 1e-6, ceil * 1.5)], "allreduce", 8, inv,
+        out_dir=str(out),
+    )
+    analysis = scaling.analyze_repo(str(root))
+    v = analysis["busbw"][f"busbw/allreduce/n8/{1 << 20}B"]
+    assert v["verdict"] == "impossible"
+    assert any("IMPOSSIBLE" in f for f in v["flags"])
+    # the trend-parser escape hatch: the same glitched point marked
+    # invalidated at source is reported but never gates — without it
+    # one bad committed capture would flip --check to rc 1 forever
+    art_path = next(
+        (out / f) for f in os.listdir(out) if f.endswith(".json")
+    )
+    rec = json.load(open(art_path))
+    rec["points"][0]["invalidated"] = "clock glitch, caught at source"
+    art_path.write_text(json.dumps(rec))
+    v2 = scaling.analyze_repo(str(root))["busbw"][
+        f"busbw/allreduce/n8/{1 << 20}B"
+    ]
+    assert v2["verdict"] == "no_data"
+    assert any("invalidated at source" in f for f in v2["flags"])
+    # within the epsilon band of the ceiling is NOT impossible
+    assert scaling.ceiling_gb_s("ppermute", "cpu")[2] == "exact"
+    assert scaling.ceiling_gb_s("allreduce", "tpu_v6")[2] \
+        == "assumed-tpu_v5_lite"
+    assert scaling.ceiling_gb_s("allreduce", "weird")[2] \
+        == "cpu-fallback"
+
+
+# ---------------------------------------------------------------- #
+# weak-scaling efficiency threshold math                            #
+# ---------------------------------------------------------------- #
+
+def _weak_root(tmp_path, wall_small, wall_big, fake=False,
+               name="repo"):
+    root = tmp_path / name
+    out = root / "docs" / "logs"
+    out.mkdir(parents=True)
+    inv = {"platform": "cpu" if fake else "tpu",
+           "device_kind": "cpu" if fake else "tpu_v5_lite",
+           "fake": fake}
+    pts = [
+        {"program": "stencil2d", "n_devices": 8, "wall_s": wall_small,
+         "per_chip_work": 512, "ok": True},
+        {"program": "stencil2d", "n_devices": 64, "wall_s": wall_big,
+         "per_chip_work": 512, "ok": True},
+    ]
+    scaling.write_weak_artifact(pts, inv, out_dir=str(out))
+    return str(root)
+
+
+def test_weak_scaling_efficiency_threshold(tmp_path, monkeypatch):
+    # eff = 1.0/2.5 = 40% < default 50% floor -> below (non-gating)
+    root = _weak_root(tmp_path, 1.0, 2.5)
+    analysis = scaling.analyze_repo(root)
+    v = analysis["weak"]["stencil2d"]
+    assert v["verdict"] == "below_scaling_efficiency"
+    assert v["efficiency"] == pytest.approx(0.4)
+    # never a gating finding, by construction
+    assert scaling.gating_findings(analysis) == {}
+
+    # eff = 1.0/1.9 = 52.6% >= 50% -> ok
+    v_ok = scaling.analyze_repo(
+        _weak_root(tmp_path, 1.0, 1.9, name="ok")
+    )["weak"]["stencil2d"]
+    assert v_ok["verdict"] == "ok"
+    assert v_ok["efficiency"] == pytest.approx(1.0 / 1.9, abs=1e-4)
+
+    # the knob moves the floor: 40% passes a 0.3 floor
+    monkeypatch.setenv("TPK_SCALING_MIN_EFF", "0.3")
+    v_knob = scaling.analyze_repo(root)["weak"]["stencil2d"]
+    assert v_knob["verdict"] == "ok"
+
+    # fail-loud parse (the TPK_* contract)
+    monkeypatch.setenv("TPK_SCALING_MIN_EFF", "abc")
+    with pytest.raises(ValueError, match="TPK_SCALING_MIN_EFF"):
+        scaling.min_eff()
+
+
+def test_weak_scaling_fake_never_verdicted(tmp_path):
+    v = scaling.analyze_repo(
+        _weak_root(tmp_path, 1.0, 99.0, fake=True)
+    )["weak"]["stencil2d"]
+    assert v["verdict"] == "no_data"
+    assert any("fake" in f for f in v["flags"])
+
+
+# ---------------------------------------------------------------- #
+# MULTICHIP legacy rounds as day-one series data                    #
+# ---------------------------------------------------------------- #
+
+def test_multichip_legacy_tail_parsing_real_round():
+    """Against the real committed MULTICHIP_r02.json: the progress
+    lines in its tail are cumulative stamps printed at each program's
+    START, so walls are deltas to the next line (jacobi3d at +3.4s,
+    scan at +4.0s -> jacobi3d wall 0.6s; the final 'all programs OK'
+    stamp closes nbody_dist_psum)."""
+    rec = json.load(open(os.path.join(REPO, "MULTICHIP_r02.json")))
+    progs = {p["name"]: p["wall_s"]
+             for p in scaling.parse_dryrun_tail(rec["tail"])}
+    assert progs["jacobi3d_dist"] == pytest.approx(0.6)
+    assert progs["scan_dist"] == pytest.approx(0.4)
+    assert progs["histogram_dist"] == pytest.approx(0.2)
+    assert progs["nbody_dist_ring"] == pytest.approx(0.7)
+    assert progs["nbody_dist_psum"] == pytest.approx(0.5)
+
+    # and through the repo-level analyzer: the five committed rounds
+    # become series data (round 1's rc-124 tail contributes nothing)
+    series = scaling.analyze_dryrun(REPO)
+    assert series["jacobi3d_dist"]["rounds"] >= 4
+    assert series["nbody_dist_psum"]["latest_wall_s"] > 0
+
+
+def test_multichip_structured_line_preferred():
+    """A tail carrying the MULTICHIP-PROGRAMS JSON line (what
+    __graft_entry__ prints now) wins over legacy delta parsing, and a
+    structured `programs` key on the artifact wins over the tail."""
+    tail = (
+        "[dryrun +  1.0s] scan_dist\n"
+        "[dryrun +  9.0s] all programs OK\n"
+        'MULTICHIP-PROGRAMS: {"n_devices": 8, "programs": '
+        '[{"name": "scan_dist", "wall_s": 0.123, "ok": true}]}\n'
+        "dryrun_multichip(8): OK\n"
+    )
+    progs = scaling.parse_dryrun_tail(tail)
+    assert progs == [{"name": "scan_dist", "wall_s": 0.123,
+                      "ok": True}]
+
+
+def test_dryrun_emits_structured_artifact(tmp_path):
+    """The new writer: dryrun_multichip records structured per-program
+    walls beside the tail — the MULTICHIP-PROGRAMS stdout line (which
+    the driver's tail capture preserves) plus the full artifact at
+    TPK_MULTICHIP_ARTIFACT."""
+    art = tmp_path / "multichip.json"
+    env = _scrubbed_env(None)
+    env["TPK_MULTICHIP_ARTIFACT"] = str(art)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"),
+         "dryrun", "8"],
+        env=env, capture_output=True, text=True, timeout=240,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "MULTICHIP-PROGRAMS: " in proc.stdout
+    progs = scaling.parse_dryrun_tail(proc.stdout)
+    names = [p["name"] for p in progs]
+    assert names == [
+        "allreduce_sum", "bcast", "ring_shift", "jacobi2d_dist",
+        "jacobi3d_dist", "scan_dist", "histogram_dist",
+        "nbody_dist_ring", "nbody_dist_psum",
+    ]
+    assert all(p["ok"] and p["wall_s"] >= 0 for p in progs)
+    rec = json.load(open(art))
+    assert rec["n_devices"] == 8 and rec["ok"] is True
+    assert rec["programs"] == progs
+    assert rec["device_inventory"]["fake"] is True  # CPU by design
+
+
+# ---------------------------------------------------------------- #
+# catalog lint: no observability-dark distributed program           #
+# ---------------------------------------------------------------- #
+
+def test_weak_program_catalog_complete():
+    """Every program tools/weak_scaling.py sweeps must have a
+    scaling.WEAK_SERIES row (artifact series name + work unit), and
+    the bus-bw ops must each have an analytic ceiling row for the
+    evidence and fallback device kinds — a new distributed kernel
+    cannot ship observability-dark."""
+    spec = importlib.util.spec_from_file_location(
+        "weak_scaling", os.path.join(REPO, "tools", "weak_scaling.py")
+    )
+    ws = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ws)
+    assert set(ws.PROGRAMS) == set(scaling.WEAK_SERIES), (
+        "tools/weak_scaling.py PROGRAMS and scaling.WEAK_SERIES "
+        "must list the same programs"
+    )
+    for name, row in scaling.WEAK_SERIES.items():
+        assert row.get("series", "").startswith("weak/"), (name, row)
+        assert row.get("work_unit"), (name, row)
+    for op in ("allreduce", "ppermute"):
+        for kind in ("tpu_v5_lite", "cpu"):
+            ceil, _k, basis = scaling.ceiling_gb_s(op, kind)
+            assert ceil > 0 and basis == "exact", (op, kind)
+
+
+def test_device_inventory_event(monkeypatch, tmp_path):
+    j = tmp_path / "health.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(j))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    inv = scaling.emit_inventory("test-site")  # env mode: no jax touch
+    assert inv["source"] == "env" and inv["fake"] is True
+    (ev,) = _events(j, "device_inventory")
+    assert ev["site"] == "test-site"
+    assert ev["platform"] == "cpu" and ev["fake"] is True
+
+
+# ---------------------------------------------------------------- #
+# end-to-end: the CLIs produce schema-valid fake-flagged artifacts  #
+# ---------------------------------------------------------------- #
+
+def test_weak_scaling_tool_end_to_end(tmp_path):
+    """Acceptance: tools/weak_scaling.py on fake CPU devices produces
+    a schema-valid fake-flagged artifact plus weak_scaling_point +
+    device_inventory journal events, and the analyzer refuses to
+    verdict the fake evidence."""
+    out = tmp_path / "repo" / "docs" / "logs"
+    out.mkdir(parents=True)
+    j = tmp_path / "health.jsonl"
+    env = _scrubbed_env(None)
+    env["TPK_SCALING_DIR"] = str(out)
+    env["TPK_HEALTH_JOURNAL"] = str(j)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "weak_scaling.py"),
+         "--sizes", "1 2", "--quick", "--reps", "1"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "FAKE devices" in proc.stdout
+    arts = scaling.load_artifacts(str(tmp_path / "repo"))
+    assert len(arts) == 1 and arts[0]["fake"] is True
+    progs = {p["program"] for p in arts[0]["points"]}
+    assert progs == set(scaling.WEAK_SERIES)
+    pts = _events(j, "weak_scaling_point")
+    assert len(pts) == 2 * len(scaling.WEAK_SERIES)
+    assert all(p["fake"] for p in pts)
+    invs = _events(j, "device_inventory")
+    sites = {e["site"] for e in invs}
+    assert "weak_scaling" in sites and "weak_scaling:parent" in sites
+    # fake weak evidence never verdicts (no_data, flagged)
+    weak = scaling.analyze_repo(str(tmp_path / "repo"))["weak"]
+    assert all(v["verdict"] == "no_data" for v in weak.values())
+
+
+def test_busbw_cli_writes_fake_flagged_artifact(tmp_path):
+    """Acceptance: `python -m tpukernels.parallel.busbw` on 8 fake
+    CPU devices writes a schema-valid fake-flagged artifact and
+    journals busbw_point + device_inventory events; the artifact path
+    goes to stderr, never stdout (the C driver greps stdout)."""
+    out = tmp_path / "repo" / "docs" / "logs"
+    out.mkdir(parents=True)
+    j = tmp_path / "health.jsonl"
+    env = _scrubbed_env(8)
+    env["TPK_SCALING_DIR"] = str(out)
+    env["TPK_HEALTH_JOURNAL"] = str(j)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpukernels.parallel.busbw",
+         "--min=1K", "--max=4K", "--reps=1"],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "# busbw artifact:" in proc.stderr
+    assert "# busbw artifact:" not in proc.stdout
+    arts = scaling.load_artifacts(str(tmp_path / "repo"))
+    assert len(arts) == 1
+    art = arts[0]
+    assert art["family"] == "busbw" and art["fake"] is True
+    assert art["op"] == "allreduce" and art["n_devices"] == 8
+    assert [p["size_bytes"] for p in art["points"]] == [1024, 4096]
+    assert art["device_inventory"]["source"] == "jax"
+    pts = _events(j, "busbw_point")
+    assert len(pts) == 2 and all(p["fake"] for p in pts)
+    (inv,) = _events(j, "device_inventory")
+    assert inv["site"] == "busbw" and inv["n_devices"] == 8
+
+
+# ---------------------------------------------------------------- #
+# acceptance: clean sweep stdout byte-identical, journaling off     #
+# ---------------------------------------------------------------- #
+
+class _FakeTime:
+    """A busbw-scoped deterministic clock: each perf_counter() call
+    advances 1 ms, so two sweeps print byte-identical timing lines.
+    Scoped to the busbw module's `time` name on purpose — patching the
+    global would also catch jax-internal clock reads, whose call count
+    differs between a cold and a warm run."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def perf_counter(self):
+        self.t += 0.001
+        return self.t
+
+
+def test_busbw_sweep_stdout_byte_identical_without_journal(
+    tmp_path, capsys,
+):
+    """The fault/trace layers' proof, scaling edition: with the clock
+    mocked deterministic, sweep() stdout must be byte-identical with
+    journaling OFF and ON — the structured capture goes to the
+    journal and artifact files, never stdout (the C driver and the
+    pod operator grep these lines)."""
+    from tpukernels.parallel import busbw
+    from tpukernels.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+
+    def run_once(journal_value):
+        mp = pytest.MonkeyPatch()
+        mp.setenv("TPK_HEALTH_JOURNAL", journal_value)
+        mp.setattr(busbw, "time", _FakeTime())
+        try:
+            busbw.sweep(min_bytes=1024, max_bytes=4096, reps=2,
+                        mesh=mesh)
+        finally:
+            mp.undo()
+        return capsys.readouterr().out
+
+    out_off = run_once("0")
+    j = tmp_path / "health.jsonl"
+    out_on = run_once(str(j))
+
+    assert out_off == out_on
+    assert "allreduce n=8" in out_off
+    assert "{" not in out_off  # no structured payload leaks to stdout
+    pts = _events(j, "busbw_point")
+    assert len(pts) == 2  # only the journaled run left evidence

@@ -763,8 +763,9 @@ def test_production_plan_order_reproduces_next_md(tmp_path,
         order.append(spec.name)
         sup._settled.add(spec.name)       # pretend it went green
         sup._attempted.add(spec.name)
-    assert order[:8] == ["prewarm_all", "bench", "slo_probe",
-                         "obs_check", "roofline_report", "c_gate",
-                         "c_scan_timing", "profile"]
+    assert order[:9] == ["prewarm_all", "bench", "slo_probe",
+                         "obs_check", "roofline_report",
+                         "busbw_sweep", "c_gate", "c_scan_timing",
+                         "profile"]
     assert order[-2:] == ["san_asan", "san_ubsan"]
     assert len(order) == len(cli.PRODUCTION_QUEUE)

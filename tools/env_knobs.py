@@ -39,6 +39,7 @@ def production_files(repo=_REPO):
     is excluded (its docstring names knobs as prose)."""
     py = [
         os.path.join(repo, "bench.py"),
+        os.path.join(repo, "__graft_entry__.py"),
         os.path.join(repo, "tests", "conftest.py"),
     ]
     for sub in ("tpukernels", "tools"):

@@ -257,6 +257,42 @@ def _fmt(ev):
     if kind == "slo_rejected":
         return (f"{ts} [pid {pid}] slo verdict REJECTED "
                 f"{ev.get('key')}: {ev.get('reason')}")
+    if kind == "device_inventory":
+        n = ev.get("n_devices")
+        return (f"{ts} [pid {pid}] device inventory ({ev.get('site')}, "
+                f"{ev.get('source')}): platform={ev.get('platform')}"
+                + (f" kind={ev.get('device_kind')}"
+                   if ev.get("device_kind") else "")
+                + (f" n={n}" if n is not None else "")
+                + (f" proc {ev.get('process_index')}/"
+                   f"{ev.get('process_count')}"
+                   if ev.get("process_count") else "")
+                + (" FAKE" if ev.get("fake") else ""))
+    if kind == "busbw_point":
+        return (f"{ts} [pid {pid}] busbw {ev.get('op')} n="
+                f"{ev.get('n_devices')} {ev.get('size_bytes')}B -> "
+                f"{ev.get('gb_s')} GB/s"
+                + (" (fake)" if ev.get("fake") else ""))
+    if kind == "weak_scaling_point":
+        ok = ev.get("ok", True)
+        return (f"{ts} [pid {pid}] weak-scaling {ev.get('program')} "
+                f"n={ev.get('n_devices')} "
+                + (f"wall={ev.get('wall_s')}s" if ok
+                   else f"FAILED ({ev.get('error')})")
+                + (" (fake)" if ev.get("fake") else ""))
+    if kind == "scaling_computed":
+        busbw = ev.get("busbw") or {}
+        weak = ev.get("weak") or {}
+        findings = sorted(
+            k for k, v in {**busbw, **weak}.items()
+            if v in ("regression", "impossible",
+                     "below_scaling_efficiency")
+        )
+        return (f"{ts} [pid {pid}] scaling verdicts computed over "
+                f"{ev.get('artifacts')} artifact(s): {len(busbw)} "
+                f"bus-bw series, {len(weak)} weak-scaling program(s)"
+                + (f" - findings: {','.join(findings)}" if findings
+                   else " - clean"))
     if kind == "tuning_resolved":
         return (f"{ts} [pid {pid}] tuning resolved for "
                 f"{ev.get('kernel')}: {ev.get('params')} "

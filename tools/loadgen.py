@@ -75,6 +75,7 @@ from tpukernels import _cachedir  # noqa: E402
 _cachedir.ensure_compilation_cache()
 
 from tpukernels.obs import metrics as obs_metrics  # noqa: E402
+from tpukernels.obs import scaling as obs_scaling  # noqa: E402
 from tpukernels.obs import slo, trace  # noqa: E402
 from tpukernels.resilience import journal  # noqa: E402
 
@@ -340,6 +341,11 @@ def main(argv=None):
     # sampled oracle canaries are multi-ms outliers in exactly the
     # tail this tool measures; the always-on tripwire stays
     os.environ.setdefault("TPK_INTEGRITY", "tripwire")
+    # env-derived hardware stamp (docs/OBSERVABILITY.md §scaling):
+    # --simulate must never import jax, so the probe stays off; the
+    # slo_probe event below carries the jax-resolved device_kind for
+    # real runs
+    obs_scaling.emit_inventory("loadgen")
 
     echo = lambda line: print(line)  # noqa: E731
     t_run0 = time.perf_counter()

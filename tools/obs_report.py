@@ -33,7 +33,13 @@ Sections (docs/OBSERVABILITY.md):
    validated ``slo.json`` verdict artifact the load generator writes
    (``tools/loadgen.py`` + ``tpukernels/obs/slo.py``): the
    tail-latency story the slope trend cannot see.
-8. **Metric snapshots** — the last ``metrics`` event per process:
+8. **Scaling** — the distributed-path series
+   (``tpukernels/obs/scaling.py``; docs/OBSERVABILITY.md §scaling):
+   bus-bandwidth per (op, message size, n_devices) over the committed
+   ``docs/logs/scaling_*.json`` / ``SCALING_r*.json`` artifacts,
+   weak-scaling efficiency per program, and the MULTICHIP dryrun-wall
+   series. Fake-device artifacts render flagged and never gate.
+9. **Metric snapshots** — the last ``metrics`` event per process:
    counters (probe retries, watchdog kills, tuning-cache traffic),
    gauges, latency histograms (count-weighted p50/p95/p99 + exact
    max).
@@ -44,14 +50,19 @@ non-gating and keys a WARN off it):
         (nothing measurable went backwards; tunnel-down nulls are
         retryable, and below-roofline is a headroom signal, not a
         failure), the journal holds no confirmed
-        ``output_integrity_failed`` event, AND no validated
-        non-simulated ``slo_breach`` verdict is on record;
-    1 — at least one ``regression`` or ``impossible`` verdict, a
-        confirmed output-integrity corruption (a wrong answer is
-        worse than a slow one), or a confirmed p99 SLO breach (a
-        degraded tail is a regression users feel before the slope
-        moves) — all three gate identically;
+        ``output_integrity_failed`` event, no validated non-simulated
+        ``slo_breach`` verdict is on record, AND no validated
+        (non-fake) scaling series regressed;
+    1 — at least one ``regression`` or ``impossible`` verdict (bench
+        trend OR validated bus-bw scaling series — the paper's
+        multi-chip headline gates exactly like its single-chip
+        slopes), a confirmed output-integrity corruption (a wrong
+        answer is worse than a slow one), or a confirmed p99 SLO
+        breach (a degraded tail is a regression users feel before the
+        slope moves) — all of these gate identically;
     2 — usage error (never 1: rc 1 is reserved for real findings).
+``below_scaling_efficiency`` prints as non-gating information, the
+``below_roofline`` pattern.
 
 ``--check`` prints only the non-ok verdict lines (machine/CI mode;
 ``below_roofline`` lines print as non-gating information); the
@@ -68,6 +79,7 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from tpukernels.obs import scaling as _scaling  # noqa: E402
 from tpukernels.obs import slo as _slo  # noqa: E402
 from tpukernels.obs import trace, trend  # noqa: E402
 from tpukernels.resilience import journal as _journal  # noqa: E402
@@ -313,6 +325,61 @@ def slo_section(out):
         )
 
 
+def scaling_section(analysis, out):
+    """Distributed-path scaling tables (docs/OBSERVABILITY.md
+    §scaling): the bus-bw series, per-program weak-scaling efficiency
+    and the MULTICHIP dryrun walls, each row carrying its verdict.
+    Fake-only series render as ``no_data`` with the exclusion flag —
+    visibly present, never gating."""
+    busbw = analysis.get("busbw") or {}
+    weak = analysis.get("weak") or {}
+    dryrun = analysis.get("dryrun") or {}
+    if not (busbw or weak or dryrun):
+        return
+    out.append("")
+    out.append(
+        f"== scaling ({analysis.get('artifacts', 0)} artifact(s) in "
+        "docs/logs/scaling_*.json + SCALING_r*.json; fake-device "
+        "series never gate) =="
+    )
+    if busbw:
+        hdr = (f"{'bus-bw series':<28} {'pts':>3} {'valid':>5} "
+               f"{'latest GB/s':>12} {'best':>10}  verdict")
+        out.append(hdr)
+        out.append("-" * len(hdr))
+        for name, v in busbw.items():
+            out.append(
+                f"{name:<28} {v['points']:>3} {v['valid_points']:>5} "
+                f"{_fmt_val(v['latest']):>12} {_fmt_val(v['best']):>10}"
+                f"  {v['verdict']}"
+            )
+            for flag in v["flags"]:
+                out.append(f"    {flag}")
+    if weak:
+        out.append(f"weak scaling (efficiency floor "
+                   f"{_scaling.min_eff():.0%}, TPK_SCALING_MIN_EFF):")
+        for name, v in weak.items():
+            walls = " ".join(
+                f"n{n}={w:.4f}s" for n, w in v["walls"].items()
+            )
+            eff = (f"{v['efficiency']:.1%}"
+                   if v.get("efficiency") is not None else "-")
+            out.append(
+                f"  {name:<12} eff={eff:>7} {walls}  {v['verdict']}"
+                + (" (fake)" if v.get("fake") else "")
+            )
+            for flag in v["flags"]:
+                out.append(f"    {flag}")
+    if dryrun:
+        out.append("multichip dryrun walls (fake CPU devices - "
+                   "liveness/drift series, never gate):")
+        for name, v in dryrun.items():
+            out.append(
+                f"  {name:<18} rounds={v['rounds']} "
+                f"latest={v['latest_wall_s']}s best={v['best_wall_s']}s"
+            )
+
+
 def metrics_section(events, out):
     snaps = [e for e in events if e.get("kind") == "metrics"]
     out.append("")
@@ -436,6 +503,25 @@ def main(argv=None):
                 f"{e.get('shape_class')} shapes on "
                 f"{e.get('device_kind')})"
             )
+        # validated (non-fake) bus-bw scaling series gate exactly like
+        # bench trends — the paper's multi-chip headline must not be
+        # the one layer that can regress silently
+        # (docs/OBSERVABILITY.md §scaling). Fake-device rehearsal
+        # artifacts can only ever reach no_data here.
+        scaling_analysis = trend.analyze_scaling_repo(root, eps=eps)
+        scaling_bad = _scaling.gating_findings(scaling_analysis)
+        for name, v in scaling_bad.items():
+            print(f"{name}: {v['verdict']}")
+            for flag in v["flags"]:
+                print(f"  {flag}")
+        below_eff = {
+            n: v for n, v in scaling_analysis.get("weak", {}).items()
+            if v["verdict"] == "below_scaling_efficiency"
+        }
+        for name in below_eff:
+            # informational, never part of the rc — the below_roofline
+            # pattern for the weak-scaling curve
+            print(f"weak/{name}: below_scaling_efficiency (non-gating)")
         ok = sum(1 for v in verdicts.values() if v["verdict"] == "ok")
         nodata = sum(
             1 for v in verdicts.values() if v["verdict"] == "no_data"
@@ -445,9 +531,11 @@ def main(argv=None):
             f"{len(below)} below-roofline (non-gating), "
             f"{nodata} no-data (no-data is retryable, not a failure), "
             f"{len(corrupt)} confirmed output-integrity failure(s), "
-            f"{len(breaches)} confirmed SLO breach(es)"
+            f"{len(breaches)} confirmed SLO breach(es), "
+            f"{len(scaling_bad)} scaling regression(s), "
+            f"{len(below_eff)} below-scaling-efficiency (non-gating)"
         )
-        return 1 if bad or corrupt or breaches else 0
+        return 1 if bad or corrupt or breaches or scaling_bad else 0
 
     if roofline_only:
         out = []
@@ -457,6 +545,8 @@ def main(argv=None):
 
     out = []
     events, _bad = _journal.load_events(journal_paths)
+    scaling_analysis = trend.analyze_scaling_repo(root, eps=eps)
+    scaling_bad = _scaling.gating_findings(scaling_analysis)
     trend_section(verdicts, out)
     roofline_section(verdicts, out)
     span_section(events, out)
@@ -464,12 +554,14 @@ def main(argv=None):
     aot_section(events, out)
     integrity_section(events, out)
     slo_section(out)
+    scaling_section(scaling_analysis, out)
     metrics_section(events, out)
     out.append("")
-    if bad:
+    if bad or scaling_bad:
         out.append(
             "VERDICT: " + "; ".join(
-                f"{n} {v['verdict']}" for n, v in bad.items()
+                f"{n} {v['verdict']}"
+                for n, v in {**bad, **scaling_bad}.items()
             )
         )
     else:
@@ -482,7 +574,7 @@ def main(argv=None):
             )
         )
     print("\n".join(out))
-    return 1 if bad else 0
+    return 1 if bad or scaling_bad else 0
 
 
 if __name__ == "__main__":

@@ -113,6 +113,27 @@ fi
 """, gating=False, stamp="never", timeout_s=150, cost_min=2,
       value=12, after=("prewarm_all",),
       inputs=("tpukernels", "tools/loadgen.py")),
+    # 0c. bus-bandwidth sweep (docs/OBSERVABILITY.md §scaling): the
+    #     paper's multi-chip metric of record, captured as a
+    #     structured scaling artifact + busbw_point journal events the
+    #     moment a pod window is healthy. After-edge to prewarm_all so
+    #     the sweep's own compile is warm-path; cost 3 chip-minutes
+    #     (16 MiB max message, 5 reps) so it fits any flap window;
+    #     non-gating — the obs_check step picks a validated bus-bw
+    #     regression up as rc 1 WARN exactly like a bench regression.
+    S("busbw_sweep", """
+set -o pipefail
+busbw_log="docs/logs/busbw_$(date +%Y-%m-%d_%H%M%S).log"
+if timeout -k 10 240 python -m tpukernels.parallel.busbw \\
+    --max=16M --reps=5 >"$busbw_log" 2>&1; then
+  tail -2 "$busbw_log"
+else
+  echo "WARN: busbw sweep failed rc=$? (non-gating) - $busbw_log"
+  exit 1
+fi
+""", gating=False, stamp="never", timeout_s=300, cost_min=3, value=11,
+      after=("prewarm_all",),
+      inputs=("tpukernels/parallel", "tpukernels/obs/scaling.py")),
     # 1. headline metrics + the 15% self-regression gate; the JSON
     #    line is persisted so an unattended recovery leaves a
     #    committable artifact. Never stamped: its own skip-captured

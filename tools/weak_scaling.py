@@ -1,0 +1,326 @@
+"""Weak-scaling sweep with structured artifacts (supersedes
+tools/weak_scaling.sh's grep-the-stdout table).
+
+Usage:
+    python tools/weak_scaling.py [--sizes "1 2 4 8"] [--reps 2]
+                                 [--quick] [--real] [--out DIR]
+
+Per mesh size N the sweep times one step of every distributed program
+with per-chip work held constant (stencil rows, N-body bodies,
+scan/hist elements and the allreduce message all scale linearly with
+N — N-body is O(N^2) total, linear per chip when i-bodies shard), so
+ideal weak scaling is a FLAT wall-clock line. Each (program, N) point
+is journaled as a ``weak_scaling_point`` event and the whole sweep is
+persisted as one ``docs/logs/scaling_weak_*.json`` artifact
+(``TPK_SCALING_DIR`` / ``--out`` redirect) that ``tools/obs_report.py``
+judges: efficiency at the largest mesh under ``TPK_SCALING_MIN_EFF``
+earns the NON-GATING ``below_scaling_efficiency`` verdict
+(docs/OBSERVABILITY.md §scaling).
+
+Default (and the only mode that runs on the dev box): each mesh size
+runs in a fresh subprocess with a scrubbed CPU-backend env and N fake
+devices — the same isolation ``__graft_entry__.dryrun_multichip``
+uses, so a wedged axon tunnel can never hang the sweep. Artifacts are
+then flagged ``fake=true`` and EXCLUDED from gating: all N "chips"
+timeshare one physical core here, so the numbers prove harness +
+shardings + scaled shapes, never bandwidth. ``--real`` keeps the
+caller's env (a pod host: run once per host like the C driver,
+coordinator vars exported) and produces the gating-eligible evidence.
+
+``--quick`` shrinks per-chip work ~100x for CI. The program catalog is
+``scaling.WEAK_SERIES`` — the completeness lint
+(tests/test_scaling_obs.py) pins this module's sweep table to it so a
+new distributed program cannot ship observability-dark.
+
+Exit codes: 0 — sweep completed; 1 — a program failed; 2 — usage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpukernels import _cachedir  # noqa: E402
+
+# env-before-jax-import contract: inner subprocesses compile real XLA
+# programs and must share the persistent cache
+_cachedir.ensure_compilation_cache()
+
+from tpukernels.obs import metrics as obs_metrics  # noqa: E402
+from tpukernels.obs import scaling  # noqa: E402
+from tpukernels.resilience import journal  # noqa: E402
+
+# Per-chip work of record (mirrors the superseded weak_scaling.sh):
+# (default, --quick) pairs.
+WORK = {
+    "stencil_rows": (512, 16), "stencil_cols": (1024, 64),
+    "stencil_iters": (50, 2),
+    "nbody_bodies": (2048, 64), "nbody_steps": (2, 1),
+    "elems": (1 << 20, 4096), "nbins": (256, 256),
+    "allreduce_floats": (1 << 22, 2048),
+}
+
+
+def _work(name: str, quick: bool) -> int:
+    return WORK[name][1 if quick else 0]
+
+
+# ------------------------------------------------------------------ #
+# the program table (names pinned to scaling.WEAK_SERIES by the lint) #
+# ------------------------------------------------------------------ #
+
+def _run_stencil2d(n: int, quick: bool, rng):
+    import jax
+    import jax.numpy as jnp
+
+    from tpukernels.parallel import make_mesh
+    from tpukernels.parallel.collectives import jacobi2d_dist
+
+    rows = _work("stencil_rows", quick)
+    cols = _work("stencil_cols", quick)
+    iters = _work("stencil_iters", quick)
+    mesh = make_mesh(n)
+    x = jnp.asarray(
+        rng.standard_normal((rows * n, cols)), jnp.float32
+    )
+
+    def call():
+        jax.block_until_ready(jacobi2d_dist(x, iters, mesh))
+
+    return call, rows
+
+
+def _run_nbody_ring(n: int, quick: bool, rng):
+    import jax
+    import jax.numpy as jnp
+
+    from tpukernels.parallel import make_mesh
+    from tpukernels.parallel.collectives import nbody_dist_ring
+
+    bodies = _work("nbody_bodies", quick)
+    steps = _work("nbody_steps", quick)
+    mesh = make_mesh(n)
+    nb = bodies * n
+    state = tuple(
+        jnp.asarray(rng.standard_normal(nb), jnp.float32)
+        for _ in range(6)
+    ) + (jnp.asarray(rng.uniform(0.5, 1.5, nb), jnp.float32),)
+
+    def call():
+        jax.block_until_ready(nbody_dist_ring(state, steps, mesh))
+
+    return call, bodies
+
+
+def _run_scan_hist(n: int, quick: bool, rng):
+    import jax
+    import jax.numpy as jnp
+
+    from tpukernels.parallel import make_mesh
+    from tpukernels.parallel.collectives import histogram_dist, scan_dist
+
+    elems = _work("elems", quick)
+    nbins = _work("nbins", quick)
+    mesh = make_mesh(n)
+    x = jnp.asarray(
+        rng.integers(0, nbins, elems * n), jnp.int32
+    )
+
+    def call():
+        jax.block_until_ready(scan_dist(x, mesh))
+        jax.block_until_ready(histogram_dist(x, nbins, mesh))
+
+    return call, elems
+
+
+def _run_allreduce(n: int, quick: bool, rng):
+    import jax
+    import numpy as np
+
+    from tpukernels.parallel import make_mesh
+    from tpukernels.parallel.collectives import allreduce_sum
+    from tpukernels.parallel.mesh import host_to_global, row_sharding
+
+    floats = _work("allreduce_floats", quick)
+    mesh = make_mesh(n)
+    x = host_to_global(
+        np.ones((n, floats), np.float32), row_sharding(mesh)
+    )
+
+    def call():
+        jax.block_until_ready(allreduce_sum(x, mesh))
+
+    return call, floats
+
+
+PROGRAMS = {
+    "stencil2d": _run_stencil2d,
+    "nbody_ring": _run_nbody_ring,
+    "scan_hist": _run_scan_hist,
+    "allreduce": _run_allreduce,
+}
+
+
+# ------------------------------------------------------------------ #
+# inner mode: one mesh size, jax-bound                               #
+# ------------------------------------------------------------------ #
+
+def inner(n: int, reps: int, quick: bool) -> int:
+    """Time every program on an n-device mesh; one JSON line per
+    point on stdout (the parent collects them for the artifact) plus
+    a ``weak_scaling_point`` journal event each. rc 1 when any
+    program failed — the sweep continues past failures so one broken
+    program cannot hide the rest."""
+    import numpy as np
+
+    # probe=True: this process exists to run device code on the mesh
+    inv = scaling.emit_inventory("weak_scaling", probe=True)
+    print("WEAK-INVENTORY: " + json.dumps(inv), flush=True)
+    rng = np.random.default_rng(0)
+    failed = 0
+    for name, build in PROGRAMS.items():
+        point = {"program": name, "n_devices": n, "ok": True}
+        try:
+            call, per_chip = build(n, quick, rng)
+            point["per_chip_work"] = per_chip
+            call()  # warm: compile + first execution, untimed
+            best = float("inf")
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                call()
+                best = min(best, time.perf_counter() - t0)
+            point["wall_s"] = round(best, 6)
+        except Exception as e:  # noqa: BLE001 — continue the sweep
+            point["ok"] = False
+            point["error"] = repr(e)
+            failed += 1
+        obs_metrics.inc("scaling.weak_points")
+        journal.emit("weak_scaling_point", fake=inv.get("fake", True),
+                     **point)
+        print("WEAK-POINT: " + json.dumps(point), flush=True)
+        wall = point.get("wall_s")
+        print(
+            f"weak_scaling n={n} {name:<12} "
+            + (f"wall={wall:9.4f}s" if wall is not None
+               else f"FAILED ({point.get('error')})")
+            + f" work/chip={point.get('per_chip_work', '?')}",
+            flush=True,
+        )
+    return 1 if failed else 0
+
+
+# ------------------------------------------------------------------ #
+# parent mode: per-size subprocess isolation                         #
+# ------------------------------------------------------------------ #
+
+def _scrubbed_cpu_env(n: int) -> dict:
+    """The dryrun_multichip scrub: CPU backend, n fake devices, no
+    axon pool var, no coordinator join."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for var in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    prev = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = _REPO + (os.pathsep + prev if prev else "")
+    return env
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    sizes, reps, quick, real = [1, 2, 4, 8], 2, False, False
+    out_dir = inner_n = None
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--sizes":
+                sizes = [int(s) for s in next(it).split()]
+            elif a == "--reps":
+                reps = int(next(it))
+            elif a == "--quick":
+                quick = True
+            elif a == "--real":
+                real = True
+            elif a == "--out":
+                out_dir = next(it)
+            elif a == "--inner":
+                inner_n = int(next(it))
+            else:
+                print(__doc__, file=sys.stderr)
+                print(f"weak_scaling: unknown argument {a!r}",
+                      file=sys.stderr)
+                return 2
+    except (StopIteration, ValueError):
+        print(f"weak_scaling: {a} needs a value", file=sys.stderr)
+        return 2
+    if inner_n is not None:
+        return inner(inner_n, reps, quick)
+    if not sizes or any(n < 1 for n in sizes):
+        print(f"weak_scaling: bad --sizes {sizes}", file=sys.stderr)
+        return 2
+
+    # CLI journal default (the bench/revalidate/loadgen contract); the
+    # per-size children inherit the same file through the environment
+    if os.environ.get("TPK_HEALTH_JOURNAL") is None:
+        os.environ["TPK_HEALTH_JOURNAL"] = journal.default_path()
+    scaling.emit_inventory("weak_scaling:parent")
+
+    points, inv, rc = [], None, 0
+    for n in sizes:
+        print(f"== mesh n={n}", flush=True)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--inner", str(n), "--reps", str(reps)]
+        if quick:
+            cmd.append("--quick")
+        env = dict(os.environ) if real else _scrubbed_cpu_env(n)
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for line in proc.stdout:
+            if line.startswith("WEAK-POINT: "):
+                try:
+                    points.append(json.loads(line[len("WEAK-POINT: "):]))
+                except ValueError:
+                    pass
+                continue
+            if line.startswith("WEAK-INVENTORY: "):
+                try:
+                    inv = json.loads(line[len("WEAK-INVENTORY: "):])
+                except ValueError:
+                    pass
+                continue
+            sys.stdout.write(line)
+            sys.stdout.flush()
+        proc.wait()
+        if proc.returncode != 0:
+            rc = 1
+    if inv is None:
+        inv = scaling.inventory()
+    artifact = scaling.write_weak_artifact(points, inv, out_dir)
+    ok = sum(1 for p in points if p.get("ok"))
+    print(
+        f"weak_scaling: {ok}/{len(points)} point(s) ok across meshes "
+        f"{sizes}"
+        + (" (FAKE devices - logic proof, never gates)"
+           if inv.get("fake", True) else "")
+        + f" -> {os.path.relpath(artifact)}"
+    )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

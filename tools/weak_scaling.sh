@@ -1,46 +1,18 @@
 #!/bin/sh
-# Weak-scaling table (docs/NEXT.md pod item): per-kernel wall-clock of
-# the distributed-capable C drivers as the mesh grows, problem size
-# scaled with the mesh so per-chip work is constant.
+# DEPRECATED thin wrapper (the sgemm_tune.py pattern): the weak-scaling
+# sweep now lives in tools/weak_scaling.py, which emits structured
+# scaling artifacts + journal events instead of a grep-me stdout table
+# (docs/DISTRIBUTED.md §observability). This wrapper keeps the old
+# calling convention alive:
 #
 #   tools/weak_scaling.sh "1 2 4 8"     # mesh sizes (default "1 2 4 8")
+#   FAKE=1 tools/weak_scaling.sh ...    # fake CPU devices
 #
-# On a pod: run as-is once per host (chips visible to jax). On the dev
-# box: FAKE=1 tools/weak_scaling.sh runs on fake CPU devices — numbers
-# are meaningless there, but the harness, shardings and scaled shapes
-# are exactly what the pod run will use, so a pod session only has to
-# run one command and read the table.
-#
-# Per-chip work held constant: stencil rows, N-body bodies, scan/hist
-# elements and the allreduce message all scale linearly with N (N-body
-# is O(N^2) total — linear per chip when i-bodies shard).
-set -e
-cd "$(dirname "$0")/../c"
-
-sizes="${1:-1 2 4 8}"
-base_rows=512        # stencil rows per chip (x 1024 cols)
-base_bodies=2048     # N-body bodies per chip
-base_elems=1048576   # scan/hist elements per chip
-base_msg=4194304     # allreduce floats per chip
-
-for n in $sizes; do
-  env_common="TPK_MESH=$n"
-  if [ "${FAKE:-0}" = "1" ]; then
-    env_common="$env_common PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-XLA_FLAGS=--xla_force_host_platform_device_count=$n"
-  fi
-  echo "== mesh n=$n"
-  # shellcheck disable=SC2086
-  env $env_common ./bin/stencil --device=tpu --check --reps=3 \
-      --n=$((base_rows * n)) --m=1024 --iters=50
-  # shellcheck disable=SC2086
-  env $env_common ./bin/nbody --device=tpu --check --reps=3 \
-      --n=$((base_bodies * n)) --iters=2
-  # shellcheck disable=SC2086
-  env $env_common ./bin/scan_histogram --device=tpu --check --reps=3 \
-      --n=$((base_elems * n))
-  # shellcheck disable=SC2086
-  env $env_common ./bin/allreduce_bench --device=tpu --check --reps=3 \
-      --n=$((base_msg * n))
-done
-echo "weak_scaling: done (grep 'metric=' lines into the table)"
+# Old semantics preserved: no FAKE = the caller's real devices (a pod
+# host) = --real; FAKE=1 = the python tool's fake-device default.
+echo "weak_scaling.sh: deprecated - delegating to tools/weak_scaling.py" >&2
+dir="$(dirname "$0")"
+real_flag="--real"
+[ "${FAKE:-0}" = "1" ] && real_flag=""
+# shellcheck disable=SC2086
+exec python "$dir/weak_scaling.py" ${1:+--sizes "$1"} $real_flag

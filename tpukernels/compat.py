@@ -13,6 +13,18 @@ Known drift resolved today:
   (jax 0.4.x, e.g. the 0.4.37 this container bakes in). Same fields
   either way (``dimension_semantics``, ``vmem_limit_bytes``, ...), so
   the alias is a plain name fix, not an adapter.
+- ``jax.shard_map`` (jax >= 0.6 top-level export, ``check_vma``
+  kwarg) vs ``jax.experimental.shard_map.shard_map`` (0.4.x,
+  ``check_rep`` kwarg). :func:`shard_map` resolves the import AND
+  translates the kwarg, so ``parallel/collectives.py`` states the
+  modern surface once. This was the root cause of the 37 pre-existing
+  ``test_distributed``/``test_graft_entry`` tier-1 failures: every
+  collective import died on ``from jax import shard_map`` before any
+  fake-device logic even ran.
+- ``jax.lax.pcast`` (jax >= 0.7 varying-type system). 0.4.x has no
+  device-varying type distinction, so the cast is simply unnecessary
+  there: :func:`pcast` forwards when the primitive exists and returns
+  the operand unchanged when it does not.
 
 Import-order note: this module imports jax, so it must NOT be imported
 by ``import tpukernels`` (registry stays lazy / jax-free). Only kernel
@@ -21,6 +33,10 @@ modules and other already-jax-bound code may import it.
 
 from __future__ import annotations
 
+import inspect
+import os
+
+import jax
 from jax.experimental import pallas as pl  # noqa: F401  (re-export)
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (re-export)
 
@@ -34,3 +50,81 @@ if CompilerParams is None:  # pragma: no cover - would mean a 3rd rename
         "TPUCompilerParams - a new Pallas API drift; teach "
         "tpukernels/compat.py the new name"
     )
+
+# shard_map: top-level on new jax, experimental on 0.4.x
+if hasattr(jax, "shard_map"):
+    _shard_map_impl = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+# the replication-check kwarg rename: check_vma (new) vs check_rep
+# (0.4.x). Introspect once so the adapter below never guesses.
+_SHARD_MAP_KWARGS = set(
+    inspect.signature(_shard_map_impl).parameters
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the modern signature on any jax.
+
+    ``check_vma`` (None = backend default) is translated to the 0.4.x
+    ``check_rep`` spelling when that is what the installed jax takes —
+    same semantics either way: False disables the replication/varying
+    type check for programs (the psum-of-replicated N-body) that are
+    intentionally outside it.
+    """
+    kw = {}
+    if check_vma is not None:
+        if "check_vma" in _SHARD_MAP_KWARGS:
+            kw["check_vma"] = check_vma
+        elif "check_rep" in _SHARD_MAP_KWARGS:
+            kw["check_rep"] = check_vma
+        # neither kwarg: a future jax dropped the knob — run with its
+        # default rather than erroring on a check we only ever relax
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
+def ensure_cpu_collectives() -> None:
+    """Enable cross-process collectives on the CPU backend.
+
+    Newer jax defaults ``jax_cpu_collectives_implementation`` to gloo;
+    0.4.x ships it off, so a multi-process fake-device job dies with
+    "Multiprocess computations aren't implemented on the CPU backend"
+    at the first psum. Call BEFORE ``jax.distributed.initialize`` on a
+    CPU-platform job (the fake-device test rigs and dev-box pod
+    rehearsals; real pods run the TPU backend and never enter this).
+    """
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] != "cpu":
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001
+        pass  # option gone = a jax where gloo is already the default
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` where it exists; on 0.4.x
+    (which never grew the predicate) the same answer read off the
+    distributed client's global state — the idempotence guard
+    ``mesh.maybe_distributed_init`` needs either way."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return fn()
+    try:  # the 0.4.x spelling of "has initialize() already run"
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 — treat unknowable as "no"
+        return False
+
+
+def pcast(x, axes, to: str):
+    """``jax.lax.pcast`` where it exists; identity where the installed
+    jax predates the varying-type system (0.4.x) and the cast has
+    nothing to do."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axes, to=to)

@@ -348,3 +348,17 @@ def _roofline_check(metric, latest):
 def analyze_repo(root, eps=CEILING_EPS) -> dict:
     """One-call path for tools: series + baseline + verdicts."""
     return analyze(load_series(root), load_baseline(root), eps=eps)
+
+
+def analyze_scaling_repo(root, eps=CEILING_EPS) -> dict:
+    """The distributed-path series families (docs/OBSERVABILITY.md
+    §scaling; ``tpukernels/obs/scaling.py``): bus-bw per (op, size,
+    n_devices) judged with this module's vocabulary — ``regression``
+    at the same epsilon band, ``impossible`` above the analytic
+    ICI ceiling (the roofline pattern), ``no_data`` for fake-only
+    series — plus the non-gating weak-scaling
+    ``below_scaling_efficiency`` verdict and the MULTICHIP dryrun-wall
+    series. Fake-device artifacts never produce a gating verdict."""
+    from tpukernels.obs import scaling
+
+    return scaling.analyze_repo(root, eps=eps)

@@ -17,6 +17,15 @@ every rank sends its S-byte buffer one hop, so the reported figure is
 per-link point-to-point bandwidth, bytes / t — the number that
 predicts halo-exchange cost directly.
 
+Observability (docs/OBSERVABILITY.md §scaling): every sweep point is
+journaled as a ``busbw_point`` event, and the CLI stamps a
+``device_inventory`` event then persists the whole sweep as a
+structured ``docs/logs/scaling_busbw_*.json`` artifact (redirect with
+``TPK_SCALING_DIR``) that ``tools/obs_report.py`` trend-checks —
+fake-device (non-TPU) artifacts are flagged ``fake`` and never gate.
+Stdout stays byte-identical to the pre-artifact CLI (the artifact
+path prints to stderr): the C driver greps these lines.
+
 CLI:  python -m tpukernels.parallel.busbw [--min=1KB] [--max=64MB]
           [--op=allreduce|ppermute]
 """
@@ -29,12 +38,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.obs import scaling
 from tpukernels.parallel.collectives import allreduce_sum, ring_shift
 from tpukernels.parallel.mesh import (
     host_to_global,
     make_mesh,
     row_sharding,
 )
+from tpukernels.resilience import journal
 
 
 def bus_bandwidth(seconds: float, nbytes: int, nranks: int) -> float:
@@ -72,6 +84,7 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
         mesh = make_mesh()  # joins the multi-host job when configured
     nranks = mesh.shape["x"]
     sharding = row_sharding(mesh)
+    fake = scaling.inventory(probe=True).get("fake", True)
     results = []
     size = min_bytes
     while size <= max_bytes:
@@ -85,19 +98,41 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
         fn = timed_program(op, mesh)  # see timed_program: un-DCE-able
         # warm-up (compile) then per-call timing with a 4-byte
         # materialization to force real completion (device-side
-        # block_until_ready is unreliable through the axon tunnel)
-        np.asarray(fn(x))
+        # block_until_ready is unreliable through the axon tunnel).
+        # The materialization blocks on ONE addressable shard; the
+        # barrier after it waits for every local device's execution —
+        # on multi-device-per-process CPU (gloo) a straggler device's
+        # collective ops would otherwise interleave with the NEXT
+        # program's and desync the transport pairs (tcp/pair.cc
+        # size-mismatch aborts). Outside the timed window by design;
+        # the warm-up keeps the materialization too, since through the
+        # axon tunnel block_until_ready alone can return early and a
+        # straggling compile would then bleed into the first timed rep.
+        w = fn(x)
+        np.asarray(w)
+        jax.block_until_ready(w)
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
-            np.asarray(fn(x))
+            r = fn(x)
+            np.asarray(r)
             t1 = time.perf_counter()
+            jax.block_until_ready(r)
             best = min(best, t1 - t0)
         if op == "allreduce":
             bw = bus_bandwidth(best, size, nranks)
         else:
             bw = size / best / 1e9  # per-link point-to-point
         results.append((size, best, bw))
+        # structured twin of the stdout line (docs/OBSERVABILITY.md
+        # §scaling): no I/O when journaling is off, nothing on stdout
+        # either way — the clean-path byte-identity proof covers this
+        obs_metrics.inc("scaling.busbw_points")
+        journal.emit(
+            "busbw_point", op=op, n_devices=nranks,
+            size_bytes=size, seconds=round(best, 6),
+            gb_s=round(bw, 4), fake=bool(fake),
+        )
         if verbose:
             print(
                 f"{op} n={nranks} size={size:>10d}B "
@@ -136,6 +171,7 @@ def _parse_size(s: str) -> int:
 
 
 if __name__ == "__main__":
+    import os
     import sys
 
     kw = {}
@@ -148,4 +184,17 @@ if __name__ == "__main__":
             kw["reps"] = int(a[7:])
         elif a.startswith("--op="):
             kw["op"] = a[5:]
-    sweep(**kw)
+    # CLI journal default (the bench.py/revalidate.py/loadgen.py
+    # contract): an unattended sweep's evidence lands in the day's
+    # health journal unless the operator chose otherwise
+    if os.environ.get("TPK_HEALTH_JOURNAL") is None:
+        os.environ["TPK_HEALTH_JOURNAL"] = journal.default_path()
+    inv = scaling.emit_inventory("busbw", probe=True)
+    mesh = make_mesh()
+    res = sweep(mesh=mesh, **kw)
+    artifact = scaling.write_busbw_artifact(
+        res, kw.get("op", "allreduce"), mesh.shape["x"], inv
+    )
+    # stderr, not stdout: the sweep table above is the byte-stable
+    # surface the C driver (and the byte-identity proof) reads
+    print(f"# busbw artifact: {artifact}", file=sys.stderr)

@@ -39,8 +39,12 @@ import os
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
+# drift-prone names resolve in compat (docs/DISTRIBUTED.md): jax's
+# shard_map moved twice and renamed its check kwarg across the 0.4->0.9
+# span, and lax.pcast only exists on the new surface
+from tpukernels.compat import pcast, shard_map
+from tpukernels.obs import trace
 from tpukernels.utils import cdiv
 
 # Every public entry builds its shard_map program through an
@@ -72,7 +76,8 @@ def _allreduce_build(mesh: Mesh, axis: str):
 def allreduce_sum(x, mesh: Mesh, axis: str = "x"):
     """MPI_Allreduce(SUM): x is (P, S) with row r = rank r's
     contribution; every row of the result is the elementwise sum."""
-    return _allreduce_build(mesh, axis)(x)
+    with trace.span("collective/allreduce", n=mesh.shape[axis]):
+        return _allreduce_build(mesh, axis)(x)
 
 
 @functools.lru_cache(maxsize=None)
@@ -115,7 +120,8 @@ def ring_shift(x, mesh: Mesh, axis: str = "x", shift: int = 1):
     is what rank r received, i.e. row (r - shift) mod P. This is the
     primitive under the stencil halo exchange and the N-body j-ring —
     exposed bare so its link bandwidth is measurable (busbw.py)."""
-    return _ring_shift_build(mesh, axis, int(shift))(x)
+    with trace.span("collective/ring_shift", n=mesh.shape[axis]):
+        return _ring_shift_build(mesh, axis, int(shift))(x)
 
 
 def bcast(x, mesh: Mesh, axis: str = "x", root: int = 0):
@@ -126,7 +132,8 @@ def bcast(x, mesh: Mesh, axis: str = "x", root: int = 0):
     nranks = mesh.shape[axis]
     if not 0 <= root < nranks:
         raise ValueError(f"root={root} out of range for {nranks} ranks")
-    return _bcast_build(mesh, axis, int(root))(x)
+    with trace.span("collective/bcast", n=nranks):
+        return _bcast_build(mesh, axis, int(root))(x)
 
 
 # ------------------------------------------------------------- stencil
@@ -167,9 +174,10 @@ def _jacobi_dist(x, iters: int, mesh: Mesh, axis: str, k: int,
     # clamp BEFORE the cache lookup so raw k values with the same
     # effective depth share one compiled program
     k = max(1, min(int(k), x.shape[0] // nranks))
-    return _jacobi_dist_build(
-        x.shape, int(iters), mesh, axis, k, bool(residual)
-    )(x)
+    with trace.span(f"collective/jacobi{len(x.shape)}d", n=nranks, k=k):
+        return _jacobi_dist_build(
+            x.shape, int(iters), mesh, axis, k, bool(residual)
+        )(x)
 
 
 @functools.lru_cache(maxsize=None)
@@ -260,7 +268,8 @@ def scan_dist(x, mesh: Mesh, axis: str = "x", exclusive: bool = False):
     nranks = mesh.shape[axis]
     if n % nranks:
         raise ValueError(f"N={n} must divide across {nranks} ranks")
-    return _scan_dist_build(mesh, axis, bool(exclusive))(x)
+    with trace.span("collective/scan", n=nranks):
+        return _scan_dist_build(mesh, axis, bool(exclusive))(x)
 
 
 @functools.lru_cache(maxsize=None)
@@ -302,7 +311,8 @@ def histogram_dist(x, nbins: int, mesh: Mesh, axis: str = "x"):
     nranks = mesh.shape[axis]
     if n % nranks:
         raise ValueError(f"N={n} must divide across {nranks} ranks")
-    return _hist_dist_build(int(nbins), mesh, axis)(x)
+    with trace.span("collective/histogram", n=nranks):
+        return _hist_dist_build(int(nbins), mesh, axis)(x)
 
 
 @functools.lru_cache(maxsize=None)
@@ -325,8 +335,9 @@ def _hist_dist_build(nbins: int, mesh: Mesh, axis: str):
             )
 
         # the carry must be typed as device-varying over the mesh axis
-        # (the body mixes in xl, which is) or the scan carry types clash
-        init = jax.lax.pcast(
+        # (the body mixes in xl, which is) or the scan carry types
+        # clash; on pre-varying-type jax the cast is an identity
+        init = pcast(
             jnp.zeros((nbins,), jnp.int32), (axis,), to="varying"
         )
         counts = jax.lax.fori_loop(0, nchunks, body, init)
@@ -391,9 +402,10 @@ def nbody_dist_psum(state, steps: int, mesh: Mesh, axis: str = "x",
     forces on all bodies from its j-partition, then `psum` combines
     (SURVEY.md C8/§3(c)). state = (px,py,pz,vx,vy,vz,m), all (N,)."""
     _nbody_check_divisible(state, mesh, axis)
-    return _nbody_psum_build(
-        int(steps), mesh, axis, float(dt), float(eps)
-    )(*state)
+    with trace.span("collective/nbody_psum", n=mesh.shape[axis]):
+        return _nbody_psum_build(
+            int(steps), mesh, axis, float(dt), float(eps)
+        )(*state)
 
 
 @functools.lru_cache(maxsize=None)
@@ -466,9 +478,11 @@ def nbody_dist_ring(state, steps: int, mesh: Mesh, axis: str = "x",
     # pass drops BOTH directions' dead rotations). Default stays off
     # until the pod A/B (docs/NEXT.md) measures it.
     bidir = os.environ.get("TPK_NBODY_RING_BIDIR") == "1"
-    return _nbody_ring_build(
-        int(steps), mesh, axis, float(dt), float(eps), skip_last, bidir
-    )(*state)
+    with trace.span("collective/nbody_ring", n=mesh.shape[axis]):
+        return _nbody_ring_build(
+            int(steps), mesh, axis, float(dt), float(eps), skip_last,
+            bidir
+        )(*state)
 
 
 @functools.lru_cache(maxsize=None)

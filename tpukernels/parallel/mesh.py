@@ -27,13 +27,21 @@ def maybe_distributed_init() -> None:
     call, so the C driver's warm-up + timed reps repeat it) funnels
     through here.
     """
-    if jax.distributed.is_initialized():
+    from tpukernels.compat import (
+        distributed_is_initialized,
+        ensure_cpu_collectives,
+    )
+
+    if distributed_is_initialized():
         return
     addr = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
     )
     if not addr:
         return
+    # CPU-platform multi-process jobs (fake-device rehearsals) need
+    # the gloo collectives backend that 0.4.x jax ships disabled
+    ensure_cpu_collectives()
     # num_processes/process_id: jax reads JAX_COORDINATOR_ADDRESS
     # itself but fills the other two only from cluster auto-detection
     # (Slurm/OMPI/TPU-metadata). Pass them from the env explicitly so

@@ -730,6 +730,14 @@ class Supervisor:
     def run_queue(self) -> int:
         """One queue attempt (one healthy window). Returns the
         exit-code contract value (RC_* above)."""
+        # env-derived hardware stamp (docs/OBSERVABILITY.md §scaling):
+        # the supervisor must never initialize a backend (a wedged
+        # tunnel would hang the whole queue), so probe stays off — the
+        # step children that touch devices stamp their own jax-backed
+        # inventories
+        from tpukernels.obs import scaling as _scaling
+
+        _scaling.emit_inventory("supervisor")
         events, _bad = journal.load_events(self._history_paths())
         est = estimate_window_minutes(events)
         # measured-cost refinement: steps that opted in (cost_from)
