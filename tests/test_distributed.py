@@ -609,6 +609,81 @@ def test_multiprocess_busbw_sweep():
     """)
 
 
+def test_multiprocess_busbw_cli():
+    """`python -m tpukernels.parallel.busbw` — the exact entry the
+    supervisor's busbw_sweep step runs on a pod — must survive a
+    coordinator-configured env: jax.distributed.initialize (inside
+    make_mesh) has to run BEFORE the backend-initializing
+    device-inventory probe, or every pod host crashes (or, on jaxes
+    without the init-order guard, silently meshes only local chips).
+    Exercises the __main__ path itself, not sweep()."""
+    run_two_procs("""
+        import glob, json, os, sys, tempfile
+        pid = int(sys.argv[1])
+        tmp = tempfile.mkdtemp()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = \\
+            "--xla_force_host_platform_device_count=4"
+        os.environ["JAX_COORDINATOR_ADDRESS"] = "127.0.0.1:{port}"
+        os.environ["JAX_NUM_PROCESSES"] = "2"
+        os.environ["JAX_PROCESS_ID"] = str(pid)
+        os.environ["TPK_SCALING_DIR"] = tmp
+        os.environ["TPK_HEALTH_JOURNAL"] = \\
+            os.path.join(tmp, "health.jsonl")
+        import runpy
+        sys.argv = ["busbw", "--min=1024", "--max=4096", "--reps=1"]
+        runpy.run_module("tpukernels.parallel.busbw",
+                         run_name="__main__")
+        import jax
+        assert jax.process_count() == 2
+        (art,) = glob.glob(os.path.join(tmp, "scaling_busbw_*.json"))
+        rec = json.load(open(art))
+        assert rec["n_devices"] == 8  # global mesh, not local-only
+        inv = rec["device_inventory"]
+        assert inv["source"] == "jax" and inv["process_count"] == 2
+        print(f"proc {{pid}}: OK")
+    """)
+
+
+def test_multiprocess_weak_scaling_inner():
+    """tools/weak_scaling.py --inner under a coordinator (the --real
+    pod mode): inner() must join the multi-host job before its
+    device-inventory probe initializes the backend. Runs the
+    multi-process-safe allreduce program only (the others feed
+    host-local full arrays, the single-process fake-device design)."""
+    run_two_procs("""
+        import importlib.util, json, os, sys, tempfile
+        pid = int(sys.argv[1])
+        tmp = tempfile.mkdtemp()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = \\
+            "--xla_force_host_platform_device_count=4"
+        os.environ["JAX_COORDINATOR_ADDRESS"] = "127.0.0.1:{port}"
+        os.environ["JAX_NUM_PROCESSES"] = "2"
+        os.environ["JAX_PROCESS_ID"] = str(pid)
+        os.environ["TPK_HEALTH_JOURNAL"] = \\
+            os.path.join(tmp, "health.jsonl")
+        import tpukernels
+        repo = os.path.dirname(os.path.dirname(tpukernels.__file__))
+        spec = importlib.util.spec_from_file_location(
+            "weak_scaling",
+            os.path.join(repo, "tools", "weak_scaling.py"))
+        ws = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(ws)
+        ws.PROGRAMS = {{"allreduce": ws.PROGRAMS["allreduce"]}}
+        rc = ws.inner(8, 1, True)
+        assert rc == 0, "allreduce point failed under coordinator"
+        import jax
+        assert jax.process_count() == 2
+        invs = [json.loads(l) for l in
+                open(os.environ["TPK_HEALTH_JOURNAL"])]
+        (ev,) = [e for e in invs
+                 if e.get("kind") == "device_inventory"]
+        assert ev["source"] == "jax" and ev["process_count"] == 2
+        print(f"proc {{pid}}: OK")
+    """)
+
+
 def test_multiprocess_capi_mesh():
     """The C-shim adapters must work under real multi-process
     jax.distributed (SURVEY.md §7 "multi-chip under a C driver"): the

@@ -170,8 +170,8 @@ def test_busbw_impossible_above_ici_ceiling(tmp_path):
     root = tmp_path / "repo"
     out = root / "docs" / "logs"
     out.mkdir(parents=True)
-    inv = {"platform": "tpu", "device_kind": "tpu_v5_lite",
-           "fake": False}
+    inv = {"source": "jax", "platform": "tpu",
+           "device_kind": "tpu_v5_lite", "fake": False}
     ceil, _kind, basis = scaling.ceiling_gb_s(
         "allreduce", "tpu_v5_lite"
     )
@@ -370,9 +370,119 @@ def test_device_inventory_event(monkeypatch, tmp_path):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     inv = scaling.emit_inventory("test-site")  # env mode: no jax touch
     assert inv["source"] == "env" and inv["fake"] is True
+    assert inv["fake_basis"] == "declared-platform"
     (ev,) = _events(j, "device_inventory")
     assert ev["site"] == "test-site"
     assert ev["platform"] == "cpu" and ev["fake"] is True
+
+
+def test_device_inventory_unknown_platform(monkeypatch):
+    """Nothing declares a platform (the NORMAL pod config): the
+    env-derived stamp is fail-safe fake=True — unknown must never
+    read as chip evidence — but fake_basis='unknown-platform' keeps
+    it distinct from known-fake so a real pod's telemetry never
+    renders 'FAKE'."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    inv = scaling.inventory()
+    assert inv["source"] == "env" and inv["platform"] is None
+    assert inv["fake"] is True
+    assert inv["fake_basis"] == "unknown-platform"
+    # a declared TPU-flavored platform is known-real
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    inv = scaling.inventory()
+    assert inv["platform"] == "tpu" and inv["fake"] is False
+    assert inv["fake_basis"] == "declared-platform"
+
+
+def test_inventory_probe_fallthrough_forced_fake(monkeypatch):
+    """A REQUESTED probe that errors must not fall back to whatever
+    the env declares: on a JAX_PLATFORMS=tpu,cpu host a flaky runtime
+    would otherwise mint a fake=False stamp from an unprobed env."""
+    import jax
+
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    monkeypatch.setattr(
+        jax, "devices",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("flaky")),
+    )
+    inv = scaling.inventory(probe=True)
+    assert inv["source"] == "env" and inv["platform"] == "tpu"
+    assert inv["fake"] is True
+    assert inv["fake_basis"] == "unprobed-fallback"
+
+
+def test_unprobed_nonfake_artifact_excluded_from_gating(tmp_path):
+    """The docs/DISTRIBUTED.md contract, enforced: a fake=False
+    artifact whose device_inventory is env-derived (or missing) has
+    unattributed topology and must neither fire nor mask a gating
+    verdict — analyze_busbw flags it and verdicts no_data."""
+    root = tmp_path / "repo"
+    out = root / "docs" / "logs"
+    out.mkdir(parents=True)
+    inv = {"source": "env", "platform": "tpu", "fake": False,
+           "fake_basis": "declared-platform"}
+    scaling.write_busbw_artifact(
+        [(1 << 20, 1e-3, 30.0)], "allreduce", 8, inv,
+        out_dir=str(out),
+    )
+    v = scaling.analyze_repo(str(root))["busbw"][
+        "busbw/allreduce/n8/1048576B"
+    ]
+    assert v["verdict"] == "no_data" and v["valid_points"] == 0
+    assert any("unprobed" in f for f in v["flags"])
+    assert not scaling.gating_findings(
+        {"busbw": {"x": v}, "weak": {}}
+    )
+
+
+def test_weak_scaling_fallback_inventory_forced_fake(tmp_path):
+    """Parent fallback when every child dies before its inventory
+    probe (a shadowed numpy import crashes inner() at its first
+    statement): the artifact must be stamped fake=True with
+    fake_basis='unprobed-fallback' EVEN on a declared-TPU host —
+    gating-eligible evidence needs a probed (source='jax') inventory,
+    and a childless sweep must never read as chip evidence."""
+    bad = tmp_path / "badmod"
+    bad.mkdir()
+    (bad / "numpy.py").write_text('raise ImportError("fault-injected")')
+    out = tmp_path / "logs"
+    out.mkdir()
+    env = _scrubbed_env(None)
+    env["JAX_PLATFORMS"] = "tpu,cpu"  # declared-real host
+    env["PYTHONPATH"] += os.pathsep + str(bad)
+    env["TPK_SCALING_DIR"] = str(out)
+    env["TPK_HEALTH_JOURNAL"] = str(tmp_path / "health.jsonl")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "weak_scaling.py"),
+         "--sizes", "1", "--quick", "--reps", "1"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "NOT gating-eligible" in proc.stderr
+    assert "stamped fake, never gates" in proc.stdout
+    (art,) = list(out.glob("scaling_weak_*.json"))
+    rec = json.load(open(art))
+    assert rec["fake"] is True
+    assert rec["device_inventory"]["fake_basis"] == "unprobed-fallback"
+    assert rec["device_inventory"]["source"] == "env"
+    # the forced stamp is journaled too (the emit_inventory contract:
+    # artifact writers embed the same dict they stamped) — a journal
+    # tailer must not read the parent's plain env stamp (fake=False
+    # on this declared-TPU host) as the run's hardware attribution
+    (ev,) = [e for e in _events(tmp_path / "health.jsonl",
+                                "device_inventory")
+             if e["site"] == "weak_scaling:fallback"]
+    assert ev["fake"] is True
+    assert ev["fake_basis"] == "unprobed-fallback"
+    # narration: unknown/unprobed hardware is never labeled "FAKE"
+    r = _run_tool("health_report.py", "--journal",
+                  str(tmp_path / "health.jsonl"))
+    lines = [ln for ln in r.stdout.splitlines()
+             if "weak_scaling:fallback" in ln]
+    assert lines and "unprobed (treated fake for gating)" in lines[0]
+    assert "FAKE" not in lines[0]
 
 
 # ---------------------------------------------------------------- #

@@ -267,7 +267,16 @@ def _fmt(ev):
                 + (f" proc {ev.get('process_index')}/"
                    f"{ev.get('process_count')}"
                    if ev.get("process_count") else "")
-                + (" FAKE" if ev.get("fake") else ""))
+                # unknown-platform / unprobed stamps (the normal pod
+                # config leaves JAX_PLATFORMS unset; a failed or
+                # skipped probe forces fake) are fail-safe fake for
+                # gating but are NOT known-fake hardware — don't
+                # slander a real pod's telemetry with "FAKE"
+                + ((" platform unknown (treated fake for gating)"
+                    if ev.get("fake_basis") == "unknown-platform"
+                    else " unprobed (treated fake for gating)"
+                    if ev.get("fake_basis") == "unprobed-fallback"
+                    else " FAKE") if ev.get("fake") else ""))
     if kind == "busbw_point":
         return (f"{ts} [pid {pid}] busbw {ev.get('op')} n="
                 f"{ev.get('n_devices')} {ev.get('size_bytes')}B -> "

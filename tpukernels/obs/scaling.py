@@ -176,7 +176,19 @@ def inventory(probe: bool = False) -> dict:
     "jax happens to be imported" is not evidence that backend init is
     safe. ``fake`` is True when the platform is not a TPU one:
     fake-device CPU rehearsals produce logic evidence, never bandwidth
-    evidence.
+    evidence. ``fake_basis`` says WHY: ``"probe"`` (a backend
+    answered), ``"declared-platform"`` (the env named one),
+    ``"unknown-platform"`` — nothing declared a platform, which is the
+    NORMAL pod configuration (JAX_PLATFORMS unset), so the hardware is
+    unknown rather than known-fake — or ``"unprobed-fallback"``: a
+    REQUESTED probe failed, and a process that wanted a probe but
+    could not get one must never produce chip evidence, whatever the
+    env declares. Non-probe bases still stamp ``fake=True`` where the
+    platform is not known-real (fail-safe: unknown must never read as
+    chip evidence and never gates) but reports render "platform
+    unknown", not "FAKE"; gating-eligible artifacts must carry a
+    probed (``source="jax"``) inventory — :func:`analyze_busbw`
+    enforces it.
     """
     if probe:
         import jax
@@ -196,6 +208,7 @@ def inventory(probe: bool = False) -> dict:
                 "process_index": jax.process_index(),
                 "process_count": jax.process_count(),
                 "fake": platform not in ("tpu", "axon"),
+                "fake_basis": "probe",
             }
         except Exception:  # noqa: BLE001 — fall through to env
             pass
@@ -206,7 +219,7 @@ def inventory(probe: bool = False) -> dict:
     # a fake one
     platform = (os.environ.get("JAX_PLATFORMS") or "").split(",")[0] \
         or ("axon" if os.environ.get("PALLAS_AXON_POOL_IPS") else None)
-    return {
+    inv = {
         "source": "env",
         "platform": platform,
         "device_kind": None,
@@ -215,11 +228,24 @@ def inventory(probe: bool = False) -> dict:
         "process_index": None,
         "process_count": None,
         # env-derived: only a declared-CPU (or force-fake-device)
-        # platform is KNOWN fake; an axon/unset platform is unknown
-        # until a backend answers, and unknown must not read as chip
-        # evidence — so anything not TPU-flavored counts fake here too
+        # platform is KNOWN fake; an unset platform (the normal pod
+        # config) is unknown until a backend answers, and unknown must
+        # not read as chip evidence — so it counts fake here too, with
+        # fake_basis distinguishing it so a real pod's stamp renders
+        # "platform unknown", never the misleading "FAKE"
         "fake": not (platform in ("tpu", "axon")),
+        "fake_basis": ("declared-platform" if platform is not None
+                       else "unknown-platform"),
     }
+    if probe:
+        # a REQUESTED probe fell through to here (jax.devices()
+        # errored): whatever the env declares, this process could not
+        # attribute its work to a real topology — force the fail-safe
+        # so a flaky runtime on a declared-TPU host can never mint
+        # chip evidence from an unprobed stamp
+        inv["fake"] = True
+        inv["fake_basis"] = "unprobed-fallback"
+    return inv
 
 
 def emit_inventory(site: str, probe: bool = False) -> dict:
@@ -401,7 +427,9 @@ def busbw_series(artifacts) -> dict:
         fake = bool(art.get("fake", True))
         op = art.get("op") or "?"
         nd = art.get("n_devices")
-        kind = (art.get("device_inventory") or {}).get("device_kind")
+        inv = art.get("device_inventory") or {}
+        kind = inv.get("device_kind")
+        inv_source = inv.get("source")
         for pt in art["points"]:
             if not isinstance(pt, dict):
                 continue
@@ -413,6 +441,7 @@ def busbw_series(artifacts) -> dict:
                 "value": gbs,
                 "fake": fake,
                 "device_kind": kind,
+                "inv_source": inv_source,
                 "source": art.get("_source", "?"),
                 # the trend-parser escape hatch: a point marked
                 # invalidated at source (truthy value = the reason)
@@ -440,6 +469,19 @@ def analyze_busbw(artifacts, eps: float) -> dict:
         valid = []
         for p in pts:
             if p["fake"]:
+                continue
+            if p.get("inv_source") != "jax":
+                # the docs/DISTRIBUTED.md contract: gating-eligible
+                # evidence carries a PROBED inventory — a non-fake
+                # artifact stamped from the env (or with no inventory
+                # at all) has unattributed topology, so it must
+                # neither fire nor mask a gating verdict
+                flags.append(
+                    f"{p['value']} GB/s from {p['source']} carries an "
+                    f"unprobed device inventory "
+                    f"(source={p.get('inv_source')!r}) - excluded "
+                    "from gating"
+                )
                 continue
             ceil, kind, basis = ceiling_gb_s(op, p["device_kind"])
             over = p["value"] > ceil * (1.0 + eps)
@@ -476,8 +518,9 @@ def analyze_busbw(artifacts, eps: float) -> dict:
         elif not valid:
             info["verdict"] = "no_data"
             flags.append(
-                "fake-device evidence only (plumbing proof; excluded "
-                "from gating)" if pts else "no points"
+                "no validated evidence (fake-device or unprobed "
+                "points only; excluded from gating)" if pts
+                else "no points"
             )
         else:
             latest = info["latest"]

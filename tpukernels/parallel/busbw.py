@@ -189,8 +189,15 @@ if __name__ == "__main__":
     # health journal unless the operator chose otherwise
     if os.environ.get("TPK_HEALTH_JOURNAL") is None:
         os.environ["TPK_HEALTH_JOURNAL"] = journal.default_path()
-    inv = scaling.emit_inventory("busbw", probe=True)
+    # Mesh FIRST, inventory probe second: the probe's jax.devices()
+    # initializes the backend, and jax.distributed.initialize (inside
+    # make_mesh -> maybe_distributed_init) must run before any backend
+    # init — probing first crashes every coordinator-configured pod
+    # host (and on jaxes without the guard would silently mesh only
+    # this host's chips). tests/test_distributed.py
+    # test_multiprocess_busbw_cli pins this ordering.
     mesh = make_mesh()
+    inv = scaling.emit_inventory("busbw", probe=True)
     res = sweep(mesh=mesh, **kw)
     artifact = scaling.write_busbw_artifact(
         res, kw.get("op", "allreduce"), mesh.shape["x"], inv
