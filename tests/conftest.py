@@ -244,6 +244,34 @@ if "TPK_SCALING_DIR" not in os.environ:
         except OSError:
             pass
 
+# Isolate the serve daemon's runtime dir (docs/SERVING.md) the same
+# way: test-spawned daemons bind their Unix socket and flock their
+# pidfile here, and they must never collide with — or be stopped as —
+# an operator's real daemon under the repo .jax_cache. Stale
+# socket/pidfile leftovers from a killed previous run are cleared so
+# serve_ctl's liveness checks start from a clean slate. Tests that
+# assert daemon state point TPK_SERVE_DIR (or --socket) at their own
+# tmp path.
+# An exported TPK_SERVE_SOCKET (the capi routing switch) takes
+# precedence over TPK_SERVE_DIR everywhere it is read, so it would
+# route every capi/default-socket dispatch into the operator's REAL
+# daemon regardless of the isolation below — scrub it; tests that
+# want the daemon route set it explicitly on their own socket.
+os.environ.pop("TPK_SERVE_SOCKET", None)
+if "TPK_SERVE_DIR" not in os.environ:
+    import tempfile
+
+    _serve_dir = os.path.join(
+        tempfile.gettempdir(), f"tpk_serve_test_{os.getuid()}"
+    )
+    os.makedirs(_serve_dir, exist_ok=True)
+    os.environ["TPK_SERVE_DIR"] = _serve_dir
+    for _f in ("serve.sock", "serve.pid"):
+        try:
+            os.unlink(os.path.join(_serve_dir, _f))
+        except OSError:
+            pass
+
 # Persist compiled executables across suite runs (the shared knob —
 # tpukernels/_cachedir.py; `import tpukernels` is deliberately
 # jax-free, so this respects the env-before-jax-import rule below).

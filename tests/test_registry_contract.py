@@ -83,6 +83,18 @@ def test_registry_contract_complete():
             )
         t, basis = slo.resolve_target_s(name, "cpu", "probe")
         assert t and t > 0 and basis == "exact", (name, t, basis)
+        # serve bucketing surface (ISSUE 10, docs/SERVING.md): every
+        # kernel must STATE its padding rule — an explicit None (the
+        # stencils: padding changes the boundary condition) is a
+        # decision, an absent row is a kernel the serving daemon
+        # would wrongly refuse (or worse, wrongly pad)
+        from tpukernels.serve import bucketing
+
+        assert name in bucketing.PAD_RULES, (
+            f"{name} has no serve PAD_RULES row (bucketing cannot "
+            "decide whether padding preserves its answer)"
+        )
+        assert bucketing.PAD_RULES[name] in (None, "zero", "hist0")
 
 
 def test_derived_kernels_are_registered_and_tunable_through_base():

@@ -1,0 +1,837 @@
+"""CPU suite for the kernel-serving daemon (docs/SERVING.md;
+ISSUE 10).
+
+Covers the tentpole contracts without a TPU: protocol framing
+roundtrips, shape-bucket math (pad-up never pad-down, waste cap,
+pad/unpad correctness against the integrity oracles), the service
+loop itself — concurrent clients served through the daemon's SHARED
+per-process executable memo with exactly one compile per (kernel,
+bucket) asserted from ``aot_hit``/``aot_miss`` journal evidence —
+batching-window coalescing, backpressure rejection under a full
+queue, the wedged-worker → abandon → requeue-once chaos path via
+``TPK_FAULT_PLAN``, a byte-identical clean-path proof (responses and
+daemon stdout identical with journaling/tracing on vs off), the capi
+client route, and the e2e ``loadgen --serve`` → slo.json →
+``obs_report --check`` proof.
+"""
+
+import contextlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_distributed import _scrubbed_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a small scan avatar so the CPU tests prove the pad math without
+# materializing the 4M-element record shape
+SCAN_BUCKET = json.dumps(
+    {"scan": {"args": [["i32", [8192]]], "statics": {}},
+     "vector_add": {
+         "args": [["f32", []], ["f32", [1024]], ["f32", [1024]]],
+         "statics": {}}}
+)
+
+
+def _events(journal_path):
+    with open(journal_path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _aot_bucket_events(events, kernel, dim):
+    """aot_hit/aot_miss events whose key is ``kernel`` compiled at a
+    shape containing ``dim`` (the key's base field may carry
+    ``@tuned=``/statics suffixes)."""
+    out = []
+    for e in events:
+        if e.get("kind") not in ("aot_hit", "aot_miss"):
+            continue
+        parts = (e.get("key") or "").split("|")
+        if len(parts) < 2:
+            continue
+        if parts[0].split("@")[0] == kernel and dim in parts[1]:
+            out.append(e)
+    return out
+
+
+@contextlib.contextmanager
+def _daemon(tmp_path, env_extra=None, tag="d"):
+    """Spawn ``python -m tpukernels.serve`` on a tmp socket with an
+    isolated journal; yields (sock, journal_path, proc) and reaps the
+    daemon (SIGTERM — the clean ``serve_stop`` path) on exit."""
+    d = tmp_path / tag
+    d.mkdir(exist_ok=True)
+    sock = str(d / "s.sock")
+    journal = str(d / "health.jsonl")
+    env = _scrubbed_env(None)
+    env["TPK_SERVE_DIR"] = str(d)
+    env["TPK_HEALTH_JOURNAL"] = journal
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpukernels.serve", "--socket", sock],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    from tpukernels.serve import client as serve_client
+
+    try:
+        deadline = time.monotonic() + 60
+        while True:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"daemon died rc={proc.returncode}: "
+                    f"{proc.communicate()[1]}"
+                )
+            try:
+                with serve_client.ServeClient(sock, timeout_s=5) as c:
+                    c.ping()
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        yield sock, journal, proc
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(10)
+
+
+# ---------------------------------------------------------------- #
+# protocol                                                         #
+# ---------------------------------------------------------------- #
+
+def test_protocol_roundtrip():
+    import socket as socket_mod
+
+    from tpukernels.serve import protocol
+
+    a, b = socket_mod.socketpair()
+    try:
+        arrays = [np.float32(2.5), np.arange(7, dtype=np.int32),
+                  np.ones((3, 4), np.float32)]
+        specs, payloads = protocol.pack_arrays(arrays)
+        protocol.send_frame(
+            a, {"op": "dispatch", "id": 3, "kernel": "x",
+                "statics": {"iters": 2}, "args": specs},
+            payloads,
+        )
+        header, got_payloads = protocol.recv_frame(b)
+        assert header == {"op": "dispatch", "id": 3, "kernel": "x",
+                          "statics": {"iters": 2}, "args": specs}
+        got = protocol.unpack_arrays(header["args"], got_payloads)
+        for orig, back in zip(arrays, got):
+            np.testing.assert_array_equal(np.asarray(orig), back)
+            assert np.asarray(orig).dtype == back.dtype
+        # a zero-payload frame (ping) roundtrips too
+        protocol.send_frame(b, {"op": "ping"})
+        header, payloads = protocol.recv_frame(a)
+        assert header == {"op": "ping"} and payloads == []
+        # clean EOF at a frame boundary is None, not an error
+        b.close()
+        assert protocol.recv_frame(a) is None
+    finally:
+        a.close()
+        with contextlib.suppress(OSError):
+            b.close()
+
+
+def test_protocol_rejects_garbage():
+    import socket as socket_mod
+
+    from tpukernels.serve import protocol
+
+    a, b = socket_mod.socketpair()
+    try:
+        a.sendall(b"GET / HTTP/1.1\r\n" + b"\0" * 16)
+        with pytest.raises(protocol.ProtocolError, match="magic"):
+            protocol.recv_frame(b)
+        with pytest.raises(protocol.ProtocolError, match="dtype"):
+            protocol.pack_arrays([np.ones(3, np.float64)])
+        with pytest.raises(protocol.ProtocolError, match="needs"):
+            protocol.unpack_arrays(
+                [{"shape": [8], "dtype": "int32"}], [b"\0" * 4]
+            )
+    finally:
+        a.close()
+        b.close()
+
+
+def test_protocol_rejects_malformed_lens():
+    """A frame whose ``_lens`` is not a list of non-negative ints must
+    raise ProtocolError (the poisoned-connection contract) — not a
+    TypeError that would escape the daemon's client loop and kill the
+    handler thread."""
+    import socket as socket_mod
+
+    from tpukernels.serve import protocol
+
+    for lens in (None, "xx", {"n": 4}, [-4, 4], [2.5], [True]):
+        a, b = socket_mod.socketpair()
+        try:
+            hb = json.dumps({"op": "ping", "_lens": lens}).encode()
+            a.sendall(
+                protocol._PREAMBLE.pack(protocol.MAGIC, len(hb), 0) + hb
+            )
+            with pytest.raises(protocol.ProtocolError,
+                               match="_lens|disagree"):
+                protocol.recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------- #
+# bucket math                                                      #
+# ---------------------------------------------------------------- #
+
+def test_bucket_pad_up_never_down(monkeypatch):
+    from tpukernels.serve import bucketing
+
+    monkeypatch.setenv("TPK_SERVE_BUCKETS", SCAN_BUCKET)
+    monkeypatch.setenv("TPK_SERVE_MAX_PAD_FRAC", "0.9")
+    # under the avatar: buckets, with the right waste fraction
+    spec, frac = bucketing.bucket_for(
+        "scan", [np.zeros(6000, np.int32)], {}
+    )
+    assert spec is not None
+    assert frac == pytest.approx(1.0 - 6000 / 8192)
+    # exact fit: pad_frac 0
+    spec, frac = bucketing.bucket_for(
+        "scan", [np.zeros(8192, np.int32)], {}
+    )
+    assert spec is not None and frac == 0.0
+    # OVER the avatar: never padded down
+    spec, why = bucketing.bucket_for(
+        "scan", [np.zeros(10000, np.int32)], {}
+    )
+    assert spec is None and why == "over-avatar"
+    # waste over the cap: dispatch natively
+    monkeypatch.setenv("TPK_SERVE_MAX_PAD_FRAC", "0.1")
+    spec, why = bucketing.bucket_for(
+        "scan", [np.zeros(6000, np.int32)], {}
+    )
+    assert spec is None and why == "pad-over-cap"
+    # alien statics select a different program: no bucket
+    monkeypatch.setenv("TPK_SERVE_MAX_PAD_FRAC", "0.9")
+    spec, why = bucketing.bucket_for(
+        "scan", [np.zeros(6000, np.int32)], {"iters": 3}
+    )
+    assert spec is None and why == "statics-mismatch"
+    # wrong dtype never buckets
+    spec, why = bucketing.bucket_for(
+        "scan", [np.zeros(6000, np.float32)], {}
+    )
+    assert spec is None and why == "layout-mismatch"
+    # fail-loud knob parse
+    monkeypatch.setenv("TPK_SERVE_MAX_PAD_FRAC", "1.5")
+    with pytest.raises(ValueError, match="TPK_SERVE_MAX_PAD_FRAC"):
+        bucketing.bucket_for("scan", [np.zeros(6000, np.int32)], {})
+
+
+def test_inconsistent_operands_never_bucket(monkeypatch):
+    """Cross-operand shape disagreements registry.dispatch would
+    reject (sgemm inner dims, mismatched vector lengths) must not be
+    padded into a plausible-but-wrong answer: they dispatch natively
+    and fail honestly."""
+    from tpukernels.serve import bucketing
+
+    monkeypatch.setenv("TPK_SERVE_BUCKETS", json.dumps({
+        "vector_add": {"args": [["f32", []], ["f32", [1024]],
+                                ["f32", [1024]]], "statics": {}},
+        "sgemm": {"args": [["f32", []], ["f32", [64, 64]],
+                           ["f32", [64, 64]], ["f32", []],
+                           ["f32", [64, 64]]], "statics": {}},
+    }))
+    monkeypatch.setenv("TPK_SERVE_MAX_PAD_FRAC", "0.9")
+    spec, why = bucketing.bucket_for(
+        "vector_add",
+        [np.float32(1.0), np.zeros(900, np.float32),
+         np.zeros(1000, np.float32)], {},
+    )
+    assert spec is None and why == "inconsistent-args"
+    spec, why = bucketing.bucket_for(
+        "sgemm",
+        [np.float32(1.0), np.zeros((48, 40), np.float32),
+         np.zeros((32, 48), np.float32), np.float32(0.0),
+         np.zeros((48, 48), np.float32)], {},
+    )
+    assert spec is None and why == "inconsistent-args"
+    # consistent non-exact shapes still bucket
+    spec, frac = bucketing.bucket_for(
+        "sgemm",
+        [np.float32(1.0), np.zeros((48, 40), np.float32),
+         np.zeros((40, 48), np.float32), np.float32(0.0),
+         np.zeros((48, 48), np.float32)], {},
+    )
+    assert spec is not None and frac > 0
+
+
+def test_stencil_has_no_pad_rule(monkeypatch):
+    """Padding a stencil changes its boundary condition — only an
+    exact avatar fit may bucket."""
+    from tpukernels.serve import bucketing
+
+    monkeypatch.setenv(
+        "TPK_SERVE_BUCKETS",
+        json.dumps({"stencil2d": {"args": [["f32", [64, 256]]],
+                                  "statics": {"iters": 2}}}),
+    )
+    spec, why = bucketing.bucket_for(
+        "stencil2d", [np.zeros((40, 200), np.float32)], {"iters": 2}
+    )
+    assert spec is None and why == "no-pad-rule"
+    spec, frac = bucketing.bucket_for(
+        "stencil2d", [np.zeros((64, 256), np.float32)], {"iters": 2}
+    )
+    assert spec is not None and frac == 0.0
+
+
+def test_pad_unpad_matches_oracles(monkeypatch):
+    """Pad + dispatch-at-avatar + unpad must equal dispatch-at-native
+    for every kernel with a pad rule — proven against the integrity
+    layer's jnp oracles (the golden authority) at the canary shapes,
+    with avatars a few elements larger."""
+    import importlib
+
+    from tpukernels.resilience import integrity
+    from tpukernels.serve import bucketing
+
+    grown = {
+        "vector_add": {"args": [["f32", []], ["f32", [1037]],
+                                ["f32", [1037]]], "statics": {}},
+        "sgemm": {"args": [["f32", []], ["f32", [48, 80]],
+                           ["f32", [80, 64]], ["f32", []],
+                           ["f32", [48, 64]]], "statics": {}},
+        "scan": {"args": [["i32", [4128]]], "statics": {}},
+        "scan_exclusive": {"args": [["i32", [4128]]], "statics": {}},
+        "histogram": {"args": [["i32", [4128]]],
+                      "statics": {"nbins": 256}},
+        "scan_histogram": {"args": [["i32", [4128]]],
+                           "statics": {"nbins": 256}},
+        "nbody": {"args": [["f32", [224]]] * 7,
+                  "statics": {"dt": 1e-3, "eps": 1e-2, "steps": 1}},
+    }
+    monkeypatch.setenv("TPK_SERVE_BUCKETS", json.dumps(grown))
+    monkeypatch.setenv("TPK_SERVE_MAX_PAD_FRAC", "0.9")
+    for kernel, spec in grown.items():
+        mod_name, attr = integrity.ORACLES[kernel].split(":")
+        oracle = getattr(importlib.import_module(mod_name), attr)
+        args = integrity._build_args(kernel)
+        statics = dict(integrity.CANARY_CONFIGS[kernel]["statics"])
+        np_args = [
+            np.float32(a) if isinstance(a, float)
+            else np.int32(a) if isinstance(a, int) else a
+            for a in args
+        ]
+        matched, frac = bucketing.bucket_for(kernel, np_args, statics)
+        assert matched is not None and 0.0 < frac <= 0.9, (kernel, frac)
+        padded, meta = bucketing.pad_args(kernel, matched, np_args)
+        out_pad = oracle(*padded, **statics)
+        outs = tuple(
+            np.asarray(o)
+            for o in (out_pad if isinstance(out_pad, (tuple, list))
+                      else (out_pad,))
+        )
+        unpadded = bucketing.unpad_outputs(kernel, meta, outs)
+        want = oracle(*args, **statics)
+        wants = tuple(
+            np.asarray(o)
+            for o in (want if isinstance(want, (tuple, list))
+                      else (want,))
+        )
+        assert len(unpadded) == len(wants), kernel
+        kind, rtol, atol = integrity.tolerance(kernel)
+        for got, ref in zip(unpadded, wants):
+            assert got.shape == ref.shape, (kernel, got.shape, ref.shape)
+            if kind == "exact":
+                np.testing.assert_array_equal(got, ref, err_msg=kernel)
+            else:
+                np.testing.assert_allclose(
+                    got, ref, rtol=rtol, atol=atol, err_msg=kernel
+                )
+
+
+# ---------------------------------------------------------------- #
+# the service loop                                                 #
+# ---------------------------------------------------------------- #
+
+def test_concurrent_clients_share_one_compile_per_bucket(tmp_path):
+    """Three concurrent clients, two kernels, mixed (bucketable)
+    shapes: every response is correct and the daemon compiled each
+    (kernel, bucket) EXACTLY once — the shared executable memo,
+    asserted from aot_hit/aot_miss journal evidence. The capi client
+    route rides the same daemon."""
+    from tpukernels.serve import client as serve_client
+
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_WORKERS": "3",
+        "TPK_SERVE_BATCH_WINDOW_MS": "0",
+    }) as (sock, journal, proc):
+        lengths = [5000, 6000, 7000, 8000, 8192]
+        errors = []
+
+        def client_run(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                with serve_client.ServeClient(sock, timeout_s=120) as c:
+                    for n in lengths:
+                        x = rng.integers(-50, 50, n).astype(np.int32)
+                        out = c.dispatch("scan", x)
+                        np.testing.assert_array_equal(
+                            out, np.cumsum(x, dtype=np.int64
+                                           ).astype(np.int32)
+                        )
+                        assert out.shape == (n,)
+                    x = rng.standard_normal(1024).astype(np.float32)
+                    y = rng.standard_normal(1024).astype(np.float32)
+                    out = c.dispatch("vector_add", np.float32(2.0), x, y)
+                    np.testing.assert_allclose(out, 2.0 * x + y,
+                                               rtol=1e-6, atol=1e-6)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=client_run, args=(s,))
+                   for s in (1, 2, 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert not errors, errors
+    events = _events(journal)
+    served = [e for e in events if e.get("kind") == "serve_request"]
+    assert len(served) == 3 * (len(lengths) + 1)
+    assert all(e.get("ok") for e in served)
+    # the headline: one compile per (kernel, bucket) across ALL
+    # requests from all clients
+    assert len(_aot_bucket_events(events, "scan", "8192")) == 1
+    assert len(_aot_bucket_events(events, "vector_add", "1024")) == 1
+    # padding waste was recorded for the non-exact scans
+    fracs = [e.get("pad_frac") for e in served
+             if e.get("kernel") == "scan" and e.get("bucketed")]
+    assert any(f and f > 0 for f in fracs)
+    assert proc.poll() is None or proc.returncode == 0
+
+
+def test_batch_window_coalesces_same_bucket(tmp_path):
+    """With one worker and a generous window, a concurrent burst of
+    same-bucket requests is served as one coalesced batch."""
+    from tpukernels.serve import client as serve_client
+
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_WORKERS": "1",
+        "TPK_SERVE_BATCH_WINDOW_MS": "400",
+    }) as (sock, journal, _proc):
+        x = (np.arange(6000) % 17).astype(np.int32)
+        want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+        # warm first so the burst is not serialized behind a compile
+        with serve_client.ServeClient(sock, timeout_s=120) as c:
+            np.testing.assert_array_equal(c.dispatch("scan", x), want)
+        errors = []
+
+        def one():
+            try:
+                with serve_client.ServeClient(sock, timeout_s=120) as c:
+                    np.testing.assert_array_equal(
+                        c.dispatch("scan", x), want
+                    )
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=one) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors, errors
+    served = [e for e in _events(journal)
+              if e.get("kind") == "serve_request"]
+    assert len(served) == 7
+    assert max(e.get("batch_size") or 0 for e in served) >= 2
+
+
+def test_backpressure_rejects_with_retry_after(tmp_path):
+    """Queue depth 1, one worker, every dispatch slowed 1 s: a burst
+    of 8 concurrent requests gets mostly rejected-with-retry-after
+    (the admitted ones still answer correctly), and every rejection
+    is journaled."""
+    from tpukernels.serve import client as serve_client
+
+    plan = json.dumps({"slow_dispatch": {"kernel": "scan",
+                                         "delay_s": 1.0}})
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_WORKERS": "1",
+        "TPK_SERVE_BATCH_WINDOW_MS": "0",
+        "TPK_SERVE_QUEUE_MAX": "1",
+        "TPK_SERVE_REQUEST_TIMEOUT_S": "60",
+        "TPK_FAULT_PLAN": plan,
+    }) as (sock, journal, _proc):
+        x = (np.arange(6000) % 13).astype(np.int32)
+        want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+        ok, rejected, errors = [], [], []
+        lock = threading.Lock()
+
+        def one():
+            try:
+                with serve_client.ServeClient(sock, timeout_s=180) as c:
+                    out = c.dispatch("scan", x)
+                np.testing.assert_array_equal(out, want)
+                with lock:
+                    ok.append(1)
+            except serve_client.ServeRejected as e:
+                assert e.retry_after_s > 0
+                with lock:
+                    rejected.append(e.retry_after_s)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        assert not errors, errors
+        assert rejected, "a full queue must reject, not stretch latency"
+        assert ok, "admitted requests must still be served"
+        assert len(ok) + len(rejected) == 8
+    events = _events(journal)
+    assert (sum(1 for e in events if e.get("kind") == "serve_rejected")
+            == len(rejected))
+
+
+def test_wedged_worker_abandoned_and_request_requeued(tmp_path):
+    """The chaos headline: the FIRST dispatch wedges (SIGALRM-immune,
+    via TPK_FAULT_PLAN wedge_dispatch) — the watchdog abandons the
+    worker, classifies the timeout, re-queues the request ONCE, and
+    the retry answers the client correctly. The daemon stays healthy
+    for later requests."""
+    from tpukernels.serve import client as serve_client
+
+    plan = json.dumps({"wedge_dispatch": {"kernel": "scan",
+                                          "times": 1}})
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_WORKERS": "2",
+        "TPK_SERVE_REQUEST_TIMEOUT_S": "2",
+        "TPK_FAULT_PLAN": plan,
+    }) as (sock, journal, _proc):
+        x = (np.arange(6000) % 11).astype(np.int32)
+        want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+        with serve_client.ServeClient(sock, timeout_s=120) as c:
+            out = c.dispatch("scan", x)  # survives its own wedge
+            np.testing.assert_array_equal(out, want)
+            # the daemon still serves after abandoning a worker
+            out = c.dispatch("scan", x)
+            np.testing.assert_array_equal(out, want)
+    events = _events(journal)
+    requeued = [e for e in events
+                if e.get("kind") == "serve_request_requeued"]
+    assert len(requeued) == 1 and requeued[0]["kernel"] == "scan"
+    assert any(e.get("kind") == "wedge_classification"
+               and e.get("site") == "serve" for e in events)
+    assert any(e.get("kind") == "fault_injected"
+               and e.get("fault") == "wedge_dispatch" for e in events)
+    served = [e for e in events if e.get("kind") == "serve_request"]
+    assert [e.get("ok") for e in served] == [True, True]
+    assert served[0].get("requeues") == 1
+
+
+def test_batch_members_behind_wedge_are_rescued(tmp_path):
+    """Requests coalesced into a batch BEHIND a wedged request must
+    not be stranded in the abandoned worker's thread: the watchdog
+    rescues the unstarted remainder back to the queue when it abandons
+    the worker, so every client still gets its answer."""
+    from tpukernels.serve import client as serve_client
+
+    plan = json.dumps({"wedge_dispatch": {"kernel": "scan",
+                                          "times": 1}})
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_WORKERS": "1",
+        "TPK_SERVE_BATCH_WINDOW_MS": "500",
+        "TPK_SERVE_REQUEST_TIMEOUT_S": "2",
+        "TPK_FAULT_PLAN": plan,
+    }) as (sock, journal, _proc):
+        x = (np.arange(6000) % 7).astype(np.int32)
+        want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+        errors = []
+
+        def one(delay):
+            time.sleep(delay)
+            try:
+                # 30 s is far past wedge+rescue (~5 s) but far short
+                # of a stranded-forever hang
+                with serve_client.ServeClient(sock, timeout_s=30) as c:
+                    np.testing.assert_array_equal(
+                        c.dispatch("scan", x), want
+                    )
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        # first request wedges; the next two arrive inside the batch
+        # window and coalesce behind it on the single worker
+        threads = [threading.Thread(target=one, args=(d,))
+                   for d in (0.0, 0.15, 0.25)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+    events = _events(journal)
+    served = [e for e in events if e.get("kind") == "serve_request"]
+    assert len(served) == 3 and all(e.get("ok") for e in served)
+    assert (sum(1 for e in events
+                if e.get("kind") == "serve_request_requeued") == 1)
+
+
+def test_bucket_lock_waits_out_slow_holder_replaces_wedged():
+    """The one-compile-per-bucket lock discipline: a legitimately slow
+    holder (a cold compile) is waited out — the lock is NEVER replaced
+    from elapsed time alone — while a holder the watchdog abandoned as
+    wedged is replaced promptly so the bucket is not poisoned
+    forever."""
+    from tpukernels.serve import server as serve_server
+
+    srv = serve_server.Server(
+        socket_path="/nonexistent/unused.sock", queue_max=1, workers=1,
+        batch_window_ms=0.0, request_timeout_s=0.4,
+    )
+    held = {}
+    release = threading.Event()
+
+    def slow_holder():
+        cell = srv._acquire_bucket("b1")
+        held["cell"] = cell
+        time.sleep(1.2)  # slow but alive: never abandoned
+        with srv._lock:
+            cell[1] = None
+        cell[0].release()
+
+    t = threading.Thread(target=slow_holder)
+    t.start()
+    time.sleep(0.2)
+    t0 = time.monotonic()
+    cell = srv._acquire_bucket("b1")
+    waited = time.monotonic() - t0
+    t.join(10)
+    assert cell is held["cell"], "slow holder's lock must not be replaced"
+    assert waited > 0.6, f"must wait out the slow holder ({waited:.2f}s)"
+    with srv._lock:
+        cell[1] = None
+    cell[0].release()
+
+    def wedged_holder():
+        srv._acquire_bucket("b2")
+        held["wedged_ident"] = threading.get_ident()
+        release.wait(30)  # holds the lock past any timeout
+
+    t2 = threading.Thread(target=wedged_holder, daemon=True)
+    t2.start()
+    deadline = time.monotonic() + 10
+    while "wedged_ident" not in held:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    with srv._lock:
+        srv._abandoned.add(held["wedged_ident"])
+    old = srv._bucket_locks["b2"]
+    t0 = time.monotonic()
+    fresh = srv._acquire_bucket("b2")
+    assert fresh is not old, "wedged holder's lock must be replaced"
+    assert time.monotonic() - t0 < 5
+    release.set()
+    t2.join(10)
+
+
+def test_clean_path_responses_byte_identical(tmp_path):
+    """Observability must not perturb the service: a fixed request
+    sequence yields byte-identical response payloads whether the
+    daemon journals+traces or runs fully dark — and the daemon's
+    stdout is EMPTY both ways."""
+    from tpukernels.serve import client as serve_client
+
+    def run(tag, env_extra):
+        with _daemon(tmp_path, dict(env_extra, **{
+            "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+            "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        }), tag=tag) as (sock, _journal, proc):
+            outs = []
+            with serve_client.ServeClient(sock, timeout_s=120) as c:
+                for n in (5000, 8192):
+                    x = (np.arange(n) % 23).astype(np.int32)
+                    out = c.dispatch("scan", x)
+                    outs.append((out.shape, out.dtype.name,
+                                 out.tobytes()))
+            proc.terminate()
+            proc.wait(20)
+            return outs, proc.stdout.read()
+
+    dark, dark_stdout = run("dark", {"TPK_HEALTH_JOURNAL": "0"})
+    lit, lit_stdout = run("lit", {"TPK_TRACE": "1"})
+    assert dark == lit
+    assert dark_stdout == lit_stdout == ""
+
+
+def test_capi_routes_through_daemon(tmp_path, monkeypatch):
+    """With TPK_SERVE_SOCKET set, capi.run_from_c is one client among
+    many: the C-buffer roundtrip answers bit-identically and the
+    request lands in the DAEMON's journal; with the daemon gone, the
+    in-process fallback still answers."""
+    from tpukernels import capi
+
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+    }) as (sock, journal, _proc):
+        monkeypatch.setenv("TPK_SERVE_SOCKET", sock)
+        monkeypatch.setenv("TPK_INTEGRITY", "tripwire")
+        capi._SERVE_TLS.client = None
+        n = 6000
+        x = np.ascontiguousarray(np.arange(n) % 19, dtype=np.int32)
+        out = np.zeros(n, dtype=np.int32)
+        params = json.dumps({"buffers": [
+            {"shape": [n], "dtype": "i32"},
+            {"shape": [n], "dtype": "i32"},
+        ]})
+        assert capi.run_from_c(
+            "scan", params, [x.ctypes.data, out.ctypes.data]
+        ) == 0
+        np.testing.assert_array_equal(
+            out, np.cumsum(x, dtype=np.int64).astype(np.int32)
+        )
+        daemon_pid = _events(journal)[-1]["pid"]
+        served = [e for e in _events(journal)
+                  if e.get("kind") == "serve_request"]
+        assert served and served[-1]["kernel"] == "scan"
+        assert served[-1]["pid"] != os.getpid()
+    # daemon gone: the retained in-process fallback answers
+    capi._SERVE_TLS.client = None
+    out2 = np.zeros(n, dtype=np.int32)
+    assert capi.run_from_c(
+        "scan", params, [x.ctypes.data, out2.ctypes.data]
+    ) == 0
+    np.testing.assert_array_equal(out2, out)
+    monkeypatch.delenv("TPK_SERVE_SOCKET")
+    capi._SERVE_TLS.client = None
+    del daemon_pid
+
+
+# ---------------------------------------------------------------- #
+# loadgen --serve -> slo.json -> obs_report --check                #
+# ---------------------------------------------------------------- #
+
+def test_loadgen_serve_slo_verdict_e2e(tmp_path):
+    """The full service-path SLO loop: daemon up, `loadgen --serve`
+    drives it open-loop, the verdict lands validated in slo.json, and
+    `obs_report --check` gates it with the unchanged rc contract."""
+    slo_dir = tmp_path / "slo"
+    slo_dir.mkdir()
+    with _daemon(tmp_path) as (sock, journal, _proc):
+        env = _scrubbed_env(None)
+        env["TPK_SLO_DIR"] = str(slo_dir)
+        env["TPK_HEALTH_JOURNAL"] = str(tmp_path / "lg.jsonl")
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+             "--serve", sock, "--kernel", "scan", "--arrivals",
+             "poisson", "--seed", "7", "--requests", "30", "--rate",
+             "10", "--check"],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+            env=env,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "(SERVED)" in r.stdout
+        served = [e for e in _events(journal)
+                  if e.get("kind") == "serve_request"]
+        assert len(served) == 31  # 30 scheduled + 1 untimed warm
+    with open(slo_dir / "slo.json") as f:
+        entries = json.load(f)["entries"]
+    entry = entries["scan|probe|cpu"]
+    assert entry["verdict"] == "ok" and not entry["simulated"]
+    assert entry["run"]["served"] is True
+    assert entry["jax"] is not None  # the daemon's version, via ping
+    env = _scrubbed_env(None)
+    env["TPK_SLO_DIR"] = str(slo_dir)
+    chk = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+
+
+def test_loadgen_serve_usage_errors():
+    env = _scrubbed_env(None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+         "--serve", "/nonexistent.sock", "--simulate", "5",
+         "--requests", "5"],
+        capture_output=True, text=True, timeout=60, cwd=REPO, env=env,
+    )
+    assert r.returncode == 2
+    assert "exclusive" in r.stderr
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+         "--serve", "/nonexistent.sock", "--kernel", "scan",
+         "--requests", "5"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert r.returncode == 2
+    assert "unreachable" in r.stderr
+
+
+# ---------------------------------------------------------------- #
+# serve_ctl lifecycle                                              #
+# ---------------------------------------------------------------- #
+
+def test_serve_ctl_start_status_stop(tmp_path):
+    """The operator loop: start answers a ping, a second start is
+    refused rc 3 (flocked pidfile — the revalidate_lib convention),
+    stop releases cleanly, status reports DOWN after."""
+    ctl = os.path.join(REPO, "tools", "serve_ctl.py")
+    env = _scrubbed_env(None)
+    env["TPK_SERVE_DIR"] = str(tmp_path)
+    env["TPK_HEALTH_JOURNAL"] = str(tmp_path / "health.jsonl")
+
+    def run(*args, timeout=120):
+        return subprocess.run(
+            [sys.executable, ctl, *args], capture_output=True,
+            text=True, timeout=timeout, cwd=REPO, env=env,
+        )
+
+    try:
+        r = run("start", "--wait", "60")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "daemon up" in r.stdout
+        r = run("status")
+        assert r.returncode == 0 and "UP" in r.stdout, r.stdout
+        r = run("start", "--wait", "60")
+        assert r.returncode == 3, r.stdout + r.stderr
+        assert "already running" in r.stdout
+    finally:
+        r = run("stop")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stopped" in r.stdout
+    r = run("status")
+    assert r.returncode == 1 and "DOWN" in r.stdout
+    events = _events(str(tmp_path / "health.jsonl"))
+    assert any(e.get("kind") == "serve_start" for e in events)
+    assert any(e.get("kind") == "serve_stop" for e in events)

@@ -763,9 +763,11 @@ def test_production_plan_order_reproduces_next_md(tmp_path,
         order.append(spec.name)
         sup._settled.add(spec.name)       # pretend it went green
         sup._attempted.add(spec.name)
-    assert order[:9] == ["prewarm_all", "bench", "slo_probe",
-                         "obs_check", "roofline_report",
-                         "busbw_sweep", "c_gate", "c_scan_timing",
-                         "profile"]
+    # serve_probe (value 10 / 2 min) ties obs_check's density and
+    # lands between the in-process slo_probe and the CPU-only checks
+    assert order[:10] == ["prewarm_all", "bench", "slo_probe",
+                          "serve_probe", "obs_check",
+                          "roofline_report", "busbw_sweep", "c_gate",
+                          "c_scan_timing", "profile"]
     assert order[-2:] == ["san_asan", "san_ubsan"]
     assert len(order) == len(cli.PRODUCTION_QUEUE)

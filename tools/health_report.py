@@ -257,6 +257,36 @@ def _fmt(ev):
     if kind == "slo_rejected":
         return (f"{ts} [pid {pid}] slo verdict REJECTED "
                 f"{ev.get('key')}: {ev.get('reason')}")
+    if kind == "serve_start":
+        return (f"{ts} [pid {pid}] serve daemon STARTED on "
+                f"{ev.get('socket')} ({ev.get('workers')} worker(s), "
+                f"queue max {ev.get('queue_max')}, batch window "
+                f"{ev.get('batch_window_ms')}ms)")
+    if kind == "serve_request":
+        # per-request events are high-volume; the narrative renders
+        # only the notable ones (requeued retries, errors) and the
+        # aggregate table (_serve_table) carries the rest
+        if ev.get("ok") and not ev.get("requeues"):
+            return None
+        return (f"{ts} [pid {pid}] serve request {ev.get('request')} "
+                f"({ev.get('kernel')}) "
+                + ("completed after requeue" if ev.get("ok")
+                   else f"FAILED: {ev.get('error')}"))
+    if kind == "serve_rejected":
+        return (f"{ts} [pid {pid}] serve REJECTED a {ev.get('kernel')} "
+                f"request (queue depth {ev.get('depth')} >= "
+                f"{ev.get('queue_max')}; retry after "
+                f"{ev.get('retry_after_s')}s)")
+    if kind == "serve_request_requeued":
+        return (f"{ts} [pid {pid}] serve request {ev.get('request')} "
+                f"({ev.get('kernel')}) REQUEUED after "
+                f"{ev.get('timeout_s')}s - worker abandoned, one "
+                "retry")
+    if kind == "serve_stop":
+        return (f"{ts} [pid {pid}] serve daemon stopped: "
+                f"{ev.get('served')} served, {ev.get('rejected')} "
+                f"rejected, {ev.get('requeued')} requeued over "
+                f"{ev.get('uptime_s')}s")
     if kind == "device_inventory":
         n = ev.get("n_devices")
         return (f"{ts} [pid {pid}] device inventory ({ev.get('site')}, "
@@ -402,6 +432,42 @@ def _step_table(events):
     return out
 
 
+def _serve_table(events):
+    """Per-kernel served-request aggregate from the high-volume
+    ``serve_request`` events (docs/SERVING.md) — requests, mean wall,
+    mean pad waste, max batch — so the narrative stays readable while
+    nothing is dropped."""
+    rows: dict = {}
+    for ev in events:
+        if ev.get("kind") != "serve_request":
+            continue
+        r = rows.setdefault(ev.get("kernel", "?"), {
+            "n": 0, "ok": 0, "wall": 0.0, "pad": 0.0, "bucketed": 0,
+            "batch_max": 0, "requeued": 0,
+        })
+        r["n"] += 1
+        r["ok"] += 1 if ev.get("ok") else 0
+        r["wall"] += ev.get("wall_s") or 0.0
+        r["pad"] += ev.get("pad_frac") or 0.0
+        r["bucketed"] += 1 if ev.get("bucketed") else 0
+        r["batch_max"] = max(r["batch_max"], ev.get("batch_size") or 0)
+        r["requeued"] += 1 if ev.get("requeues") else 0
+    if not rows:
+        return []
+    out = ["served requests (from serve_request events):"]
+    for kernel in sorted(rows):
+        r = rows[kernel]
+        out.append(
+            f"  {kernel:<16} n={r['n']:<5} ok={r['ok']:<5} "
+            f"mean_wall={r['wall'] / r['n']:.4f}s "
+            f"bucketed={r['bucketed']} "
+            f"mean_pad={r['pad'] / r['n']:.1%} "
+            f"batch_max={r['batch_max']}"
+            + (f" requeued={r['requeued']}" if r["requeued"] else "")
+        )
+    return out
+
+
 def summarize(events, bad=0) -> str:
     out = []
     events = sorted(events, key=lambda e: e.get("t", 0.0))
@@ -421,6 +487,10 @@ def summarize(events, bad=0) -> str:
     steps = _step_table(events)
     if steps:
         out.extend(steps)
+        out.append("-" * 60)
+    served = _serve_table(events)
+    if served:
+        out.extend(served)
         out.append("-" * 60)
     breakdown = _span_breakdown(events)
     if breakdown:
@@ -446,7 +516,9 @@ def summarize(events, bad=0) -> str:
         f"{counts.get('step_quarantined', 0)} quarantined step(s), "
         f"{counts.get('output_integrity_failed', 0)} output-integrity "
         "failure(s), "
-        f"{counts.get('slo_breach', 0)} SLO breach(es)"
+        f"{counts.get('slo_breach', 0)} SLO breach(es), "
+        f"{counts.get('serve_rejected', 0)} serve rejection(s), "
+        f"{counts.get('serve_request_requeued', 0)} serve requeue(s)"
     )
     return "\n".join(out)
 

@@ -8,6 +8,8 @@ Usage:
                             --requests 120 --shapes record
     python tools/loadgen.py --requests 200 --simulate 5   # no jax
     python tools/loadgen.py --requests 200 --print-schedule
+    python tools/loadgen.py --serve default --kernel scan \\
+                            --requests 200            # drive the daemon
 
 bench.py measures steady-state slope throughput; a service is judged
 on per-request latency under bursty arrivals — queueing, compile
@@ -16,8 +18,9 @@ p99 (docs/OBSERVABILITY.md §latency SLOs). This tool generates a
 deterministic OPEN-LOOP arrival schedule (arrivals never wait for
 service — when dispatch stalls, later requests queue and their
 latency counts the wait, so coordinated omission cannot hide a
-stall), drives ``registry.dispatch`` (the serving path of record
-until the daemon lands), records per-request latency into the
+stall), drives ``registry.dispatch`` in-process — or the serving
+daemon over its socket with ``--serve`` (docs/SERVING.md) — records
+per-request latency into the
 log-bucketed ``slo.latency_s.<kernel>`` histograms
 (``tpukernels/obs/metrics.py``), judges them against the per-kernel
 SLO targets (``tpukernels/obs/slo.py``) and persists the verdicts
@@ -44,6 +47,18 @@ registry kernel; ``k1=w1,k2=w2`` weights them.
 clock (single-server queue, seeded service times around MS; no jax
 import): the plumbing/determinism proof. Simulated verdicts are
 persisted flagged ``simulated`` and NEVER gate.
+
+``--serve SOCKET`` (``default`` = the ``TPK_SERVE_SOCKET``/serve-dir
+resolution) drives the kernel-serving daemon (docs/SERVING.md)
+instead of in-process ``registry.dispatch`` — the same schedule, the
+same completion-minus-SCHEDULED-arrival latency arithmetic, so the
+SLO verdicts judge the real service path end to end: queueing,
+bucketing/padding, batching windows and backpressure all land in the
+tail. This client process never imports jax (device_kind and jax
+version come from the daemon's ping). An admission-control rejection
+is retried after the daemon's ``retry_after_s`` hint — the retries'
+wait counts in the request's latency — and dropped loudly
+(``slo.dropped.<kernel>``) after 10 rejections.
 
 This process defaults ``TPK_INTEGRITY=tripwire`` (an explicit env
 choice wins): the sampled oracle canary checks would inject periodic
@@ -182,6 +197,33 @@ def _operands(kernel, shape_class):
             else _probe_operands)(kernel)
 
 
+def _operands_np(kernel, shape_class):
+    """Numpy twin of :func:`_operands` for the ``--serve`` client
+    path (jax-free by design): host scalars become 0-d arrays, the
+    dispatch memo's canonicalization, applied client-side."""
+    import numpy as np
+
+    if shape_class == "record":
+        from tpukernels import aot
+
+        spec = aot.BENCH_CONFIGS[kernel]
+        dt = {"f32": np.float32, "i32": np.int32}
+        args = tuple(
+            dt[kind](1) if shape == () else np.ones(shape, dt[kind])
+            for kind, shape in spec["args"]
+        )
+        return args, dict(spec["statics"])
+    from tpukernels.resilience import integrity
+
+    args = tuple(
+        np.float32(a) if isinstance(a, float)
+        else np.int32(a) if isinstance(a, int)
+        else a
+        for a in integrity._build_args(kernel)
+    )
+    return args, dict(integrity.CANARY_CONFIGS[kernel]["statics"])
+
+
 # ------------------------------------------------------------------ #
 # execution                                                          #
 # ------------------------------------------------------------------ #
@@ -237,6 +279,80 @@ def run_real(schedule, shape_class: str, echo) -> None:
         obs_metrics.observe(f"slo.service_s.{kernel}", s1 - s0)
 
 
+def run_serve(schedule, shape_class: str, socket_path: str, echo):
+    """Drive the serving daemon through the schedule, open-loop — the
+    ``run_real`` arithmetic with the daemon in place of
+    ``registry.dispatch``. Latency stays completion minus SCHEDULED
+    arrival, so daemon queueing, batching windows and backpressure
+    retries all count; one untimed dispatch per (kernel, shapes)
+    warms the daemon's executable memo first. Returns the daemon's
+    ping stats (device_kind, jax version) for the verdict record."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import protocol as serve_protocol
+
+    def dispatch_patiently(cli, kernel, args, statics) -> bool:
+        """One request, honoring backpressure (the shared
+        ``dispatch_with_backpressure`` policy; the retry waits count
+        in the caller's latency clock): ten rejections, a
+        daemon-reported dispatch error, or transport trouble mid-run
+        (the client reconnects lazily) drop the request LOUDLY
+        (stderr + counter) — one daemon hiccup must never crash the
+        remaining schedule or discard the samples already recorded."""
+        try:
+            serve_client.dispatch_with_backpressure(
+                cli, kernel, args, statics
+            )
+            return True
+        except serve_client.ServeRejected:
+            obs_metrics.inc(f"slo.dropped.{kernel}")
+            print(f"# dropped {kernel} request after "
+                  "10 rejection(s)", file=sys.stderr)
+            return False
+        except serve_client.ServeError as e:
+            obs_metrics.inc(f"slo.dropped.{kernel}")
+            print(f"# dropped {kernel} request: daemon error "
+                  f"{e}", file=sys.stderr)
+            return False
+        except (OSError, serve_protocol.ProtocolError) as e:
+            obs_metrics.inc(f"slo.dropped.{kernel}")
+            print(f"# dropped {kernel} request: transport trouble "
+                  f"{e!r}", file=sys.stderr)
+            return False
+
+    cli = serve_client.ServeClient(socket_path)
+    stats = cli.ping()  # reachability gate: a dead socket aborts HERE
+    prepared = {}
+    for kernel in sorted({k for _t, k in schedule}):
+        prepared[kernel] = _operands_np(kernel, shape_class)
+        args, statics = prepared[kernel]
+        w0 = time.perf_counter()
+        warmed = dispatch_patiently(cli, kernel, args, statics)
+        echo(f"# warmed {kernel} in {time.perf_counter() - w0:.3f}s"
+             " (served)" + ("" if warmed else " DROPPED"))
+    t0 = time.perf_counter()
+    for t, kernel in schedule:
+        now = time.perf_counter() - t0
+        if t > now:
+            time.sleep(t - now)
+        args, statics = prepared[kernel]
+        s0 = time.perf_counter()
+        if dispatch_patiently(cli, kernel, args, statics):
+            s1 = time.perf_counter()
+            obs_metrics.inc(f"slo.requests.{kernel}")
+            obs_metrics.observe(f"slo.latency_s.{kernel}", (s1 - t0) - t)
+            obs_metrics.observe(f"slo.service_s.{kernel}", s1 - s0)
+    # re-ping AFTER the dispatches: the daemon resolves device_kind /
+    # jax lazily on its first dispatch, and the verdict record should
+    # carry them when available — but a daemon that died at the very
+    # end must not discard the run (keep the initial stats)
+    try:
+        stats = cli.ping()
+    except (OSError, serve_protocol.ProtocolError):
+        pass
+    cli.close()
+    return stats
+
+
 def _parse_mix(raw: str | None, kernel: str | None) -> dict:
     from tpukernels import aot
 
@@ -265,7 +381,7 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     kernel = mix_raw = None
     arrivals, rate, requests = "poisson", DEFAULT_RATE, 200
-    duration = simulate_ms = None
+    duration = simulate_ms = serve_sock = None
     seed = None
     shape_class, period = "probe", 60.0
     print_schedule = check = False
@@ -274,6 +390,8 @@ def main(argv=None):
         for a in it:
             if a == "--kernel":
                 kernel = next(it)
+            elif a == "--serve":
+                serve_sock = next(it)
             elif a == "--mix":
                 mix_raw = next(it)
             elif a == "--arrivals":
@@ -316,6 +434,14 @@ def main(argv=None):
     if period <= 0:
         print("loadgen: --period must be > 0", file=sys.stderr)
         return 2
+    if serve_sock is not None and simulate_ms is not None:
+        print("loadgen: --serve and --simulate are exclusive (the "
+              "virtual clock has no daemon)", file=sys.stderr)
+        return 2
+    if serve_sock == "default":
+        from tpukernels.serve import client as _serve_client
+
+        serve_sock = _serve_client.default_socket_path()
     try:
         if seed is None:
             seed = default_seed()
@@ -348,11 +474,25 @@ def main(argv=None):
     obs_scaling.emit_inventory("loadgen")
 
     echo = lambda line: print(line)  # noqa: E731
+    serve_stats = None
     t_run0 = time.perf_counter()
     with trace.span("loadgen/run", arrivals=arrivals, seed=seed):
         if simulate_ms is not None:
             run_simulated(schedule, seed, simulate_ms)
             kind = "cpu"
+        elif serve_sock is not None:
+            from tpukernels.serve import protocol as serve_protocol
+
+            try:
+                serve_stats = run_serve(schedule, shape_class,
+                                        serve_sock, echo)
+            except (OSError, serve_protocol.ProtocolError) as e:
+                print(f"loadgen: serve daemon at {serve_sock} "
+                      f"unreachable: {e}", file=sys.stderr)
+                return 2
+            # the daemon is the device-bound process; judge against
+            # ITS device kind, not this jax-free client's
+            kind = serve_stats.get("device_kind") or "cpu"
         else:
             run_real(schedule, shape_class, echo)
             from tpukernels.tuning import cache as tcache
@@ -368,7 +508,9 @@ def main(argv=None):
         simulated=simulate_ms is not None,
     )
     jax_version = None
-    if simulate_ms is None:
+    if serve_stats is not None:
+        jax_version = serve_stats.get("jax")
+    elif simulate_ms is None:
         import jax
 
         jax_version = jax.__version__
@@ -376,6 +518,7 @@ def main(argv=None):
         "arrivals": arrivals, "seed": seed, "rate": rate,
         "requests": len(schedule), "duration": duration,
         "wall_s": round(wall, 3),
+        "served": serve_sock is not None,
     }
     artifact = slo.record(verdicts, run_info, jax_version=jax_version)
     journal.emit(
@@ -410,6 +553,7 @@ def main(argv=None):
         f"loadgen: {len(schedule)} request(s), {arrivals} arrivals, "
         f"seed {seed}, {shape_class} shapes on {kind}"
         + (" (SIMULATED)" if simulate_ms is not None else "")
+        + (" (SERVED)" if serve_sock is not None else "")
         + f", wall {wall:.1f}s -> {os.path.relpath(artifact)}"
         + (f"; BREACH: {','.join(breached)}" if breached else "")
     )

@@ -113,6 +113,38 @@ fi
 """, gating=False, stamp="never", timeout_s=150, cost_min=2,
       value=12, after=("prewarm_all",),
       inputs=("tpukernels", "tools/loadgen.py")),
+    # 0b'. served-path tail probe (docs/SERVING.md): start the kernel
+    #      daemon, drive it 60 s with the same open-loop Poisson load
+    #      at record shapes THROUGH the socket, shut it down cleanly —
+    #      so every healthy window also buys a p99 datapoint for the
+    #      real service path (queueing, bucketing, batching windows),
+    #      not just in-process dispatch. Non-gating (obs_check picks a
+    #      confirmed breach up as rc 1 WARN), never stamped, after
+    #      prewarm_all so the daemon opens onto a warm manifest; the
+    #      stop runs whatever the loadgen rc so a failed burst cannot
+    #      leak a daemon into the next window.
+    S("serve_probe", """
+set -o pipefail
+serve_log="docs/logs/serve_probe_$(date +%Y-%m-%d_%H%M%S).log"
+serve_probe_body() {
+  python tools/serve_ctl.py start --wait 30 || return $?
+  timeout -k 10 100 python tools/loadgen.py --serve default \\
+      --mix all --arrivals poisson --duration 60 --rate 8 \\
+      --requests 0 --shapes record
+  rc=$?
+  python tools/serve_ctl.py stop
+  return $rc
+}
+if serve_probe_body >"$serve_log" 2>&1; then
+  tail -1 "$serve_log"
+else
+  echo "WARN: serve probe failed rc=$? (non-gating) - $serve_log"
+  exit 1
+fi
+""", gating=False, stamp="never", timeout_s=200, cost_min=2, value=10,
+      after=("prewarm_all",),
+      inputs=("tpukernels/serve", "tools/loadgen.py",
+              "tools/serve_ctl.py")),
     # 0c. bus-bandwidth sweep (docs/OBSERVABILITY.md §scaling): the
     #     paper's multi-chip metric of record, captured as a
     #     structured scaling artifact + busbw_point journal events the

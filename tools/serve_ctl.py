@@ -1,0 +1,189 @@
+"""Operator control of the kernel-serving daemon (docs/SERVING.md).
+
+Usage:
+    python tools/serve_ctl.py start [--wait S] [--socket PATH]
+    python tools/serve_ctl.py stop [--wait S]
+    python tools/serve_ctl.py status
+
+``start`` spawns ``python -m tpukernels.serve`` detached (its own
+session; stderr appended to ``serve_daemon.log`` beside the socket)
+and waits until the daemon answers a protocol ping. ``stop`` sends
+SIGTERM to the pid the flocked pidfile records and waits for the
+flock to release — the clean-shutdown path that emits ``serve_stop``.
+``status`` is the ``revalidate.py --whos-holding`` idea applied to
+the daemon: liveness is the FLOCK on the pidfile (a dead daemon's
+stale pid never reads as running), the recorded pid is the
+diagnosis, and a live daemon also answers a ping with its stats.
+
+Exit codes: 0 — done (``status``: daemon is up); 1 — failed
+(``status``: daemon is down); 2 — usage error; 3 — ``start`` refused
+because a live daemon already holds the pidfile (the wrapper's
+"already covered" code).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpukernels import _cachedir  # noqa: E402
+from tpukernels.serve import client as serve_client  # noqa: E402
+from tpukernels.serve import protocol as serve_protocol  # noqa: E402
+
+
+def _pidfile_state():
+    """(held, pid_or_None): held = a live daemon process flocks the
+    pidfile (the revalidate_lib convention — test the lock, never
+    trust the pid alone)."""
+    import fcntl
+
+    path = _cachedir.serve_pidfile_path()
+    try:
+        f = open(path)
+    except OSError:
+        return False, None
+    with f:
+        content = f.readline().strip()
+        pid = int(content) if content.isdigit() else None
+        try:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+            fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+        except OSError:
+            return True, pid
+    return False, pid
+
+
+def _ping(socket_path):
+    # ProtocolError too: a daemon mid-shutdown hangs up before
+    # answering, which must read as "not (yet) up", not a traceback
+    try:
+        with serve_client.ServeClient(socket_path, timeout_s=5) as cli:
+            return cli.ping()
+    except (OSError, serve_protocol.ProtocolError):
+        return None
+
+
+def start(wait_s: float, socket_path) -> int:
+    socket_path = socket_path or _cachedir.serve_socket_path()
+    held, pid = _pidfile_state()
+    if held:
+        print(f"serve_ctl: daemon already running (pid {pid}) - "
+              "leave it, or stop it first")
+        return 3
+    d = _cachedir.serve_dir()
+    os.makedirs(d, exist_ok=True)
+    log_path = os.path.join(d, "serve_daemon.log")
+    log = open(log_path, "a")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpukernels.serve",
+         "--socket", socket_path],
+        cwd=_REPO, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=log,
+    )
+    log.close()
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            print(f"serve_ctl: daemon exited rc={proc.returncode} "
+                  f"before answering - see {log_path}",
+                  file=sys.stderr)
+            return 1
+        stats = _ping(socket_path)
+        if stats:
+            print(f"serve_ctl: daemon up (pid {stats.get('pid')}, "
+                  f"{stats.get('workers')} worker(s)) on {socket_path}")
+            return 0
+        time.sleep(0.2)
+    print(f"serve_ctl: daemon did not answer within {wait_s}s - "
+          f"killing it; see {log_path}", file=sys.stderr)
+    proc.terminate()
+    return 1
+
+
+def stop(wait_s: float) -> int:
+    held, pid = _pidfile_state()
+    if not held:
+        print("serve_ctl: no daemon running"
+              + (f" (stale pid {pid} in pidfile)" if pid else ""))
+        return 0
+    if pid is None:
+        print("serve_ctl: pidfile flocked but records no pid - "
+              "inspect by hand (fuser on the socket)", file=sys.stderr)
+        return 1
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except OSError as e:
+        print(f"serve_ctl: cannot signal pid {pid}: {e}",
+              file=sys.stderr)
+        return 1
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        held, _pid = _pidfile_state()
+        if not held:
+            print(f"serve_ctl: daemon (pid {pid}) stopped")
+            return 0
+        time.sleep(0.2)
+    print(f"serve_ctl: daemon (pid {pid}) still holds the pidfile "
+          f"after {wait_s}s - escalate by hand if it is truly wedged",
+          file=sys.stderr)
+    return 1
+
+
+def status(socket_path=None) -> int:
+    held, pid = _pidfile_state()
+    if not held:
+        print("serve_ctl: daemon DOWN"
+              + (f" (stale pid {pid} in pidfile)" if pid else ""))
+        return 1
+    stats = _ping(socket_path or _cachedir.serve_socket_path())
+    if stats is None:
+        print(f"serve_ctl: pid {pid} holds the pidfile but the "
+              "socket does not answer - starting up, or wedged")
+        return 1
+    print(
+        f"serve_ctl: daemon UP (pid {stats.get('pid')}) - "
+        f"served={stats.get('served')} rejected={stats.get('rejected')}"
+        f" requeued={stats.get('requeued')} depth={stats.get('depth')}"
+        f"/{stats.get('queue_max')} device={stats.get('device_kind')}"
+        f" uptime={stats.get('uptime_s')}s"
+    )
+    return 0
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if not argv or argv[0] not in ("start", "stop", "status"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    cmd = argv[0]
+    wait_s, socket_path = 30.0, None
+    it = iter(argv[1:])
+    try:
+        for a in it:
+            if a == "--wait":
+                wait_s = float(next(it))
+            elif a == "--socket":
+                socket_path = next(it)
+            else:
+                print(__doc__, file=sys.stderr)
+                print(f"serve_ctl: unknown argument {a!r}",
+                      file=sys.stderr)
+                return 2
+    except (StopIteration, ValueError):
+        print(f"serve_ctl: {a} needs a value", file=sys.stderr)
+        return 2
+    if cmd == "start":
+        return start(wait_s, socket_path)
+    if cmd == "stop":
+        return stop(wait_s)
+    return status(socket_path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

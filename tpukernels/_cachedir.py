@@ -187,6 +187,42 @@ def slo_path(env: dict | None = None) -> str:
     return os.path.join(d, "slo.json")
 
 
+def serve_dir(env: dict | None = None) -> str:
+    """Runtime directory of the kernel-serving daemon
+    (docs/SERVING.md; ``tpukernels/serve/``): the Unix-domain socket
+    and the flocked pidfile live here, beside the caches whose warm
+    path the daemon serves — unless ``TPK_SERVE_DIR`` redirects (tests
+    isolate it per suite run so a test daemon can never collide with,
+    or be stopped as, the operator's real one). Same
+    read-the-env-per-call rule as the tuning/AOT/integrity/SLO paths.
+    """
+    target = os.environ if env is None else env
+    d = target.get("TPK_SERVE_DIR")
+    if not d:
+        d = target.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            _REPO, ".jax_cache"
+        )
+    return d
+
+
+def serve_socket_path(env: dict | None = None) -> str:
+    """Path of the serve daemon's Unix-domain socket. An explicit
+    ``TPK_SERVE_SOCKET`` wins (it is also the client-side routing
+    switch — docs/SERVING.md); otherwise ``serve.sock`` under
+    :func:`serve_dir`."""
+    target = os.environ if env is None else env
+    explicit = target.get("TPK_SERVE_SOCKET")
+    if explicit:
+        return explicit
+    return os.path.join(serve_dir(env), "serve.sock")
+
+
+def serve_pidfile_path(env: dict | None = None) -> str:
+    """The daemon's flocked pidfile (the ``revalidate_lib.sh`` lock
+    convention: test the flock, not just the pid)."""
+    return os.path.join(serve_dir(env), "serve.pid")
+
+
 def integrity_manifest_path(env: dict | None = None) -> str:
     return os.path.join(integrity_dir(env), "integrity.json")
 

@@ -519,6 +519,12 @@ def run_cached(name: str, fn, args, statics=None, sources=None):
 # nothing is allocated, nothing executes, so the whole registered
 # suite precompiles on any host (CPU-provable; on a TPU host the same
 # call fills the remote-compile cache off-window).
+# These avatars are ALSO the serving daemon's default shape-bucket
+# table (tpukernels/serve/bucketing.py, docs/SERVING.md): incoming
+# requests are zero-padded up to the nearest avatar so client traffic
+# lands on exactly the executables prewarm compiled — change a shape
+# here and both the prewarm surface and the serving buckets move
+# together.
 BENCH_CONFIGS = {
     "vector_add": {
         "args": (("f32", ()), ("f32", (1 << 20,)), ("f32", (1 << 20,))),

@@ -34,16 +34,23 @@ Design constraints, in order:
    without re-deriving bucket arithmetic. Memory stays bounded: a
    bucket per occupied power-of-2^(1/4), never a sample list.
 
-State is per-process (bench ``--one`` children snapshot their own);
-:func:`reset` exists for tests.
+State is per-process (bench ``--one`` children snapshot their own)
+and THREAD-SAFE: a single module lock guards every record/snapshot,
+because the serve daemon's worker threads (docs/SERVING.md) bump the
+same counters concurrently and a ``get + set`` race would silently
+lose increments the tests assert on. The lock is uncontended on
+every single-threaded path, so the clean-path cost stays a dict
+update; :func:`reset` exists for tests.
 """
 
 from __future__ import annotations
 
 import math
+import threading
 
 from tpukernels.resilience import journal
 
+_LOCK = threading.Lock()
 _COUNTERS: dict = {}
 _GAUGES: dict = {}
 _HISTS: dict = {}  # name -> [count, sum, min, max, {bucket: count}]
@@ -99,29 +106,32 @@ def percentiles(count: int, max_value: float, buckets: dict,
 
 def inc(name: str, n: float = 1):
     """Add ``n`` (default 1) to counter ``name``, creating it at 0."""
-    _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
 
 
 def gauge(name: str, value: float):
     """Set gauge ``name`` to ``value`` (last write wins)."""
-    _GAUGES[name] = value
+    with _LOCK:
+        _GAUGES[name] = value
 
 
 def observe(name: str, value: float):
     """Record one sample into histogram ``name``."""
-    h = _HISTS.get(name)
-    if h is None:
-        _HISTS[name] = [1, value, value, value,
-                        {bucket_index(value): 1}]
-    else:
-        h[0] += 1
-        h[1] += value
-        if value < h[2]:
-            h[2] = value
-        if value > h[3]:
-            h[3] = value
-        b = bucket_index(value)
-        h[4][b] = h[4].get(b, 0) + 1
+    with _LOCK:
+        h = _HISTS.get(name)
+        if h is None:
+            _HISTS[name] = [1, value, value, value,
+                            {bucket_index(value): 1}]
+        else:
+            h[0] += 1
+            h[1] += value
+            if value < h[2]:
+                h[2] = value
+            if value > h[3]:
+                h[3] = value
+            b = bucket_index(value)
+            h[4][b] = h[4].get(b, 0) + 1
 
 
 def _hist_row(v) -> dict:
@@ -146,11 +156,12 @@ def snapshot() -> dict:
     {...}, "histograms": {name: {count, sum, min, max, p50, p95, p99,
     buckets}}}`` — max is exact, p50/p95/p99 are count-weighted from
     the log buckets (clamped to max)."""
-    return {
-        "counters": dict(_COUNTERS),
-        "gauges": dict(_GAUGES),
-        "histograms": {k: _hist_row(v) for k, v in _HISTS.items()},
-    }
+    with _LOCK:
+        return {
+            "counters": dict(_COUNTERS),
+            "gauges": dict(_GAUGES),
+            "histograms": {k: _hist_row(v) for k, v in _HISTS.items()},
+        }
 
 
 def emit_snapshot(site: str | None = None):
@@ -166,9 +177,10 @@ def emit_snapshot(site: str | None = None):
 
 def reset():
     """Drop all recorded state (tests; never called on real paths)."""
-    _COUNTERS.clear()
-    _GAUGES.clear()
-    _HISTS.clear()
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+        _HISTS.clear()
 
 
 def _atexit_flush():

@@ -24,14 +24,17 @@ measurement), ``slope/compile`` / ``slope/execute`` (the ``_slope``
 phases inside it), ``probe/liveness``, ``registry/populate``,
 ``capi/<kernel>``, ``tune/<kernel>``. Nested spans join their names
 onto the enclosing path: ``measure/sgemm`` > ``slope/compile`` lands
-as ``measure/sgemm/slope/compile``. State is per-process (the span
-stack is not thread-safe by design — the instrumented paths are
-single-threaded measurement loops).
+as ``measure/sgemm/slope/compile``. The span stack is PER-THREAD
+(``threading.local``): the measurement loops stay single-threaded,
+but the serve daemon's worker threads (docs/SERVING.md) each trace
+their own ``serve/<kernel>`` requests concurrently, and a shared
+stack would interleave their paths into nonsense.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 from tpukernels.resilience import journal
@@ -45,7 +48,14 @@ def _read_enabled() -> bool:
 
 
 _ENABLED = _read_enabled()
-_STACK: list = []  # enclosing span names, innermost last (per process)
+_TLS = threading.local()  # .stack: enclosing span names per thread
+
+
+def _stack() -> list:
+    s = getattr(_TLS, "stack", None)
+    if s is None:
+        s = _TLS.stack = []
+    return s
 
 
 def enabled() -> bool:
@@ -54,17 +64,19 @@ def enabled() -> bool:
 
 def reload() -> bool:
     """Re-read TPK_TRACE (tests flip the env mid-process; real runs
-    load once at import, like the fault layer). Clears the span stack:
-    a stale parent path must not prefix spans from the new regime."""
+    load once at import, like the fault layer). Clears the calling
+    thread's span stack: a stale parent path must not prefix spans
+    from the new regime."""
     global _ENABLED
     _ENABLED = _read_enabled()
-    _STACK.clear()
+    _stack().clear()
     return _ENABLED
 
 
 def current_path() -> str | None:
     """Slash-joined path of the innermost open span, or None."""
-    return "/".join(_STACK) if _STACK else None
+    s = _stack()
+    return "/".join(s) if s else None
 
 
 class _NoopSpan:
@@ -100,9 +112,10 @@ class _Span:
         self.fields = fields
 
     def __enter__(self):
-        _STACK.append(self.name)
-        self.depth = len(_STACK)
-        self.path = "/".join(_STACK)
+        s = _stack()
+        s.append(self.name)
+        self.depth = len(s)
+        self.path = "/".join(s)
         self.t0 = time.perf_counter()
         return self
 
@@ -111,8 +124,9 @@ class _Span:
         # unwind by identity, tolerating a stack corrupted by an
         # earlier non-LIFO exit: observability must not mask (or
         # worsen) the failure it is observing
-        if _STACK and _STACK[-1] == self.name:
-            _STACK.pop()
+        s = _stack()
+        if s and s[-1] == self.name:
+            s.pop()
         payload = {
             ("param_" + k if k in _RESERVED else k): v
             for k, v in self.fields.items()

@@ -121,8 +121,12 @@ def dispatch(name: str, *args, **statics):
     executable memo is invalidated, and repeat offenders are
     quarantined. ``TPK_INTEGRITY=0`` makes this a single check.
 
-    Dispatch is the serving path of record (until the daemon lands),
-    so it is latency-instrumented for the SLO layer
+    Dispatch is the serving path of record — the serve daemon
+    (``tpukernels/serve``, docs/SERVING.md) funnels every client
+    request through this exact function, so the fault point, the
+    executable memo and the integrity guard police the service the
+    same way they police a batch run — and it is
+    latency-instrumented for the SLO layer
     (docs/OBSERVABILITY.md §latency SLOs): a ``dispatch/<kernel>``
     span (no-op unless ``TPK_TRACE``), a ``dispatch.calls.<kernel>``
     counter and a ``dispatch.wall_s.<kernel>`` histogram per call —

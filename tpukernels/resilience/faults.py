@@ -47,6 +47,15 @@ contract and examples):
   ``tests/test_slo.py``. ``kernel`` omitted matches any; ``every``
   defaults to 1; a bare string is ``{"kernel": ...}`` sugar; the
   same ``"env"`` clause as wedge_metric narrows the match.
+- ``"wedge_dispatch": {"kernel": "scan", "times": 1}`` — the first
+  ``times`` matching ``registry.dispatch`` calls WEDGE (the same
+  SIGALRM-immune hang as ``wedge_metric``, but at the serving
+  dispatch point): the serve daemon's worker-watchdog chaos proof —
+  a wedged worker thread is abandoned, the request re-queued once,
+  and the retry (past the ``times`` budget) runs clean
+  (docs/SERVING.md §watchdog). ``times`` defaults to 1 (0 = every
+  matching call); ``kernel`` omitted matches any; a bare string is
+  ``{"kernel": ...}`` sugar; the same ``"env"`` clause narrows.
 - ``"corrupt_output": {"kernel": "sgemm", "site": "registry"}`` /
   ``"nan_output": {...}`` — the output-integrity guard
   (resilience/integrity.py) corrupts the guarded result it is about
@@ -105,6 +114,7 @@ _PLAN = _load_plan()
 _PROBE_IDX = 0       # probe attempts consumed (per process)
 _CURRENT_METRIC = None  # set by bench's --one/--prewarm child entry
 _DISPATCH_CALLS: dict = {}  # kernel -> dispatches seen (slow_dispatch)
+_WEDGE_CALLS: dict = {}     # kernel -> dispatches seen (wedge_dispatch)
 
 
 def active() -> bool:
@@ -119,6 +129,7 @@ def reload_plan():
     _PROBE_IDX = 0
     _CURRENT_METRIC = None
     _DISPATCH_CALLS.clear()
+    _WEDGE_CALLS.clear()
     return _PLAN
 
 
@@ -241,9 +252,34 @@ def dispatch_fault(kernel: str):
     ``delay_s`` — a latency-TAIL fault, invisible to slope throughput
     (which amortizes it) and exactly what the SLO layer's p99
     verdicts must catch. Counting is per (process, kernel): requests
-    1..every-1 run clean, request ``every`` stalls."""
+    1..every-1 run clean, request ``every`` stalls.
+
+    A ``wedge_dispatch`` key instead WEDGES the first ``times``
+    matching dispatches (SIGALRM-immune, like ``wedge_metric``) —
+    the serve daemon's worker-watchdog chaos proof: the wedged
+    worker's request is re-queued once and its RETRY, past the
+    ``times`` budget, runs clean."""
     if _PLAN is None:
         return
+    wspec = _PLAN.get("wedge_dispatch")
+    if wspec:
+        if isinstance(wspec, str):
+            wspec = {"kernel": wspec}
+        want = wspec.get("kernel")
+        want_env = wspec.get("env")
+        if (want is None or want == kernel) and not (
+            want_env and any(
+                os.environ.get(k) != v for k, v in want_env.items()
+            )
+        ):
+            n = _WEDGE_CALLS[kernel] = _WEDGE_CALLS.get(kernel, 0) + 1
+            times = int(wspec.get("times", 1))
+            if times <= 0 or n <= times:
+                journal.emit(
+                    "fault_injected", site="dispatch", kernel=kernel,
+                    fault="wedge_dispatch", call=n,
+                )
+                _wedge(f"wedge_dispatch {kernel} (call {n})")
     spec = _PLAN.get("slow_dispatch")
     if not spec:
         return

@@ -1,0 +1,21 @@
+"""tpukernels.serve — the persistent multi-client kernel service
+(docs/SERVING.md; ROADMAP item 1).
+
+The batch suite's serving half: a Unix-domain-socket daemon
+(``server.py``) that dispatches every request through
+``registry.dispatch`` — the compiled-executable memo, fault point and
+integrity guard the batch paths already trust — plus the wire
+protocol (``protocol.py``), shape bucketing onto the AOT avatars
+(``bucketing.py``) and the jax-free client (``client.py``) that
+``capi.run_from_c`` and ``tools/loadgen.py --serve`` use.
+
+Stdlib + numpy at import time; jax loads inside the daemon's dispatch
+path only.
+"""
+
+from tpukernels.serve.client import (  # noqa: F401
+    ServeClient,
+    ServeError,
+    ServeRejected,
+    default_socket_path,
+)

@@ -1,0 +1,9 @@
+"""``python -m tpukernels.serve`` — run the kernel-serving daemon
+(tpukernels/serve/server.py; docs/SERVING.md)."""
+
+import sys
+
+from tpukernels.serve.server import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,293 @@
+"""Shape bucketing: pad requests up to registered AOT avatars
+(docs/SERVING.md §bucketing).
+
+The AOT layer (docs/PERF.md §compile discipline) makes the SECOND
+dispatch at a shape compile-free; a service whose clients send
+arbitrary shapes would compile forever. Bucketing folds the incoming
+shape space onto the registered ``aot.BENCH_CONFIGS`` avatars: a
+request whose operands fit under an avatar (every dim <=, same rank,
+same dtype, same statics) is zero-padded UP to it, dispatched through
+the avatar's warm executable, and its outputs are sliced/corrected
+back to the native shapes. Pad-up, never pad-down — truncating user
+data is not an optimization.
+
+Not every kernel tolerates padding, so the rule is per-kernel and
+EXPLICIT (``PAD_RULES``; the registry completeness lint pins a row
+per kernel):
+
+- ``"zero"``  — zero padding is algebraically invisible: saxpy/sgemm
+  (zero rows/cols contribute zero), scan (suffix zeros leave every
+  prefix untouched), nbody (a zero-mass body at the origin exerts and
+  feels no net force under the eps softening).
+- ``"hist0"`` — zero padding is visible exactly once: each pad
+  element lands in bin 0, so the correction subtracts the pad count
+  from ``counts[0]`` after dispatch (the scan half of scan_histogram
+  follows the scan rule).
+- ``None``    — padding changes the answer (the stencils: a padded
+  boundary is a different boundary condition). Exact avatar matches
+  still bucket (pad_frac 0); anything else dispatches at its native
+  shape.
+
+Padding is wasted compute, so it is capped (``TPK_SERVE_MAX_PAD_FRAC``,
+default 0.5: never burn more than half the dispatched elements on
+padding) and observable (the server records every bucketed request's
+waste into the ``serve.bucket_pad_frac`` histogram). Requests over
+the cap, over the avatar, or at alien statics dispatch natively —
+correct first, warm second.
+
+``TPK_SERVE_BUCKETS`` (inline JSON or a file path, the
+``TPK_FAULT_PLAN`` convention) overrides the avatar table — how the
+CPU tests prove the pad math without materializing the record shapes,
+and how an operator serves a custom shape population.
+
+Stdlib + numpy only; the avatar table comes from ``tpukernels.aot``
+(stdlib at import).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from tpukernels import aot
+
+DEFAULT_MAX_PAD_FRAC = 0.5
+
+# kernel -> padding rule (module docstring). Explicit None rows are
+# deliberate: tests/test_registry_contract.py requires every registry
+# kernel to state its rule, so a new kernel cannot silently become
+# unbucketable (or worse, wrongly bucketable).
+PAD_RULES = {
+    "vector_add": "zero",
+    "sgemm": "zero",
+    "stencil2d": None,
+    "stencil3d": None,
+    "scan": "zero",
+    "scan_exclusive": "zero",
+    "histogram": "hist0",
+    "scan_histogram": "hist0",
+    "nbody": "zero",
+}
+
+_DTYPE_NAMES = {"f32": "float32", "i32": "int32"}
+
+
+def max_pad_frac() -> float:
+    """``TPK_SERVE_MAX_PAD_FRAC`` (default 0.5), fail-loud parse in
+    [0, 1] — the TPK_* knob contract."""
+    raw = os.environ.get("TPK_SERVE_MAX_PAD_FRAC")
+    if raw is None:
+        return DEFAULT_MAX_PAD_FRAC
+    try:
+        val = float(raw)
+    except ValueError:
+        val = -1.0
+    if not 0.0 <= val <= 1.0:
+        raise ValueError(
+            f"TPK_SERVE_MAX_PAD_FRAC={raw!r}: expected a float in [0, 1]"
+        )
+    return val
+
+
+# parse-once cache keyed on the raw knob value: admission runs
+# bucket_for per incoming request in the reader thread, and a
+# file-path knob must not cost a disk open + JSON parse per request.
+# (A changed FILE behind an unchanged path is not re-read — tests and
+# operators flip the env value, which busts the cache.)
+_CONFIG_CACHE: dict = {"raw": None, "table": None}
+
+
+def bucket_configs() -> dict:
+    """The avatar table bucketing folds onto: ``TPK_SERVE_BUCKETS``
+    (inline JSON object or a JSON file path — the fault-plan loading
+    convention) when set, else the registered ``aot.BENCH_CONFIGS``.
+    Spec shape mirrors BENCH_CONFIGS: ``{kernel: {"args": [(kind,
+    shape), ...], "statics": {...}}}``."""
+    raw = os.environ.get("TPK_SERVE_BUCKETS")
+    if not raw or not raw.strip():
+        return aot.BENCH_CONFIGS
+    if _CONFIG_CACHE["raw"] == raw:
+        return _CONFIG_CACHE["table"]
+    if raw.lstrip()[:1] == "{":
+        table = json.loads(raw)
+    else:
+        with open(raw) as f:
+            table = json.load(f)
+    if not isinstance(table, dict):
+        raise ValueError(
+            "TPK_SERVE_BUCKETS must be a JSON object "
+            f"({type(table).__name__} given)"
+        )
+    _CONFIG_CACHE["table"] = table
+    _CONFIG_CACHE["raw"] = raw
+    return table
+
+
+def _spec_args(spec):
+    """[(dtype_name, shape_tuple), ...] for one avatar spec (tolerates
+    JSON lists where BENCH_CONFIGS has tuples)."""
+    out = []
+    for kind, shape in spec["args"]:
+        out.append((_DTYPE_NAMES.get(kind, kind),
+                    tuple(int(d) for d in shape)))
+    return out
+
+
+def bucket_for(kernel: str, arrays, statics: dict):
+    """Match one request against the kernel's avatar.
+
+    ``arrays`` are the request's numpy operands (0-d = host scalar).
+    Returns ``(spec, pad_frac)`` when the request buckets — ``spec``
+    is the avatar entry, ``pad_frac`` the wasted-element fraction
+    (0.0 for an exact fit) — or ``(None, reason)`` when it must
+    dispatch natively. Pad-up only: any dim over the avatar's is a
+    non-match, never a truncation."""
+    try:
+        spec = bucket_configs().get(kernel)
+    except (OSError, ValueError) as e:
+        raise ValueError(f"TPK_SERVE_BUCKETS: {e}") from None
+    if spec is None:
+        return None, "no-avatar"
+    want = _spec_args(spec)
+    if len(want) != len(arrays):
+        return None, "arg-count-mismatch"
+    if dict(spec.get("statics") or {}) != dict(statics or {}):
+        return None, "statics-mismatch"
+    orig = padded = 0
+    exact = True
+    for a, (dtype, shape) in zip(arrays, want):
+        a = np.asarray(a)
+        if a.dtype.name != dtype or a.ndim != len(shape):
+            return None, "layout-mismatch"
+        if any(d > w for d, w in zip(a.shape, shape)):
+            return None, "over-avatar"
+        if tuple(a.shape) != shape:
+            exact = False
+        orig += int(a.size)
+        padded += int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if exact:
+        return spec, 0.0
+    if PAD_RULES.get(kernel) is None:
+        return None, "no-pad-rule"
+    if not _consistent(kernel, arrays):
+        # cross-operand shape disagreements (sgemm inner dims,
+        # mismatched vector lengths) that registry.dispatch would
+        # REJECT must never be padded into a plausible-but-wrong
+        # answer — dispatch natively and let the kernel error honestly
+        return None, "inconsistent-args"
+    pad_frac = 1.0 - (orig / padded if padded else 1.0)
+    if pad_frac > max_pad_frac():
+        return None, "pad-over-cap"
+    return spec, pad_frac
+
+
+def _consistent(kernel: str, arrays) -> bool:
+    """Cross-operand shape agreement for multi-operand kernels — the
+    constraints ``registry.dispatch`` itself would enforce. Only
+    consulted for non-exact (padding) matches: an exact avatar fit is
+    consistent by construction."""
+    shapes = [tuple(np.asarray(a).shape) for a in arrays]
+    if kernel == "vector_add":
+        return shapes[1] == shapes[2]
+    if kernel == "sgemm":
+        (m, k), (k2, n), (m2, n2) = shapes[1], shapes[2], shapes[4]
+        return k == k2 and m == m2 and n == n2
+    if kernel == "nbody":
+        return len(set(shapes)) == 1
+    return True  # single-data-operand kernels
+
+
+def pad_args(kernel: str, spec, arrays):
+    """Zero-pad the request's operands up to the avatar shapes.
+    Returns ``(padded_arrays, meta)`` — ``meta`` carries what
+    :func:`unpad_outputs` needs (native shapes + the data-arg pad
+    count for the hist0 correction)."""
+    want = _spec_args(spec)
+    padded, orig_shapes = [], []
+    for a, (dtype, shape) in zip(arrays, want):
+        a = np.asarray(a)
+        orig_shapes.append(tuple(a.shape))
+        if tuple(a.shape) == shape:
+            padded.append(a)
+            continue
+        buf = np.zeros(shape, dtype=a.dtype)
+        buf[tuple(slice(0, d) for d in a.shape)] = a
+        padded.append(buf)
+    data_pad = 0
+    for a, (dtype, shape) in zip(arrays, want):
+        if shape:  # first non-scalar arg is the data array by contract
+            data_pad = int(np.prod(shape, dtype=np.int64)) - int(
+                np.asarray(a).size
+            )
+            break
+    return padded, {"orig_shapes": orig_shapes, "data_pad": data_pad,
+                    "rule": PAD_RULES.get(kernel)}
+
+
+def unpad_outputs(kernel: str, meta, outputs):
+    """Slice/correct the avatar-shaped outputs back to the request's
+    native shapes. ``outputs`` is the flat tuple of numpy result
+    leaves; returns the corrected tuple. The inverse map is
+    per-kernel because output shapes are functions of INPUT shapes:
+
+    - vector_add / scan / scan_exclusive — one output shaped like the
+      data arg: slice to it.
+    - sgemm — output shaped like C (arg 4): slice to it.
+    - nbody — six outputs shaped like the body arrays: slice each.
+    - histogram — counts are avatar-shaped already (nbins is a
+      static); subtract the pad count from bin 0 (every pad element
+      is a zero).
+    - scan_histogram — scan half sliced, counts half bin-0-corrected.
+    """
+    shapes = meta["orig_shapes"]
+    pad = meta["data_pad"]
+
+    def _cut(a, shape):
+        a = np.asarray(a)
+        if tuple(a.shape) == tuple(shape):
+            return a
+        return np.ascontiguousarray(
+            a[tuple(slice(0, d) for d in shape)]
+        )
+
+    def _fix_counts(c):
+        c = np.array(c, copy=True)
+        c[0] -= np.asarray(pad, dtype=c.dtype)
+        return c
+
+    if kernel == "vector_add":
+        return (_cut(outputs[0], shapes[1]),)
+    if kernel == "sgemm":
+        return (_cut(outputs[0], shapes[4]),)
+    if kernel in ("scan", "scan_exclusive"):
+        return (_cut(outputs[0], shapes[0]),)
+    if kernel == "histogram":
+        return (_fix_counts(outputs[0]),)
+    if kernel == "scan_histogram":
+        return (_cut(outputs[0], shapes[0]), _fix_counts(outputs[1]))
+    if kernel == "nbody":
+        return tuple(_cut(o, s) for o, s in zip(outputs, shapes))
+    # exact-fit buckets of rule-less kernels never pad, so outputs
+    # are already native-shaped
+    return tuple(np.asarray(o) for o in outputs)
+
+
+def bucket_id(kernel: str, spec, statics: dict, arrays=None) -> str:
+    """Stable batching/locking key for one (kernel, compiled-program)
+    bucket. Bucketed requests share the avatar's key; native
+    dispatches key on their own shapes (same-shape natives still
+    coalesce and still compile once)."""
+    if spec is not None:
+        shapes = "+".join(
+            "x".join(str(d) for d in shape) or "-"
+            for _dt, shape in _spec_args(spec)
+        )
+    else:
+        shapes = "+".join(
+            "x".join(str(d) for d in np.asarray(a).shape) or "-"
+            for a in (arrays or ())
+        )
+    stat = ",".join(f"{k}={v}" for k, v in sorted((statics or {}).items()))
+    return f"{kernel}|{shapes}|{stat or '-'}"

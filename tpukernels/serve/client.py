@@ -1,0 +1,170 @@
+"""Client side of the kernel-serving daemon (docs/SERVING.md).
+
+``ServeClient.dispatch(kernel, *arrays, **statics)`` mirrors
+``registry.dispatch``'s signature over the wire: numpy operands in,
+numpy results out (a single array, or a tuple when the kernel returns
+several). That symmetry is the point — ``capi.run_from_c`` and
+``tools/loadgen.py --serve`` swap the in-process serving path for the
+daemon by swapping one callable, and the daemon itself dispatches
+through the real ``registry.dispatch`` on the other end.
+
+Deliberately jax-free: a client host (the C driver's embedded
+interpreter, a loadgen probe box) needs numpy and a socket, nothing
+else — backend init, compilation and the executable memo all live in
+the daemon.
+
+Failure surface: :class:`ServeError` for daemon-reported dispatch
+errors, :class:`ServeRejected` (carrying ``retry_after_s``) for
+admission-control rejections — backpressure is a first-class answer
+the caller must see, not an exception to swallow — and plain
+``OSError``/``ProtocolError`` for transport trouble (the caller
+decides whether an in-process fallback exists; ``capi`` retains one).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+
+import numpy as np
+
+from tpukernels import _cachedir
+from tpukernels.serve import protocol
+
+
+class ServeError(Exception):
+    """The daemon answered, and the answer is a dispatch failure."""
+
+
+class ServeRejected(ServeError):
+    """Admission control turned the request away; ``retry_after_s``
+    is the daemon's load-derived retry hint."""
+
+    def __init__(self, msg, retry_after_s=0.1):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+def default_socket_path() -> str:
+    """``TPK_SERVE_SOCKET`` when set (also the capi routing switch),
+    else the serve dir's ``serve.sock`` (``tpukernels/_cachedir.py``)."""
+    return _cachedir.serve_socket_path()
+
+
+def dispatch_with_backpressure(cli, kernel, args, statics,
+                               max_rejections: int = 10):
+    """``cli.dispatch`` honoring admission control: a
+    :class:`ServeRejected` is retried after the daemon's
+    ``retry_after_s`` hint, up to ``max_rejections`` times, then
+    re-raised — the one backpressure policy both standing clients
+    (``capi._dispatch``, ``loadgen.run_serve``) share; only the
+    give-up action differs, so it stays with the caller. Transport
+    errors and daemon-reported :class:`ServeError` propagate
+    untouched."""
+    tries = 0
+    while True:
+        try:
+            return cli.dispatch(kernel, *args, **statics)
+        except ServeRejected as e:
+            tries += 1
+            if tries >= max_rejections:
+                raise
+            time.sleep(e.retry_after_s)
+
+
+class ServeClient:
+    """One connection, one outstanding request at a time (the
+    protocol's pipelining contract). Connects lazily and reconnects
+    after transport errors; not thread-safe — give each client thread
+    its own instance."""
+
+    def __init__(self, socket_path=None, timeout_s=None):
+        self.socket_path = socket_path or default_socket_path()
+        self.timeout_s = timeout_s
+        self._sock = None
+        self._rid = 0
+
+    # ---------------------------------------------------------- #
+    # transport                                                  #
+    # ---------------------------------------------------------- #
+
+    def _connected(self):
+        if self._sock is None:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            if self.timeout_s is not None:
+                s.settimeout(self.timeout_s)
+            try:
+                s.connect(self.socket_path)
+            except OSError:
+                s.close()
+                raise
+            self._sock = s
+        return self._sock
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _roundtrip(self, header, payloads=()):
+        sock = self._connected()
+        try:
+            protocol.send_frame(sock, header, payloads)
+            frame = protocol.recv_frame(sock)
+        except (OSError, protocol.ProtocolError):
+            self.close()  # poisoned stream: next call reconnects
+            raise
+        if frame is None:
+            self.close()
+            raise protocol.ProtocolError(
+                "daemon hung up before answering"
+            )
+        return frame
+
+    # ---------------------------------------------------------- #
+    # operations                                                 #
+    # ---------------------------------------------------------- #
+
+    def ping(self) -> dict:
+        """Liveness + stats (pid, served/rejected/requeued counts,
+        queue depth, device_kind, jax version)."""
+        header, _payloads = self._roundtrip(
+            {"v": protocol.VERSION, "op": "ping"}
+        )
+        return header
+
+    def dispatch(self, kernel: str, *args, **statics):
+        """One kernel request: numpy operands (host scalars as 0-d
+        arrays — pass ``np.float32(x)``/``np.int32(n)``), numpy
+        result(s) back, already sliced to the request's native shapes
+        when the daemon bucketed it."""
+        arrays = [np.asarray(a) for a in args]
+        specs, payloads = protocol.pack_arrays(arrays)
+        self._rid += 1
+        header, out_payloads = self._roundtrip(
+            {"v": protocol.VERSION, "op": "dispatch", "id": self._rid,
+             "kernel": kernel, "statics": statics, "args": specs},
+            payloads,
+        )
+        if not header.get("ok"):
+            msg = header.get("error") or "daemon error"
+            if header.get("kind") == "overloaded":
+                raise ServeRejected(
+                    msg, float(header.get("retry_after_s") or 0.1)
+                )
+            raise ServeError(msg)
+        outs = protocol.unpack_arrays(
+            header.get("outputs") or [], out_payloads
+        )
+        return outs[0] if len(outs) == 1 else tuple(outs)

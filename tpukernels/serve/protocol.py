@@ -1,0 +1,186 @@
+"""Length-prefixed JSON + raw-buffer wire protocol (docs/SERVING.md).
+
+One frame = a fixed 16-byte preamble (``TPK1`` magic, big-endian
+header length, big-endian total payload length), a UTF-8 JSON header,
+then the concatenated raw little-endian C-order array buffers the
+header describes. JSON carries everything small and structural
+(kernel name, statics, shapes, dtypes, verdict fields); the buffers
+carry the operand/output bytes verbatim — a 16 MiB sgemm operand must
+never ride through a JSON string.
+
+The same framing serves both directions. Requests:
+
+    {"v": 1, "op": "dispatch", "id": 7, "kernel": "scan",
+     "statics": {}, "args": [{"shape": [4093], "dtype": "int32"}]}
+    + one payload buffer per ``args`` entry
+
+    {"v": 1, "op": "ping"}        # liveness / stats, no payload
+
+Responses:
+
+    {"v": 1, "id": 7, "ok": true,
+     "outputs": [{"shape": [4093], "dtype": "int32"}], ...}
+    + one payload buffer per ``outputs`` entry
+
+    {"v": 1, "id": 7, "ok": false, "error": "...",
+     "kind": "overloaded", "retry_after_s": 0.25}
+
+The module is transport-math only — no sockets are created here, no
+jax is imported, and the dtype table is exactly the C ABI's
+(``capi._DTYPES``): the serve daemon is one more consumer of the same
+two-dtype contract, not a new one.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+VERSION = 1
+MAGIC = b"TPK1"
+_PREAMBLE = struct.Struct(">4sIQ")
+
+# sanity bounds, not resource limits: a header over 1 MiB or a frame
+# over 4 GiB is a desynced/hostile stream, not a big request
+MAX_HEADER = 1 << 20
+MAX_PAYLOAD = 1 << 32
+
+# the C ABI's dtype surface (capi._DTYPES), by canonical numpy name
+DTYPES = {
+    "float32": np.float32,
+    "int32": np.int32,
+}
+
+
+class ProtocolError(Exception):
+    """The stream is not speaking this protocol (bad magic, absurd
+    lengths, truncated frame, unknown dtype). Callers must treat the
+    connection as poisoned — there is no resync."""
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    """Read exactly ``n`` bytes or raise — a short read mid-frame is a
+    peer that died, and half a frame is worse than none."""
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ProtocolError(
+                f"connection closed mid-frame ({n} byte(s) short)"
+            )
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def send_frame(sock, header: dict, payloads=()) -> None:
+    """Serialize one frame onto ``sock``. ``payloads`` is a sequence
+    of bytes-like buffers; their lengths are recorded in the wire
+    header (``_lens``) so :func:`recv_frame` can split the blob
+    without trusting the semantic fields."""
+    payloads = [bytes(p) for p in payloads]
+    wire = dict(header)
+    wire["_lens"] = [len(p) for p in payloads]
+    hb = json.dumps(wire, separators=(",", ":")).encode()
+    total = sum(len(p) for p in payloads)
+    if len(hb) > MAX_HEADER or total > MAX_PAYLOAD:
+        raise ProtocolError(
+            f"frame too large (header {len(hb)}B, payload {total}B)"
+        )
+    head = _PREAMBLE.pack(MAGIC, len(hb), total) + hb
+    if total <= (1 << 16):
+        # small frames: one syscall beats avoiding a tiny copy
+        sock.sendall(head + b"".join(payloads))
+        return
+    # multi-MB operand/output frames: send buffers as-is instead of
+    # materializing an extra full-frame copy on the hot path
+    sock.sendall(head)
+    for p in payloads:
+        sock.sendall(p)
+
+
+def recv_frame(sock):
+    """Read one frame; returns ``(header, [payload_bytes, ...])`` or
+    ``None`` on a clean EOF at a frame boundary (the peer hung up
+    between requests — not an error)."""
+    first = sock.recv(1)
+    if not first:
+        return None
+    raw = first + _recv_exact(sock, _PREAMBLE.size - 1)
+    magic, hlen, total = _PREAMBLE.unpack(raw)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic!r}")
+    if hlen > MAX_HEADER or total > MAX_PAYLOAD:
+        raise ProtocolError(
+            f"absurd frame lengths (header {hlen}B, payload {total}B)"
+        )
+    try:
+        header = json.loads(_recv_exact(sock, hlen))
+    except ValueError as e:
+        raise ProtocolError(f"unparseable frame header: {e}") from None
+    if not isinstance(header, dict):
+        raise ProtocolError("frame header is not a JSON object")
+    lens = header.pop("_lens", [])
+    if not isinstance(lens, list) or any(
+        not isinstance(n, int) or isinstance(n, bool) or n < 0
+        for n in lens
+    ):
+        raise ProtocolError(f"malformed _lens {lens!r}")
+    if sum(lens) != total:
+        raise ProtocolError(
+            f"payload lengths {lens} disagree with frame total {total}"
+        )
+    blob = _recv_exact(sock, total)
+    payloads, off = [], 0
+    for n in lens:
+        payloads.append(blob[off:off + n])
+        off += n
+    return header, payloads
+
+
+# ------------------------------------------------------------------ #
+# array <-> (spec, bytes)                                            #
+# ------------------------------------------------------------------ #
+
+def pack_arrays(arrays):
+    """``([{"shape", "dtype"}, ...], [bytes, ...])`` for a sequence of
+    numpy arrays (0-d arrays carry host scalars — the dispatch memo's
+    canonicalization contract)."""
+    specs, payloads = [], []
+    for a in arrays:
+        a = np.asarray(a)
+        name = a.dtype.name
+        if name not in DTYPES:
+            raise ProtocolError(
+                f"unsupported dtype {name!r}; the wire carries "
+                f"{sorted(DTYPES)}"
+            )
+        specs.append({"shape": list(a.shape), "dtype": name})
+        payloads.append(np.ascontiguousarray(a).tobytes())
+    return specs, payloads
+
+
+def unpack_arrays(specs, payloads):
+    """Rebuild numpy arrays from specs + raw buffers; validates byte
+    counts so a desynced stream fails loudly, never reshapes
+    garbage."""
+    if len(specs) != len(payloads):
+        raise ProtocolError(
+            f"{len(specs)} array spec(s) but {len(payloads)} payload(s)"
+        )
+    out = []
+    for spec, raw in zip(specs, payloads):
+        name = spec.get("dtype")
+        if name not in DTYPES:
+            raise ProtocolError(f"unsupported dtype {name!r} in spec")
+        dt = np.dtype(DTYPES[name])
+        shape = tuple(int(d) for d in spec.get("shape", ()))
+        want = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+        if len(raw) != want:
+            raise ProtocolError(
+                f"payload is {len(raw)}B but {shape} {name} needs {want}B"
+            )
+        out.append(np.frombuffer(raw, dtype=dt).reshape(shape))
+    return out

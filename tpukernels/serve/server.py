@@ -1,0 +1,896 @@
+"""The kernel-serving daemon (docs/SERVING.md).
+
+Every entry point before this module — bench.py, the C shim, the
+autotune sweep, the load generator — was a batch process that paid
+backend init and first-compile per invocation. This is the long-lived
+process in the middle: a Unix-domain-socket server that accepts
+dispatch requests (kernel, shapes, dtypes, statics, raw operand
+bytes — ``tpukernels/serve/protocol.py``) from any number of
+concurrent clients and runs every one through ``registry.dispatch``,
+i.e. through the process-wide compiled-executable memo, the
+fault-injection point and the output-integrity guard the batch paths
+already trust. After the first request per (kernel, bucket), serving
+is compile-free.
+
+The service disciplines, each CPU-chaos-proven (tests/test_serve.py):
+
+- **Shape bucketing** (``bucketing.py``) — operands are zero-padded
+  up to the nearest registered AOT avatar (never down, waste-capped,
+  per-kernel correctness rules) so a diverse client shape population
+  collapses onto a handful of warm executables;
+  ``serve.bucket_pad_frac`` makes the padding waste observable.
+- **Batching** — same-bucket requests arriving within
+  ``TPK_SERVE_BATCH_WINDOW_MS`` are coalesced to one worker and
+  served back-to-back on one warm executable (``serve.batch_size``).
+- **Admission control** — the request queue is bounded
+  (``TPK_SERVE_QUEUE_MAX``); at depth, new requests are REJECTED
+  immediately with a ``retry_after_s`` hint (``serve_rejected``)
+  instead of queueing into unbounded latency — the client sees the
+  overload, the p99 of admitted requests stays honest.
+- **Worker watchdog** — an in-flight request stuck past
+  ``TPK_SERVE_REQUEST_TIMEOUT_S`` gets the bench treatment: its
+  worker thread is abandoned (a wedged PJRT call cannot be cancelled
+  — the thread is marked, replaced, and its eventual result
+  discarded), the timeout is classified slow-vs-wedged through
+  ``watchdog.classify_timeout``, and the request is re-queued ONCE
+  (``serve_request_requeued``) before failing loudly to the client.
+
+Observability rides the existing stack: a ``serve/<kernel>`` span per
+request, ``serve.*`` counters/histograms, and the
+``serve_start``/``serve_request``/``serve_rejected``/
+``serve_request_requeued``/``serve_stop`` journal kinds
+(docs/OBSERVABILITY.md). The daemon prints NOTHING to stdout on the
+clean path (notes go to stderr, evidence to the journal) — the
+byte-identical proof the fault/trace/AOT layers established, applied
+to a server.
+
+Run it: ``python -m tpukernels.serve [--socket PATH ...]`` (or
+``tools/serve_ctl.py start``). SIGTERM/SIGINT shut it down cleanly:
+the listener closes, the socket and flocked pidfile are removed, and
+``serve_stop`` records the session totals.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue as _queue_mod
+import socket
+import struct
+import sys
+import threading
+import time
+
+from tpukernels import _cachedir
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.obs import trace
+from tpukernels.resilience import journal, watchdog
+from tpukernels.serve import bucketing, protocol
+
+DEFAULT_QUEUE_MAX = 64
+DEFAULT_WORKERS = 2
+DEFAULT_BATCH_WINDOW_MS = 2.0
+DEFAULT_REQUEST_TIMEOUT_S = 60.0
+
+# kernel-level SO_SNDTIMEO on accepted sockets: a client that stops
+# READING (SIGSTOP'd, hung) would otherwise block a worker forever in
+# sendall once the response outgrows the socket buffer — invisibly to
+# the watchdog, which tracks dispatch, not delivery. Send-only, so an
+# idle client's connection (blocked in recv on our side) lives forever.
+SEND_TIMEOUT_S = 30.0
+
+
+def _int_knob(name: str, default: int, floor: int = 1) -> int:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        val = floor - 1
+    if val < floor:
+        raise ValueError(
+            f"{name}={raw!r}: expected an int >= {floor}"
+        )
+    return val
+
+
+def _float_knob(name: str, default: float, floor: float = 0.0) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        val = floor - 1.0
+    if val < floor:
+        raise ValueError(
+            f"{name}={raw!r}: expected a number >= {floor}"
+        )
+    return val
+
+
+class _Request:
+    """One in-flight dispatch request and its lifecycle state."""
+
+    __slots__ = ("serial", "rid", "kernel", "statics", "arrays",
+                 "spec", "pad_frac", "bucket", "conn", "t_enq",
+                 "t_start", "requeues", "patience", "done", "lock",
+                 "worker_ident")
+
+    def __init__(self, serial, rid, kernel, statics, arrays, spec,
+                 pad_frac, bucket, conn):
+        self.serial = serial  # server-side key: client ids can collide
+        self.rid = rid
+        self.kernel = kernel
+        self.statics = statics
+        self.arrays = arrays
+        self.spec = spec
+        self.pad_frac = pad_frac
+        self.bucket = bucket
+        self.conn = conn
+        self.t_enq = time.perf_counter()
+        self.t_start = None
+        self.requeues = 0
+        self.patience = 0          # grace extensions granted (max 1)
+        self.done = False          # guarded by self.lock
+        self.lock = threading.Lock()
+        self.worker_ident = None
+
+    def claim_done(self) -> bool:
+        """Atomically claim the right to respond — the one guard that
+        makes a watchdog-requeued request and its abandoned original
+        worker unable to both answer the client."""
+        with self.lock:
+            if self.done:
+                return False
+            self.done = True
+            return True
+
+
+class _Conn:
+    """A client connection plus its send lock: worker threads answer
+    requests while the reader thread may be rejecting the client's
+    next one — frames must never interleave on the wire."""
+
+    __slots__ = ("sock", "send_lock")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+
+    def send(self, header, payloads=()):
+        with self.send_lock:
+            protocol.send_frame(self.sock, header, payloads)
+
+
+class _BoundedQueue:
+    """Bounded FIFO with same-bucket extraction — the admission-control
+    and coalescing surface. ``queue.Full`` at depth is the
+    backpressure contract; ``take_matching`` pulls every queued
+    request of one bucket WITHOUT disturbing the order of the rest."""
+
+    def __init__(self, maxlen: int):
+        self._d = collections.deque()
+        self._cv = threading.Condition()
+        self._max = maxlen
+
+    def put_nowait(self, item, force: bool = False):
+        with self._cv:
+            if not force and len(self._d) >= self._max:
+                raise _queue_mod.Full
+            self._d.append(item)
+            self._cv.notify()
+
+    def get(self, timeout: float):
+        with self._cv:
+            if not self._d:
+                self._cv.wait(timeout)
+            if not self._d:
+                return None
+            return self._d.popleft()
+
+    def take_matching(self, bucket: str, limit: int):
+        with self._cv:
+            taken, keep = [], collections.deque()
+            for item in self._d:
+                if item.bucket == bucket and len(taken) < limit:
+                    taken.append(item)
+                else:
+                    keep.append(item)
+            self._d = keep
+            return taken
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._d)
+
+
+class Server:
+    def __init__(self, socket_path=None, queue_max=None, workers=None,
+                 batch_window_ms=None, request_timeout_s=None):
+        self.socket_path = socket_path or _cachedir.serve_socket_path()
+        self.queue_max = (queue_max if queue_max is not None
+                          else _int_knob("TPK_SERVE_QUEUE_MAX",
+                                         DEFAULT_QUEUE_MAX))
+        self.workers = (workers if workers is not None
+                        else _int_knob("TPK_SERVE_WORKERS",
+                                       DEFAULT_WORKERS))
+        self.batch_window_s = (
+            batch_window_ms if batch_window_ms is not None
+            else _float_knob("TPK_SERVE_BATCH_WINDOW_MS",
+                             DEFAULT_BATCH_WINDOW_MS)
+        ) / 1000.0
+        self.request_timeout_s = (
+            request_timeout_s if request_timeout_s is not None
+            else _float_knob("TPK_SERVE_REQUEST_TIMEOUT_S",
+                             DEFAULT_REQUEST_TIMEOUT_S, floor=0.1)
+        )
+        self._q = _BoundedQueue(self.queue_max)
+        self._stop = threading.Event()
+        self._listener = None
+        self._lock = threading.Lock()       # shared mutable maps below
+        self._inflight: dict = {}           # serial -> _Request (started)
+        self._bucket_locks: dict = {}       # bucket -> [lock, holder]
+        self._abandoned: set = set()        # wedged worker idents
+        self._worker_pending: dict = {}     # ident -> deque of batch rest
+        self._next_rid = 0
+        self._served = 0
+        self._rejected = 0
+        self._requeued = 0
+        self._t0 = time.time()
+        self._service_ewma = 0.05           # retry-after hint basis
+        self._device_kind = None            # resolved by 1st dispatch
+        # fail-fast: a misconfigured TPK_SERVE_BUCKETS (typo'd path,
+        # malformed JSON) must refuse to start the daemon, not surface
+        # as a per-request "bad request" to every client — which capi
+        # treats as authoritative and never falls back from
+        bucketing.bucket_configs()
+
+    # -------------------------------------------------------------- #
+    # lifecycle                                                      #
+    # -------------------------------------------------------------- #
+
+    def serve_forever(self):
+        d = os.path.dirname(self.socket_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if os.path.exists(self.socket_path):
+            # a dead daemon's stale socket; a LIVE one holds the
+            # flocked pidfile and serve_ctl refuses to double-start
+            os.unlink(self.socket_path)
+        self._listener = socket.socket(socket.AF_UNIX,
+                                       socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(64)
+        self._listener.settimeout(0.5)
+        journal.emit(
+            "serve_start", socket=self.socket_path,
+            queue_max=self.queue_max, workers=self.workers,
+            batch_window_ms=round(self.batch_window_s * 1e3, 3),
+            request_timeout_s=self.request_timeout_s,
+        )
+        for _ in range(self.workers):
+            self._spawn_worker()
+        threading.Thread(target=self._watchdog_loop, daemon=True,
+                         name="serve-watchdog").start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _addr = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                    struct.pack("ll", int(SEND_TIMEOUT_S), 0),
+                )
+                threading.Thread(
+                    target=self._client_loop, args=(_Conn(conn),),
+                    daemon=True, name="serve-client",
+                ).start()
+        finally:
+            self.shutdown()
+
+    def stop(self, *_sig):
+        """Signal-handler-safe stop request."""
+        self._stop.set()
+
+    def shutdown(self):
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+            journal.emit(
+                "serve_stop", served=self._served,
+                rejected=self._rejected, requeued=self._requeued,
+                uptime_s=round(time.time() - self._t0, 3),
+            )
+
+    def _spawn_worker(self):
+        threading.Thread(target=self._worker_loop, daemon=True,
+                         name="serve-worker").start()
+
+    # -------------------------------------------------------------- #
+    # client side: read, admit or reject                             #
+    # -------------------------------------------------------------- #
+
+    def _client_loop(self, conn: _Conn):
+        try:
+            while not self._stop.is_set():
+                frame = protocol.recv_frame(conn.sock)
+                if frame is None:
+                    return
+                header, payloads = frame
+                op = header.get("op")
+                if op == "ping":
+                    conn.send(dict(self._stats(), v=protocol.VERSION,
+                                   ok=True))
+                elif op == "dispatch":
+                    self._admit(conn, header, payloads)
+                else:
+                    conn.send({"v": protocol.VERSION,
+                               "id": header.get("id"), "ok": False,
+                               "kind": "error",
+                               "error": f"unknown op {op!r}"})
+        except (protocol.ProtocolError, OSError):
+            pass  # poisoned/hung-up connection: drop it, serve on
+        finally:
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def _stats(self) -> dict:
+        return {
+            "op": "pong", "pid": os.getpid(),
+            "served": self._served, "rejected": self._rejected,
+            "requeued": self._requeued, "depth": self._q.depth(),
+            "queue_max": self.queue_max, "workers": self.workers,
+            "uptime_s": round(time.time() - self._t0, 3),
+            # report-only, like jax below: a liveness ping must never
+            # force backend init in the reader thread (None until the
+            # first dispatch resolves it)
+            "device_kind": self._device_kind,
+            "jax": self._jax_version(),
+        }
+
+    @staticmethod
+    def _jax_version():
+        # report-only: never force the import before the first dispatch
+        mod = sys.modules.get("jax")
+        return getattr(mod, "__version__", None)
+
+    def _admit(self, conn: _Conn, header: dict, payloads):
+        rid = header.get("id")
+        try:
+            kernel = header["kernel"]
+            statics = dict(header.get("statics") or {})
+            arrays = protocol.unpack_arrays(
+                header.get("args") or [], payloads
+            )
+            spec, how = bucketing.bucket_for(kernel, arrays, statics)
+            pad_frac = how if spec is not None else 0.0
+            bucket = bucketing.bucket_id(kernel, spec, statics, arrays)
+        except (KeyError, ValueError, TypeError, AttributeError,
+                protocol.ProtocolError) as e:
+            # TypeError/AttributeError cover structurally malformed
+            # headers (scalar shapes, non-dict args/statics) that the
+            # field accessors raise before any explicit validation —
+            # they must become an error REPLY, not an unhandled
+            # exception that kills this client's handler thread
+            conn.send({"v": protocol.VERSION, "id": rid, "ok": False,
+                       "kind": "error", "error": f"bad request: {e}"})
+            return
+        with self._lock:
+            self._next_rid += 1
+            serial = self._next_rid
+        req = _Request(serial, rid if rid is not None else serial,
+                       kernel, statics, arrays, spec, pad_frac,
+                       bucket, conn)
+        try:
+            self._q.put_nowait(req)
+        except _queue_mod.Full:
+            self._reject(req)
+
+    def _reject(self, req: _Request):
+        with self._lock:
+            self._rejected += 1
+        obs_metrics.inc("serve.rejected")
+        depth = self._q.depth()
+        retry = round(max(0.05, (depth + 1) * self._service_ewma), 3)
+        journal.emit(
+            "serve_rejected", kernel=req.kernel, request=req.rid,
+            depth=depth, queue_max=self.queue_max, retry_after_s=retry,
+        )
+        try:
+            req.conn.send({
+                "v": protocol.VERSION, "id": req.rid, "ok": False,
+                "kind": "overloaded", "retry_after_s": retry,
+                "error": (f"queue at depth {depth} >= "
+                          f"{self.queue_max}; retry after {retry}s"),
+            })
+        except OSError:
+            pass
+
+    # -------------------------------------------------------------- #
+    # worker side: coalesce, dispatch, respond                       #
+    # -------------------------------------------------------------- #
+
+    def _worker_loop(self):
+        while not self._stop.is_set():
+            if self._retire_if_abandoned():
+                return
+            first = self._q.get(timeout=0.5)
+            if first is None:
+                continue
+            batch = [first]
+            if self.batch_window_s > 0:
+                deadline = time.perf_counter() + self.batch_window_s
+                while True:
+                    batch.extend(self._q.take_matching(
+                        first.bucket, self.queue_max - len(batch)
+                    ))
+                    rem = deadline - time.perf_counter()
+                    if rem <= 0:
+                        break
+                    time.sleep(min(rem, 0.001))
+            else:
+                batch.extend(self._q.take_matching(
+                    first.bucket, self.queue_max - len(batch)
+                ))
+            obs_metrics.observe("serve.batch_size", len(batch))
+            # the unstarted remainder is SHARED with the watchdog
+            # (self._worker_pending): members coalesced behind a
+            # permanently wedged request live only on this thread's
+            # stack, so the watchdog must be able to rescue them —
+            # a hand-back that waits for the wedged _execute to
+            # return would wait forever
+            ident = threading.get_ident()
+            pending = collections.deque(batch)
+            with self._lock:
+                self._worker_pending[ident] = pending
+            size = len(batch)
+            while True:
+                with self._lock:
+                    if not pending:
+                        self._worker_pending.pop(ident, None)
+                        break
+                    req = pending.popleft()
+                try:
+                    self._execute(req, size)
+                except Exception as e:  # noqa: BLE001 — pool must survive
+                    # _execute answers dispatch failures itself; a bug
+                    # that still escapes (a response-path surprise)
+                    # must not kill the worker thread and strand the
+                    # rest of the batch
+                    print(f"# serve: worker error on {req.kernel}: "
+                          f"{e!r}", file=sys.stderr)
+                    if req.claim_done():
+                        try:
+                            req.conn.send({
+                                "v": protocol.VERSION, "id": req.rid,
+                                "ok": False, "kind": "error",
+                                "error": f"internal worker error: {e!r}",
+                            })
+                        except (OSError, protocol.ProtocolError):
+                            pass
+                if self._retire_if_abandoned():
+                    # the watchdog abandoned this worker and already
+                    # requeued whatever was left in `pending`
+                    return
+
+    def _retire_if_abandoned(self) -> bool:
+        """True when the watchdog abandoned THIS worker — and forget
+        its ident on the way out: thread idents are recycled after
+        exit, and a stale entry would make a future worker be born
+        'abandoned' and silently shrink the pool."""
+        ident = threading.get_ident()
+        with self._lock:
+            if ident not in self._abandoned:
+                return False
+            self._abandoned.discard(ident)
+        return True
+
+    def _bucket_lock(self, bucket: str):
+        """The bucket's ``[lock, holder_ident]`` cell, on demand."""
+        with self._lock:
+            cell = self._bucket_locks.get(bucket)
+            if cell is None:
+                cell = self._bucket_locks[bucket] = [
+                    threading.Lock(), None
+                ]
+            return cell
+
+    def _acquire_bucket(self, bucket: str):
+        """Serialize same-bucket dispatches (one compile per bucket is
+        an assertion, not a hope). A legitimately slow holder — a cold
+        record-shape compile can outlast any fixed fraction of the
+        request timeout — is waited out indefinitely; ONLY a lock
+        whose holder the watchdog abandoned as wedged is replaced, so
+        the bucket cannot stay poisoned forever and two workers can
+        never compile the same bucket concurrently."""
+        poll = max(0.05, min(0.5, self.request_timeout_s / 4))
+        while True:
+            cell = self._bucket_lock(bucket)
+            if cell[0].acquire(timeout=poll):
+                with self._lock:
+                    if self._bucket_locks.get(bucket) is not cell:
+                        # replaced while we were blocked on the stale
+                        # lock: drop it, race for the current one
+                        cell[0].release()
+                        continue
+                    cell[1] = threading.get_ident()
+                return cell
+            with self._lock:
+                holder = cell[1]
+                if (holder is not None
+                        and holder in self._abandoned
+                        and self._bucket_locks.get(bucket) is cell):
+                    self._bucket_locks[bucket] = [
+                        threading.Lock(), None
+                    ]
+
+    def _execute(self, req: _Request, batch_size: int):
+        import numpy as np
+
+        from tpukernels import registry
+
+        req.worker_ident = threading.get_ident()
+        # local t_start: the watchdog nulls req.t_start on a requeue,
+        # and this attempt may be the abandoned original unwinding
+        # late — its own wall must not read a field the retry owns
+        t_start = time.perf_counter()
+        req.t_start = t_start
+        with self._lock:
+            self._inflight[req.serial] = req
+        queue_wait = t_start - req.t_enq
+        obs_metrics.observe("serve.queue_wait_s", queue_wait)
+        if req.spec is not None and req.requeues == 0:
+            # once per request, not per attempt: a retry would count
+            # the same padding waste twice
+            obs_metrics.observe("serve.bucket_pad_frac", req.pad_frac)
+        cell = None
+        try:
+            if req.spec is not None:
+                args, meta = bucketing.pad_args(req.kernel, req.spec,
+                                                req.arrays)
+            else:
+                args, meta = req.arrays, None
+            import jax
+            import jax.numpy as jnp
+
+            jargs = tuple(jnp.asarray(a) for a in args)
+            cell = self._acquire_bucket(req.bucket)
+            with trace.span(f"serve/{req.kernel}", bucket=req.bucket):
+                out = registry.dispatch(req.kernel, *jargs,
+                                        **req.statics)
+                jax.block_until_ready(out)
+            if self._device_kind is None:
+                from tpukernels.tuning import cache as tcache
+
+                self._device_kind = tcache.device_kind()
+            outs = tuple(
+                np.asarray(o)
+                for o in (out if isinstance(out, (tuple, list))
+                          else (out,))
+            )
+            if meta is not None:
+                outs = bucketing.unpad_outputs(req.kernel, meta, outs)
+        except Exception as e:  # noqa: BLE001 — reported to the client
+            if req.claim_done():
+                self._finish(req, None, error=repr(e),
+                             wall=time.perf_counter() - t_start)
+            return
+        finally:
+            if cell is not None:
+                with self._lock:
+                    if cell[1] == threading.get_ident():
+                        cell[1] = None
+                cell[0].release()
+            # deregister only THIS attempt: after a watchdog requeue
+            # the same request object is re-registered by its retry
+            # worker, and an abandoned worker unwinding late must not
+            # blind the watchdog to that retry
+            with self._lock:
+                if (self._inflight.get(req.serial) is req
+                        and req.worker_ident == threading.get_ident()):
+                    self._inflight.pop(req.serial, None)
+        if req.claim_done():
+            wall = time.perf_counter() - t_start
+            with self._lock:
+                self._service_ewma = (0.8 * self._service_ewma
+                                      + 0.2 * wall)
+            self._finish(req, outs, queue_wait=queue_wait,
+                         batch_size=batch_size, wall=wall)
+        # else: the watchdog already answered for this request (the
+        # wedge finally unwound, or the requeue raced us) — discard
+
+    def _finish(self, req: _Request, outs, error=None,
+                queue_wait=None, batch_size=None, wall=None):
+        if wall is None:
+            # watchdog caller (wedged-twice): the retry attempt's own
+            # start is still in req.t_start here. _execute passes its
+            # attempt-local wall instead — req.t_start may belong to a
+            # different attempt by the time a slow original unwinds.
+            wall = time.perf_counter() - (req.t_start or req.t_enq)
+        payloads = ()
+        if error is None:
+            # an out-of-contract output (a dtype outside the wire's
+            # two) must become an error RESPONSE, not an exception
+            # that kills the worker thread
+            try:
+                specs, payloads = protocol.pack_arrays(outs)
+            except protocol.ProtocolError as e:
+                error = f"unserializable output: {e}"
+                payloads = ()
+        if error is None:
+            with self._lock:
+                self._served += 1
+            obs_metrics.inc(f"serve.requests.{req.kernel}")
+            obs_metrics.observe(f"serve.wall_s.{req.kernel}", wall)
+            header = {"v": protocol.VERSION, "id": req.rid, "ok": True,
+                      "outputs": specs}
+        else:
+            obs_metrics.inc("serve.errors")
+            header = {"v": protocol.VERSION, "id": req.rid, "ok": False,
+                      "kind": "error", "error": error}
+            payloads = ()
+        journal.emit(
+            "serve_request", kernel=req.kernel, request=req.rid,
+            bucket=req.bucket, pad_frac=round(req.pad_frac, 6),
+            bucketed=req.spec is not None,
+            wall_s=round(wall, 6),
+            queue_wait_s=round(queue_wait, 6)
+            if queue_wait is not None else None,
+            batch_size=batch_size, requeues=req.requeues,
+            ok=error is None, error=error,
+        )
+        try:
+            req.conn.send(header, payloads)
+        except (OSError, protocol.ProtocolError):
+            pass  # client gone/stalled; the work is journaled anyway
+
+    # -------------------------------------------------------------- #
+    # watchdog: abandon wedged workers, requeue once                 #
+    # -------------------------------------------------------------- #
+
+    def _probe_alive(self, timeout_s: float = 2.0) -> bool:
+        """Backend liveness from a side thread (SIGALRM is main-thread
+        only): a trivial device op either completes inside the window
+        (SLOW — the backend answers, one request/worker is stuck) or
+        does not (WEDGED — the backend itself is gone)."""
+        result = []
+
+        def _probe():
+            try:
+                import jax
+                import jax.numpy as jnp
+
+                jax.block_until_ready(jnp.zeros((2,)) + 1)
+                result.append(True)
+            except Exception:  # noqa: BLE001 — a dead backend IS the answer
+                pass
+
+        t = threading.Thread(target=_probe, daemon=True,
+                             name="serve-probe")
+        t.start()
+        t.join(timeout_s)
+        return bool(result)
+
+    def _watchdog_loop(self):
+        period = min(1.0, max(0.1, self.request_timeout_s / 4))
+        grace = self.request_timeout_s * 1.5
+        while not self._stop.is_set():
+            time.sleep(period)
+            now = time.perf_counter()
+            with self._lock:
+                overdue = [
+                    r for r in self._inflight.values()
+                    if r.t_start is not None
+                    and now - r.t_start > grace * (1 + r.patience)
+                ]
+            for req in overdue:
+                self._handle_wedge(req)
+
+    def _handle_wedge(self, req: _Request):
+        with self._lock:
+            if self._inflight.get(req.serial) is not req:
+                return
+        # classify BEFORE abandoning: a live backend means this may be
+        # a legitimately slow attempt — a cold record-shape compile can
+        # outlast any fixed grace — and abandoning it would replace the
+        # bucket lock under a live compile, putting a second compile of
+        # the same bucket in flight (the executable memo is unlocked).
+        # One doubled grace beats that; an attempt still overdue at 2x
+        # grace is treated as wedged regardless of the probe.
+        verdict = watchdog.classify_timeout(
+            self._probe_alive(), site="serve", kernel=req.kernel,
+            request=req.rid,
+        )
+        if verdict == "slow" and req.patience == 0:
+            req.patience = 1
+            print(f"# serve: {req.kernel} request {req.rid} overdue "
+                  f"(> {self.request_timeout_s * 1.5:.1f}s) but the "
+                  "backend answers - extending grace once",
+                  file=sys.stderr)
+            return
+        with self._lock:
+            still = self._inflight.pop(req.serial, None)
+        if still is None:
+            return  # the attempt completed during the probe
+        obs_metrics.inc("watchdog.kills")
+        journal.emit(
+            "watchdog_fire", mechanism="serve-abandon", site="serve",
+            timeout_s=self.request_timeout_s, kernel=req.kernel,
+            request=req.rid,
+        )
+        if req.worker_ident is not None:
+            with self._lock:
+                self._abandoned.add(req.worker_ident)
+                # rescue batch members coalesced behind the wedge:
+                # they were never started (not in _inflight) and the
+                # abandoned thread will never reach its hand-back —
+                # drain under the lock so a late-unwinding worker
+                # cannot pop a request we are about to requeue
+                pend = self._worker_pending.pop(req.worker_ident, None)
+                stranded = list(pend) if pend else []
+                if pend:
+                    pend.clear()
+            self._spawn_worker()
+            for rest in stranded:
+                # forced: already admitted, must not bounce off
+                # backpressure on the rescue
+                self._q.put_nowait(rest, force=True)
+        if req.requeues < 1:
+            req.requeues += 1
+            req.t_start = None
+            req.worker_ident = None
+            # the retry's queue wait measures ITS queueing, not the
+            # failed attempt it replaces (~grace worth of wedge time
+            # would dominate the serve.queue_wait_s tail otherwise)
+            req.t_enq = time.perf_counter()
+            with self._lock:
+                self._requeued += 1
+            obs_metrics.inc("serve.requeued")
+            journal.emit(
+                "serve_request_requeued", kernel=req.kernel,
+                request=req.rid, bucket=req.bucket,
+                timeout_s=self.request_timeout_s,
+            )
+            # forced: a request the service already accepted must not
+            # bounce off its own backpressure on the retry
+            self._q.put_nowait(req, force=True)
+        elif req.claim_done():
+            self._finish(
+                req, None,
+                error=(f"request wedged twice (> "
+                       f"{self.request_timeout_s}s each attempt)"),
+            )
+
+
+# ------------------------------------------------------------------ #
+# CLI entry (python -m tpukernels.serve)                             #
+# ------------------------------------------------------------------ #
+
+def _hold_pidfile(path: str):
+    """Write-and-flock the daemon pidfile for the process lifetime —
+    the revalidate_lib.sh watcher-lock convention: liveness is the
+    flock, the recorded pid is the diagnosis. Returns the held fd
+    (kept open) or raises RuntimeError when another daemon holds it."""
+    import fcntl
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    # a+ so a losing contender can never truncate the holder's pid
+    f = open(path, "a+")
+    # a few NB retries: serve_ctl's liveness probe takes the flock for
+    # a flash — a status check racing our startup must not read as
+    # "another daemon" and abort us
+    for attempt in range(5):
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            break
+        except OSError:
+            if attempt < 4:
+                time.sleep(0.1)
+                continue
+            f.seek(0)
+            pid = f.readline().strip()
+            f.close()
+            raise RuntimeError(
+                f"another serve daemon holds {path}"
+                + (f" (pid {pid})" if pid else "")
+            ) from None
+    f.seek(0)
+    f.truncate()
+    f.write(f"{os.getpid()}\n")
+    f.flush()
+    return f
+
+
+def main(argv=None):
+    import signal
+
+    argv = sys.argv[1:] if argv is None else list(argv)
+    socket_path = queue_max = workers = None
+    batch_window_ms = request_timeout_s = None
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--socket":
+                socket_path = next(it)
+            elif a == "--queue-max":
+                queue_max = int(next(it))
+            elif a == "--workers":
+                workers = int(next(it))
+            elif a == "--batch-window-ms":
+                batch_window_ms = float(next(it))
+            elif a == "--request-timeout-s":
+                request_timeout_s = float(next(it))
+            elif a in ("-h", "--help"):
+                print(__doc__, file=sys.stderr)
+                return 0
+            else:
+                print(__doc__, file=sys.stderr)
+                print(f"serve: unknown argument {a!r}", file=sys.stderr)
+                return 2
+    except (StopIteration, ValueError):
+        print(f"serve: {a} needs a value", file=sys.stderr)
+        return 2
+
+    # CLI journal default (the bench.py/loadgen.py contract): an
+    # unattended daemon's evidence must land in the day's journal
+    if os.environ.get("TPK_HEALTH_JOURNAL") is None:
+        os.environ["TPK_HEALTH_JOURNAL"] = journal.default_path()
+    # sampled oracle canaries are multi-ms outliers in exactly the
+    # request tail this daemon is judged on (the loadgen rationale);
+    # the always-on tripwire stays, and an explicit env choice wins
+    os.environ.setdefault("TPK_INTEGRITY", "tripwire")
+
+    try:
+        server = Server(socket_path, queue_max, workers,
+                        batch_window_ms, request_timeout_s)
+    except (ValueError, OSError) as e:
+        # OSError: an unreadable TPK_SERVE_BUCKETS file path
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+    try:
+        pidfile = _hold_pidfile(_cachedir.serve_pidfile_path())
+    except RuntimeError as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 3
+
+    from tpukernels.obs import scaling as obs_scaling
+
+    obs_scaling.emit_inventory("serve")
+    signal.signal(signal.SIGTERM, server.stop)
+    signal.signal(signal.SIGINT, server.stop)
+    print(f"# serve: listening on {server.socket_path} "
+          f"(pid {os.getpid()}, workers {server.workers}, "
+          f"queue max {server.queue_max})", file=sys.stderr)
+    try:
+        server.serve_forever()
+    except OSError as e:
+        print(f"serve: cannot serve on {server.socket_path}: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        try:
+            pidfile.close()
+            os.unlink(_cachedir.serve_pidfile_path())
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
