@@ -258,6 +258,14 @@ if "TPK_SCALING_DIR" not in os.environ:
 # daemon regardless of the isolation below — scrub it; tests that
 # want the daemon route set it explicitly on their own socket.
 os.environ.pop("TPK_SERVE_SOCKET", None)
+# The fleet-dir redirect is scrubbed for the same reason: an exported
+# TPK_SERVE_FLEET_DIR would make test-spawned fleets (serve_ctl
+# start-fleet) collide with — or drain workers of — an operator's
+# real fleet. The default then resolves under the isolated
+# TPK_SERVE_DIR below; stale fleet state from a killed previous run
+# (fleet.json, front socket, router pidfile) is cleared so
+# start-fleet's double-start refusal starts from a clean slate.
+os.environ.pop("TPK_SERVE_FLEET_DIR", None)
 if "TPK_SERVE_DIR" not in os.environ:
     import tempfile
 
@@ -266,7 +274,10 @@ if "TPK_SERVE_DIR" not in os.environ:
     )
     os.makedirs(_serve_dir, exist_ok=True)
     os.environ["TPK_SERVE_DIR"] = _serve_dir
-    for _f in ("serve.sock", "serve.pid"):
+    for _f in ("serve.sock", "serve.pid",
+               os.path.join("fleet", "fleet.json"),
+               os.path.join("fleet", "front.sock"),
+               os.path.join("fleet", "router.pid")):
         try:
             os.unlink(os.path.join(_serve_dir, _f))
         except OSError:

@@ -1,0 +1,605 @@
+"""CPU suite for the sharded serving fleet (docs/SERVING.md §fleet;
+ISSUE 11).
+
+Covers the fleet contracts without a TPU: deterministic md5 bucket
+routing with the FLEET-WIDE one-compile proof (3 concurrent clients x
+mixed shapes against 1 router + 2 workers -> exactly one
+``aot_hit``/``aot_miss`` per (kernel, bucket) across every process),
+spill-to-sibling under worker backpressure, live drain + restart
+mid-burst with zero dropped requests, wedged-worker failover chaos
+via an env-narrowed ``wedge_dispatch`` fault plan, per-tenant
+token-bucket quotas with priority classes, front-socket protocol
+poisoning isolated to one connection, the seeded retry-jitter
+thundering-herd fix, and the ``loadgen --serve --tenant`` ->
+per-tenant ``slo.json`` rows -> ``obs_report --check`` e2e with the
+rc contract unchanged.
+"""
+
+import contextlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_distributed import _scrubbed_env
+from test_serve import SCAN_BUCKET, _aot_bucket_events, _events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CTL = os.path.join(REPO, "tools", "serve_ctl.py")
+
+
+def _ctl(env, *args, timeout=120):
+    return subprocess.run(
+        [sys.executable, CTL, *args], capture_output=True, text=True,
+        timeout=timeout, cwd=REPO, env=env,
+    )
+
+
+@contextlib.contextmanager
+def _fleet(tmp_path, n=2, env_extra=None, tag="f"):
+    """Start a fleet (router + ``n`` workers) via ``serve_ctl
+    start-fleet`` in an isolated serve dir; yields (front_socket,
+    journal_path, env) and stops the fleet on exit."""
+    d = tmp_path / tag
+    d.mkdir(exist_ok=True)
+    journal = str(d / "health.jsonl")
+    env = _scrubbed_env(None)
+    env["TPK_SERVE_DIR"] = str(d)
+    env["TPK_HEALTH_JOURNAL"] = journal
+    env.update(env_extra or {})
+    r = _ctl(env, "start-fleet", str(n), "--wait", "90", timeout=150)
+    assert r.returncode == 0, r.stdout + r.stderr
+    front = str(d / "fleet" / "front.sock")
+    try:
+        yield front, journal, env
+    finally:
+        _ctl(env, "stop-fleet", "--wait", "30", timeout=150)
+
+
+def _scan_case(n=6000):
+    x = (np.arange(n) % 17).astype(np.int32)
+    return x, np.cumsum(x, dtype=np.int64).astype(np.int32)
+
+
+# ---------------------------------------------------------------- #
+# pure units: ring math, retry jitter, per-tenant SLO keys         #
+# ---------------------------------------------------------------- #
+
+def test_ring_order_deterministic_and_complete():
+    from tpukernels.serve import router
+
+    for n in (1, 2, 3, 5):
+        order = router.ring_order("scan|8192|-", n)
+        assert sorted(order) == list(range(n))
+        # stable across calls (md5, not python's salted hash)
+        assert order == router.ring_order("scan|8192|-", n)
+    # distinct buckets spread: with a handful of keys over 2 workers
+    # both primaries must occur (md5 uniformity, pinned here so a
+    # hash change is a loud test failure, not silent resharding)
+    primaries = {
+        router.ring_order(b, 2)[0]
+        for b in ("scan|8192|-", "vector_add|-+1024+1024|-",
+                  "sgemm|-+48x80+80x64+-+48x64|-", "histogram|4128|nbins=256")
+    }
+    assert primaries == {0, 1}
+
+
+def test_retry_jitter_deterministic_and_decorrelated(monkeypatch):
+    """The thundering-herd fix: jittered backpressure retries are
+    0.5x-1.5x the hint, byte-reproducible per seed, and two different
+    seeds do NOT sleep in lockstep."""
+    import random
+
+    from tpukernels.serve import client as serve_client
+
+    class _Rejecting:
+        def dispatch(self, kernel, *a, **s):
+            raise serve_client.ServeRejected("full", 0.2)
+
+    def run(seed):
+        sleeps = []
+        monkeypatch.setattr(
+            "tpukernels.serve.client.time.sleep", sleeps.append
+        )
+        with pytest.raises(serve_client.ServeRejected):
+            serve_client.dispatch_with_backpressure(
+                _Rejecting(), "scan", (), {}, max_rejections=5,
+                jitter=random.Random(seed),
+            )
+        return sleeps
+
+    a, b, a2 = run(1), run(2), run(1)
+    assert a == a2, "same seed must sleep identically"
+    assert a != b, "different seeds must decorrelate"
+    assert len(a) == 4
+    assert all(0.1 <= s < 0.3 for s in a + b)
+    # and without a jitter stream, the raw hint is kept (the capi
+    # single-client path is unchanged)
+    sleeps = []
+    monkeypatch.setattr(
+        "tpukernels.serve.client.time.sleep", sleeps.append
+    )
+    with pytest.raises(serve_client.ServeRejected):
+        serve_client.dispatch_with_backpressure(
+            _Rejecting(), "scan", (), {}, max_rejections=3
+        )
+    assert sleeps == [0.2, 0.2]
+
+
+def test_slo_tenant_rows_resolve_base_kernel(monkeypatch, tmp_path):
+    """``scan@hot`` series: targets + kernel sources resolve the BASE
+    kernel, the verdict keyspace keeps the tenant — per-tenant rows
+    ride the unchanged slo.json contract."""
+    from tpukernels.obs import slo
+
+    assert slo.base_kernel("scan@hot") == "scan"
+    assert slo.base_kernel("scan") == "scan"
+    assert (slo.resolve_target_s("scan@hot", "cpu", "probe")
+            == slo.resolve_target_s("scan", "cpu", "probe"))
+    assert slo.resolve_target_s("scan@hot", "cpu", "probe")[0] is not None
+    # unknown base kernel still has no row, tenant or not
+    assert slo.resolve_target_s("nope@hot", "cpu", "probe")[0] is None
+    assert slo.entry_key("scan@hot", "probe", "cpu") == "scan@hot|probe|cpu"
+    # a tenant entry persists and validates like any other
+    monkeypatch.setenv("TPK_SLO_DIR", str(tmp_path))
+    slo.reset()
+    row = {
+        "kernel": "scan@hot", "count": 30, "p50_s": 0.001,
+        "p95_s": 0.002, "p99_s": 0.003, "max_s": 0.004,
+        "buckets": {}, "target_p99_s": 0.4, "basis": "cpu-fallback",
+        "device_kind": "cpu", "shape_class": "probe",
+        "simulated": True, "verdict": "ok",
+    }
+    slo.record({"scan@hot": row}, {"tenant": "hot"})
+    entries = slo.load_entries()
+    assert "scan@hot|probe|cpu|sim" in entries
+
+
+# ---------------------------------------------------------------- #
+# the fleet service loop                                           #
+# ---------------------------------------------------------------- #
+
+def test_fleet_one_compile_per_bucket_and_poison_isolation(tmp_path):
+    """The acceptance headline: 3 concurrent clients x mixed
+    (bucketable) shapes against a 1-router/2-worker fleet — every
+    response correct, and EXACTLY ONE aot_hit/aot_miss per (kernel,
+    bucket) across the whole fleet (the consistent hash keeps each
+    bucket's executable memo on one worker). Afterwards, garbage and
+    oversize frames at the front socket poison only their own
+    connection — the router and every worker keep serving."""
+    import socket as socket_mod
+
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import protocol, router
+
+    with _fleet(tmp_path, n=2, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_BATCH_WINDOW_MS": "0",
+    }) as (front, journal, _env):
+        lengths = [5000, 6000, 7000, 8000, 8192]
+        errors = []
+
+        def client_run(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                with serve_client.ServeClient(
+                    front, timeout_s=180, tenant=f"t{seed}"
+                ) as c:
+                    for n in lengths:
+                        x = rng.integers(-50, 50, n).astype(np.int32)
+                        out = c.dispatch("scan", x)
+                        np.testing.assert_array_equal(
+                            out, np.cumsum(x, dtype=np.int64
+                                           ).astype(np.int32)
+                        )
+                    x = rng.standard_normal(1024).astype(np.float32)
+                    y = rng.standard_normal(1024).astype(np.float32)
+                    out = c.dispatch("vector_add", np.float32(2.0), x, y)
+                    np.testing.assert_allclose(out, 2.0 * x + y,
+                                               rtol=1e-6, atol=1e-6)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=client_run, args=(s,))
+                   for s in (1, 2, 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        assert not errors, errors
+
+        # --- protocol poison: only the poisoned connection dies --- #
+        def _hung_up(sock):
+            """EOF or RST both mean the router dropped the poisoned
+            connection (RST when our junk bytes were still unread at
+            its close)."""
+            sock.settimeout(10)
+            try:
+                return sock.recv(1) == b""
+            except ConnectionResetError:
+                return True
+
+        s = socket_mod.socket(socket_mod.AF_UNIX,
+                              socket_mod.SOCK_STREAM)
+        s.connect(front)
+        s.sendall(b"GET / HTTP/1.1\r\n" + b"\0" * 32)
+        assert _hung_up(s)  # router hung up on the poison
+        s.close()
+        s = socket_mod.socket(socket_mod.AF_UNIX,
+                              socket_mod.SOCK_STREAM)
+        s.connect(front)
+        s.sendall(protocol._PREAMBLE.pack(
+            protocol.MAGIC, protocol.MAX_HEADER + 1, 0
+        ))
+        assert _hung_up(s)  # absurd header length: same fate
+        s.close()
+        # an unknown op errors politely WITHOUT poisoning the stream
+        s = socket_mod.socket(socket_mod.AF_UNIX,
+                              socket_mod.SOCK_STREAM)
+        s.connect(front)
+        protocol.send_frame(s, {"v": 1, "op": "teapot", "id": 9})
+        hdr, _p = protocol.recv_frame(s)
+        assert hdr["ok"] is False and "unknown op" in hdr["error"]
+        protocol.send_frame(s, {"v": 1, "op": "ping"})
+        assert protocol.recv_frame(s)[0]["role"] == "router"
+        s.close()
+        # the fleet still serves real traffic after the abuse
+        x, want = _scan_case()
+        with serve_client.ServeClient(front, timeout_s=120) as c:
+            np.testing.assert_array_equal(c.dispatch("scan", x), want)
+
+    events = _events(journal)
+    served = [e for e in events if e.get("kind") == "serve_request"]
+    assert len(served) == 3 * (len(lengths) + 1) + 1
+    assert all(e.get("ok") for e in served)
+    # THE fleet-wide one-compile proof: one compile per (kernel,
+    # bucket) across router + both workers + all clients
+    assert len(_aot_bucket_events(events, "scan", "8192")) == 1
+    assert len(_aot_bucket_events(events, "vector_add", "1024")) == 1
+    # routing landed every bucket on its deterministic ring home
+    routes = [e for e in events if e.get("kind") == "serve_route"]
+    assert routes and all(e.get("ok") for e in routes)
+    by_bucket = {}
+    for e in routes:
+        by_bucket.setdefault(e["bucket"], set()).add(e["worker"])
+    for bucket, workers in by_bucket.items():
+        assert workers == {router.ring_order(bucket, 2)[0]}, (
+            bucket, workers,
+        )
+    # tenants rode through to the worker-side request evidence
+    assert {e.get("tenant") for e in served} >= {"t1", "t2", "t3"}
+
+
+def test_spill_on_backpressure_to_deterministic_sibling(tmp_path):
+    """A slow, depth-1 HOME worker (env-narrowed slow_dispatch: the
+    sibling stays fast) under a concurrent same-bucket burst: the
+    router absorbs the worker's overload rejections by spilling to
+    the bucket's deterministic ring sibling instead of bouncing
+    clients, and every request still answers correctly."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import router
+
+    primary, sibling = router.ring_order("scan|8192|-", 2)[:2]
+    plan = json.dumps({"slow_dispatch": {
+        "kernel": "scan", "delay_s": 1.2,
+        "env": {"TPK_SERVE_WORKER_ID": str(primary)},
+    }})
+    with _fleet(tmp_path, n=2, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_WORKERS": "1",
+        "TPK_SERVE_BATCH_WINDOW_MS": "0",
+        "TPK_SERVE_QUEUE_MAX": "1",
+        "TPK_FAULT_PLAN": plan,
+    }) as (front, journal, _env):
+        x, want = _scan_case()
+        errors = []
+
+        def one(seed):
+            import random
+
+            try:
+                with serve_client.ServeClient(front,
+                                              timeout_s=180) as c:
+                    # generous retry budget: on a loaded CI host BOTH
+                    # depth-1 workers can be transiently full and the
+                    # ~0.1 s hints burn through the default 10 tries
+                    # before the 1.2 s slow dispatch clears — the
+                    # contract under test is the spill, not the
+                    # client's give-up threshold
+                    out = serve_client.dispatch_with_backpressure(
+                        c, "scan", (x,), {}, max_rejections=60,
+                        jitter=random.Random(seed),
+                    )
+                np.testing.assert_array_equal(out, want)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=one, args=(s,))
+                   for s in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        assert not errors, errors
+    events = _events(journal)
+    spills = [e for e in events if e.get("kind") == "serve_spill"]
+    assert spills, "a full home worker must spill, not bounce"
+    assert all(e["from_worker"] == primary
+               and e["to_worker"] == sibling for e in spills)
+    assert any(e["reason"] == "overloaded" for e in spills)
+    served = [e for e in events if e.get("kind") == "serve_request"
+              and e.get("ok")]
+    assert len(served) == 5
+
+
+def test_drain_mid_burst_zero_drops_then_restart(tmp_path):
+    """The rolling-restart chaos proof: drain one worker in the
+    middle of a concurrent request burst — its buckets fail over to
+    the ring sibling, the worker stops, and NOT ONE accepted request
+    drops — then ``undrain`` restarts it and restores the ring."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import router
+
+    primary = router.ring_order("scan|8192|-", 2)[0]
+    with _fleet(tmp_path, n=2, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_BATCH_WINDOW_MS": "0",
+    }) as (front, journal, env):
+        x, want = _scan_case()
+        errors, done = [], []
+        stop_burst = threading.Event()
+
+        def stream():
+            try:
+                with serve_client.ServeClient(front,
+                                              timeout_s=180) as c:
+                    # warm once, then stream until told to stop
+                    np.testing.assert_array_equal(
+                        c.dispatch("scan", x), want
+                    )
+                    while not stop_burst.is_set():
+                        np.testing.assert_array_equal(
+                            c.dispatch("scan", x), want
+                        )
+                        done.append(1)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=stream) for _ in range(3)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while len(done) < 5 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert done, "burst never got going"
+        r = _ctl(env, "drain", str(primary), "--wait", "30")
+        assert r.returncode == 0, r.stdout + r.stderr
+        # the fleet keeps serving while one worker is gone
+        mid = len(done)
+        deadline = time.monotonic() + 60
+        while len(done) < mid + 5 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(done) > mid, "fleet stalled after drain"
+        r = _ctl(env, "undrain", str(primary), "--wait", "90")
+        assert r.returncode == 0, r.stdout + r.stderr
+        deadline = time.monotonic() + 60
+        post = len(done)
+        while len(done) < post + 5 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        stop_burst.set()
+        for t in threads:
+            t.join(120)
+        assert not errors, errors
+
+    events = _events(journal)
+    drains = [e for e in events if e.get("kind") == "serve_drain"]
+    assert [e["phase"] for e in drains] == ["begin", "undrain"]
+    assert all(e["worker"] == primary for e in drains)
+    # zero drops: every routed request answered ok, every
+    # worker-served request ok
+    routes = [e for e in events if e.get("kind") == "serve_route"]
+    assert routes and all(e.get("ok") for e in routes)
+    t_drain = next(e["t"] for e in drains if e["phase"] == "begin")
+    t_undrain = next(e["t"] for e in drains if e["phase"] == "undrain")
+    # +1 s: a forward that STARTED just before the drain op may
+    # journal its serve_route just after it — not a violation
+    drained_window = [
+        e for e in routes if t_drain + 1.0 < e["t"] < t_undrain
+    ]
+    assert drained_window, "no traffic landed during the drain window"
+    assert all(e["worker"] != primary for e in drained_window), (
+        "requests routed to a draining worker"
+    )
+
+
+def test_wedged_worker_failover_and_cooldown(tmp_path):
+    """The wedged-worker chaos headline: EVERY scan dispatch on the
+    bucket's home worker wedges (env-narrowed ``wedge_dispatch``,
+    times=0). The worker's own watchdog gives up after the
+    requeue-once budget and answers ``kind: "wedged"``; the router
+    spills to the sibling (loudly), puts the sick worker on a
+    routing cooldown, and the client still gets the right answer —
+    and the NEXT request routes straight to the sibling without
+    re-feeding the wedge."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import router
+
+    primary, sibling = router.ring_order("scan|8192|-", 2)[:2]
+    plan = json.dumps({"wedge_dispatch": {
+        "kernel": "scan", "times": 0,
+        "env": {"TPK_SERVE_WORKER_ID": str(primary)},
+    }})
+    with _fleet(tmp_path, n=2, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_REQUEST_TIMEOUT_S": "2",
+        "TPK_ROUTE_COOLDOWN_S": "120",
+        "TPK_FAULT_PLAN": plan,
+    }) as (front, journal, _env):
+        x, want = _scan_case()
+        with serve_client.ServeClient(front, timeout_s=180) as c:
+            out = c.dispatch("scan", x)  # rides out the wedge
+            np.testing.assert_array_equal(out, want)
+            out = c.dispatch("scan", x)  # cooled: direct to sibling
+            np.testing.assert_array_equal(out, want)
+    events = _events(journal)
+    spills = [e for e in events if e.get("kind") == "serve_spill"]
+    assert any(e["reason"] == "wedged" and e["from_worker"] == primary
+               and e["to_worker"] == sibling for e in spills)
+    routes = [e for e in events if e.get("kind") == "serve_route"
+              and e.get("kernel") == "scan"]
+    assert [e.get("ok") for e in routes] == [True, True]
+    # request 1 spilled after the wedge; request 2 routed directly to
+    # the sibling (cooldown) — no second trip through the wedge
+    assert routes[0]["worker"] == sibling
+    assert routes[0]["spilled_from"] == primary
+    assert routes[1]["worker"] == sibling
+    assert routes[1]["spilled_from"] is None
+    # the home worker's watchdog evidence is in the same journal
+    assert any(e.get("kind") == "serve_request_requeued"
+               for e in events)
+
+
+def test_tenant_quota_priority_and_fleet_lifecycle(tmp_path):
+    """Router admission: with a drained token bucket (tiny refill), a
+    tenant's batch-priority requests are throttled FIRST (they must
+    leave the 1 + burst/2 reserve) while interactive requests still
+    pass, and a second tenant's bucket is untouched. Also the fleet
+    operator loop: status shows router totals + per-worker ping
+    payloads (depth, inflight, bucket ownership), a double
+    start-fleet is refused rc 3, stop-fleet tears down."""
+    from tpukernels.serve import client as serve_client
+
+    with _fleet(tmp_path, n=1, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_ROUTE_TENANT_RATE": "0.001",
+        "TPK_ROUTE_TENANT_BURST": "4",
+    }) as (front, journal, env):
+        x, want = _scan_case()
+        hot_batch = serve_client.ServeClient(front, timeout_s=180,
+                                             tenant="hot",
+                                             priority="batch")
+        hot_inter = serve_client.ServeClient(front, timeout_s=180,
+                                             tenant="hot")
+        cold = serve_client.ServeClient(front, timeout_s=180,
+                                        tenant="cold")
+        # tokens 4 -> batch needs 3: ok (3 left), ok (2 left)...
+        np.testing.assert_array_equal(
+            hot_batch.dispatch("scan", x), want)
+        np.testing.assert_array_equal(
+            hot_batch.dispatch("scan", x), want)
+        # ...throttled at 2 < 3 — the interactive reserve holds
+        with pytest.raises(serve_client.ServeRejected) as exc:
+            hot_batch.dispatch("scan", x)
+        assert 0 < exc.value.retry_after_s <= 5.0
+        # the same tenant's INTERACTIVE request still passes (2 >= 1)
+        np.testing.assert_array_equal(
+            hot_inter.dispatch("scan", x), want)
+        # another tenant's bucket is untouched
+        np.testing.assert_array_equal(cold.dispatch("scan", x), want)
+        # an unknown priority is a bad request, not a crash
+        weird = serve_client.ServeClient(front, timeout_s=60,
+                                         priority="urgent")
+        with pytest.raises(serve_client.ServeError, match="priority"):
+            weird.dispatch("scan", x)
+        for c in (hot_batch, hot_inter, cold, weird):
+            c.close()
+
+        r = _ctl(env, "status")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "fleet UP" in r.stdout and "throttled=1" in r.stdout
+        assert "worker0" in r.stdout and "scan|8192|-" in r.stdout
+        assert "inflight=" in r.stdout
+        r = _ctl(env, "start-fleet", "1", "--wait", "30")
+        assert r.returncode == 3, r.stdout + r.stderr
+        assert "already running" in r.stdout
+    events = _events(journal)
+    throttled = [e for e in events
+                 if e.get("kind") == "serve_tenant_throttled"]
+    assert len(throttled) == 1
+    assert throttled[0]["tenant"] == "hot"
+    assert throttled[0]["priority"] == "batch"
+    served = [e for e in events if e.get("kind") == "serve_request"]
+    assert sorted(e.get("tenant") or "-" for e in served) == [
+        "cold", "hot", "hot", "hot"
+    ]
+    # after stop-fleet, status reports DOWN
+    r = _ctl(env, "status")
+    assert r.returncode == 1 and "DOWN" in r.stdout
+
+
+def test_loadgen_fleet_tenants_fairness_slo_e2e(tmp_path):
+    """The fairness e2e under a skewed mix: a hot tenant hammering
+    the fleet through the front socket gets throttled at the
+    router's token buckets while a steady tenant's every request is
+    served; the steady tenant's p99 verdict lands as its OWN
+    validated ``scan@steady`` row in slo.json, and ``obs_report
+    --check`` keeps its rc contract (rc 0 — throttling is pacing,
+    not a breach)."""
+    slo_dir = tmp_path / "slo"
+    slo_dir.mkdir()
+    with _fleet(tmp_path, n=2, env_extra={
+        "TPK_ROUTE_TENANT_RATE": "3",
+        "TPK_ROUTE_TENANT_BURST": "6",
+    }) as (front, journal, env):
+        lg = os.path.join(REPO, "tools", "loadgen.py")
+        lg_env = dict(env)
+        lg_env["TPK_SLO_DIR"] = str(slo_dir)
+        lg_env["TPK_HEALTH_JOURNAL"] = journal
+        hot = subprocess.Popen(
+            [sys.executable, lg, "--serve", front, "--kernel", "scan",
+             "--arrivals", "poisson", "--seed", "7", "--requests",
+             "15", "--rate", "30", "--tenant", "hot"],
+            cwd=REPO, env=lg_env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        steady = subprocess.run(
+            [sys.executable, lg, "--serve", front, "--kernel", "scan",
+             "--arrivals", "poisson", "--seed", "3", "--requests",
+             "25", "--rate", "2", "--tenant", "steady"],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+            env=lg_env,
+        )
+        hot_out, hot_err = hot.communicate(timeout=300)
+        assert steady.returncode == 0, steady.stdout + steady.stderr
+        assert hot.returncode == 0, hot_out + hot_err
+        assert "(SERVED)" in steady.stdout
+    events = _events(journal)
+    # the hot tenant was throttled (its retries absorbed the pacing)
+    throttled = [e for e in events
+                 if e.get("kind") == "serve_tenant_throttled"]
+    assert any(e["tenant"] == "hot" for e in throttled)
+    # every steady request (25 + 1 warm) was served — zero drops
+    steady_served = [e for e in events
+                     if e.get("kind") == "serve_request"
+                     and e.get("tenant") == "steady"]
+    assert len(steady_served) == 26
+    assert all(e.get("ok") for e in steady_served)
+    # per-tenant rows landed in slo.json under the base kernel's
+    # target; the steady tail is clean
+    with open(slo_dir / "slo.json") as f:
+        entries = json.load(f)["entries"]
+    steady_row = entries["scan@steady|probe|cpu"]
+    assert steady_row["verdict"] == "ok"
+    assert steady_row["run"]["tenant"] == "steady"
+    assert steady_row["jax"] is not None
+    assert "scan@hot|probe|cpu" in entries
+    # the gating surface is unchanged: rc 0
+    chk_env = _scrubbed_env(None)
+    chk_env["TPK_SLO_DIR"] = str(slo_dir)
+    chk = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=chk_env,
+    )
+    assert chk.returncode == 0, chk.stdout + chk.stderr

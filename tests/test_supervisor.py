@@ -764,10 +764,12 @@ def test_production_plan_order_reproduces_next_md(tmp_path,
         sup._settled.add(spec.name)       # pretend it went green
         sup._attempted.add(spec.name)
     # serve_probe (value 10 / 2 min) ties obs_check's density and
-    # lands between the in-process slo_probe and the CPU-only checks
-    assert order[:10] == ["prewarm_all", "bench", "slo_probe",
+    # lands between the in-process slo_probe and the CPU-only checks;
+    # fleet_probe (value 9 / 3 min = 3.0) slots between c_gate (3.33)
+    # and c_scan_timing (2.5)
+    assert order[:11] == ["prewarm_all", "bench", "slo_probe",
                           "serve_probe", "obs_check",
                           "roofline_report", "busbw_sweep", "c_gate",
-                          "c_scan_timing", "profile"]
+                          "fleet_probe", "c_scan_timing", "profile"]
     assert order[-2:] == ["san_asan", "san_ubsan"]
     assert len(order) == len(cli.PRODUCTION_QUEUE)

@@ -258,6 +258,13 @@ def _fmt(ev):
         return (f"{ts} [pid {pid}] slo verdict REJECTED "
                 f"{ev.get('key')}: {ev.get('reason')}")
     if kind == "serve_start":
+        if ev.get("role") == "router":
+            return (f"{ts} [pid {pid}] fleet ROUTER started on "
+                    f"{ev.get('socket')} over {ev.get('workers')} "
+                    f"worker(s)"
+                    + (f", tenant quota {ev.get('tenant_rate')}/s "
+                       f"burst {ev.get('tenant_burst')}"
+                       if ev.get("tenant_rate") else ""))
         return (f"{ts} [pid {pid}] serve daemon STARTED on "
                 f"{ev.get('socket')} ({ev.get('workers')} worker(s), "
                 f"queue max {ev.get('queue_max')}, batch window "
@@ -283,10 +290,38 @@ def _fmt(ev):
                 f"{ev.get('timeout_s')}s - worker abandoned, one "
                 "retry")
     if kind == "serve_stop":
+        if ev.get("role") == "router":
+            return (f"{ts} [pid {pid}] fleet router stopped: "
+                    f"{ev.get('routed')} routed, "
+                    f"{ev.get('spilled')} spilled, "
+                    f"{ev.get('throttled')} throttled over "
+                    f"{ev.get('uptime_s')}s")
         return (f"{ts} [pid {pid}] serve daemon stopped: "
                 f"{ev.get('served')} served, {ev.get('rejected')} "
                 f"rejected, {ev.get('requeued')} requeued over "
                 f"{ev.get('uptime_s')}s")
+    if kind == "serve_route":
+        # per-request routing is high-volume; clean routes render
+        # only in the aggregate table (_route_table) — a route that
+        # ended in a relayed failure is the notable exception
+        if ev.get("ok"):
+            return None
+        return (f"{ts} [pid {pid}] routed {ev.get('kernel')} request "
+                f"{ev.get('request')} to worker {ev.get('worker')} "
+                "FAILED downstream")
+    if kind == "serve_spill":
+        return (f"{ts} [pid {pid}] SPILLED {ev.get('kernel')} bucket "
+                f"{ev.get('bucket')} worker {ev.get('from_worker')} "
+                f"-> {ev.get('to_worker')} ({ev.get('reason')})")
+    if kind == "serve_drain":
+        return (f"{ts} [pid {pid}] fleet worker {ev.get('worker')} "
+                + ("DRAINING" if ev.get("phase") == "begin"
+                   else "restored to the ring")
+                + f" ({ev.get('inflight')} in flight)")
+    if kind == "serve_tenant_throttled":
+        return (f"{ts} [pid {pid}] tenant {ev.get('tenant')} "
+                f"THROTTLED ({ev.get('priority')} {ev.get('kernel')} "
+                f"request; retry after {ev.get('retry_after_s')}s)")
     if kind == "device_inventory":
         n = ev.get("n_devices")
         return (f"{ts} [pid {pid}] device inventory ({ev.get('site')}, "
@@ -468,6 +503,40 @@ def _serve_table(events):
     return out
 
 
+def _route_table(events):
+    """Per-worker routed-request aggregate from the high-volume
+    ``serve_route`` events (docs/SERVING.md §fleet) — where each
+    bucket landed, how much spilled, which tenants rode — the
+    fleet-side twin of :func:`_serve_table`."""
+    rows: dict = {}
+    for ev in events:
+        if ev.get("kind") != "serve_route":
+            continue
+        r = rows.setdefault(ev.get("worker", "?"), {
+            "n": 0, "ok": 0, "spilled_in": 0, "buckets": set(),
+            "tenants": set(),
+        })
+        r["n"] += 1
+        r["ok"] += 1 if ev.get("ok") else 0
+        r["spilled_in"] += 1 if ev.get("spilled_from") is not None else 0
+        r["buckets"].add(ev.get("bucket"))
+        if ev.get("tenant") not in (None, "-"):
+            r["tenants"].add(ev.get("tenant"))
+    if not rows:
+        return []
+    out = ["routed requests (from serve_route events):"]
+    for worker in sorted(rows, key=str):
+        r = rows[worker]
+        out.append(
+            f"  worker {worker}: n={r['n']:<5} ok={r['ok']:<5} "
+            f"spilled_in={r['spilled_in']} "
+            f"buckets={len(r['buckets'])}"
+            + (f" tenants={','.join(sorted(r['tenants']))}"
+               if r["tenants"] else "")
+        )
+    return out
+
+
 def summarize(events, bad=0) -> str:
     out = []
     events = sorted(events, key=lambda e: e.get("t", 0.0))
@@ -491,6 +560,10 @@ def summarize(events, bad=0) -> str:
     served = _serve_table(events)
     if served:
         out.extend(served)
+        out.append("-" * 60)
+    routed = _route_table(events)
+    if routed:
+        out.extend(routed)
         out.append("-" * 60)
     breakdown = _span_breakdown(events)
     if breakdown:
@@ -518,7 +591,9 @@ def summarize(events, bad=0) -> str:
         "failure(s), "
         f"{counts.get('slo_breach', 0)} SLO breach(es), "
         f"{counts.get('serve_rejected', 0)} serve rejection(s), "
-        f"{counts.get('serve_request_requeued', 0)} serve requeue(s)"
+        f"{counts.get('serve_request_requeued', 0)} serve requeue(s), "
+        f"{counts.get('serve_spill', 0)} fleet spill(s), "
+        f"{counts.get('serve_tenant_throttled', 0)} tenant throttle(s)"
     )
     return "\n".join(out)
 

@@ -49,7 +49,8 @@ import): the plumbing/determinism proof. Simulated verdicts are
 persisted flagged ``simulated`` and NEVER gate.
 
 ``--serve SOCKET`` (``default`` = the ``TPK_SERVE_SOCKET``/serve-dir
-resolution) drives the kernel-serving daemon (docs/SERVING.md)
+resolution) drives the kernel-serving daemon (docs/SERVING.md) — or
+a fleet's front-end router, which speaks the same protocol —
 instead of in-process ``registry.dispatch`` — the same schedule, the
 same completion-minus-SCHEDULED-arrival latency arithmetic, so the
 SLO verdicts judge the real service path end to end: queueing,
@@ -57,8 +58,15 @@ bucketing/padding, batching windows and backpressure all land in the
 tail. This client process never imports jax (device_kind and jax
 version come from the daemon's ping). An admission-control rejection
 is retried after the daemon's ``retry_after_s`` hint — the retries'
-wait counts in the request's latency — and dropped loudly
-(``slo.dropped.<kernel>``) after 10 rejections.
+wait counts in the request's latency, and each retry's sleep is
+jittered 0.5x-1.5x by a stream seeded off the run seed so
+synchronized clients don't re-stampede a recovering daemon — and
+dropped loudly (``slo.dropped.<kernel>``) after 10 rejections.
+``--tenant NAME`` / ``--priority interactive|batch`` (serve-only)
+ride every request header for the fleet router's per-tenant
+admission point (docs/SERVING.md §fleet); a tenant run's series
+record as ``<kernel>@<tenant>`` so its p99 verdicts earn their own
+``slo.json`` rows under the unchanged gating contract.
 
 This process defaults ``TPK_INTEGRITY=tripwire`` (an explicit env
 choice wins): the sampled oracle canary checks would inject periodic
@@ -279,16 +287,32 @@ def run_real(schedule, shape_class: str, echo) -> None:
         obs_metrics.observe(f"slo.service_s.{kernel}", s1 - s0)
 
 
-def run_serve(schedule, shape_class: str, socket_path: str, echo):
+def run_serve(schedule, shape_class: str, socket_path: str, echo,
+              seed: int = 0, tenant=None, priority=None):
     """Drive the serving daemon through the schedule, open-loop — the
     ``run_real`` arithmetic with the daemon in place of
     ``registry.dispatch``. Latency stays completion minus SCHEDULED
     arrival, so daemon queueing, batching windows and backpressure
     retries all count; one untimed dispatch per (kernel, shapes)
-    warms the daemon's executable memo first. Returns the daemon's
-    ping stats (device_kind, jax version) for the verdict record."""
+    warms the daemon's executable memo first. Backpressure retries
+    are jittered by a stream seeded off the run seed (0.5x-1.5x the
+    hint — docs/SERVING.md §backpressure): N loadgen clients rejected
+    together must not sleep identical hints and re-stampede a
+    recovering daemon, and seeding keeps the run reproducible.
+    ``tenant``/``priority`` ride every request header (the fleet
+    router's admission point) and a tenant's series record under
+    ``<kernel>@<tenant>`` so its verdicts earn their own slo.json
+    rows. Returns the daemon's ping stats (device_kind, jax version)
+    for the verdict record."""
+    import random as random_mod
+
     from tpukernels.serve import client as serve_client
     from tpukernels.serve import protocol as serve_protocol
+
+    jitter = random_mod.Random(seed ^ 0x7E57ED)
+
+    def _mk(kernel):
+        return f"{kernel}@{tenant}" if tenant else kernel
 
     def dispatch_patiently(cli, kernel, args, statics) -> bool:
         """One request, honoring backpressure (the shared
@@ -300,26 +324,27 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo):
         remaining schedule or discard the samples already recorded."""
         try:
             serve_client.dispatch_with_backpressure(
-                cli, kernel, args, statics
+                cli, kernel, args, statics, jitter=jitter
             )
             return True
         except serve_client.ServeRejected:
-            obs_metrics.inc(f"slo.dropped.{kernel}")
+            obs_metrics.inc(f"slo.dropped.{_mk(kernel)}")
             print(f"# dropped {kernel} request after "
                   "10 rejection(s)", file=sys.stderr)
             return False
         except serve_client.ServeError as e:
-            obs_metrics.inc(f"slo.dropped.{kernel}")
+            obs_metrics.inc(f"slo.dropped.{_mk(kernel)}")
             print(f"# dropped {kernel} request: daemon error "
                   f"{e}", file=sys.stderr)
             return False
         except (OSError, serve_protocol.ProtocolError) as e:
-            obs_metrics.inc(f"slo.dropped.{kernel}")
+            obs_metrics.inc(f"slo.dropped.{_mk(kernel)}")
             print(f"# dropped {kernel} request: transport trouble "
                   f"{e!r}", file=sys.stderr)
             return False
 
-    cli = serve_client.ServeClient(socket_path)
+    cli = serve_client.ServeClient(socket_path, tenant=tenant,
+                                   priority=priority)
     stats = cli.ping()  # reachability gate: a dead socket aborts HERE
     prepared = {}
     for kernel in sorted({k for _t, k in schedule}):
@@ -338,9 +363,11 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo):
         s0 = time.perf_counter()
         if dispatch_patiently(cli, kernel, args, statics):
             s1 = time.perf_counter()
-            obs_metrics.inc(f"slo.requests.{kernel}")
-            obs_metrics.observe(f"slo.latency_s.{kernel}", (s1 - t0) - t)
-            obs_metrics.observe(f"slo.service_s.{kernel}", s1 - s0)
+            obs_metrics.inc(f"slo.requests.{_mk(kernel)}")
+            obs_metrics.observe(f"slo.latency_s.{_mk(kernel)}",
+                                (s1 - t0) - t)
+            obs_metrics.observe(f"slo.service_s.{_mk(kernel)}",
+                                s1 - s0)
     # re-ping AFTER the dispatches: the daemon resolves device_kind /
     # jax lazily on its first dispatch, and the verdict record should
     # carry them when available — but a daemon that died at the very
@@ -382,6 +409,7 @@ def main(argv=None):
     kernel = mix_raw = None
     arrivals, rate, requests = "poisson", DEFAULT_RATE, 200
     duration = simulate_ms = serve_sock = None
+    tenant = priority = None
     seed = None
     shape_class, period = "probe", 60.0
     print_schedule = check = False
@@ -392,6 +420,10 @@ def main(argv=None):
                 kernel = next(it)
             elif a == "--serve":
                 serve_sock = next(it)
+            elif a == "--tenant":
+                tenant = next(it)
+            elif a == "--priority":
+                priority = next(it)
             elif a == "--mix":
                 mix_raw = next(it)
             elif a == "--arrivals":
@@ -437,6 +469,22 @@ def main(argv=None):
     if serve_sock is not None and simulate_ms is not None:
         print("loadgen: --serve and --simulate are exclusive (the "
               "virtual clock has no daemon)", file=sys.stderr)
+        return 2
+    if (tenant or priority) and serve_sock is None:
+        print("loadgen: --tenant/--priority only apply to --serve "
+              "runs (the router's admission point reads them)",
+              file=sys.stderr)
+        return 2
+    if tenant is not None and ("@" in tenant or "|" in tenant
+                               or not tenant):
+        print(f"loadgen: bad --tenant {tenant!r} (non-empty, no '@' "
+              "or '|' - it joins metric and slo.json keys)",
+              file=sys.stderr)
+        return 2
+    if priority is not None and priority not in ("interactive",
+                                                 "batch"):
+        print(f"loadgen: --priority {priority!r} (known: "
+              "interactive, batch)", file=sys.stderr)
         return 2
     if serve_sock == "default":
         from tpukernels.serve import client as _serve_client
@@ -485,7 +533,9 @@ def main(argv=None):
 
             try:
                 serve_stats = run_serve(schedule, shape_class,
-                                        serve_sock, echo)
+                                        serve_sock, echo, seed=seed,
+                                        tenant=tenant,
+                                        priority=priority)
             except (OSError, serve_protocol.ProtocolError) as e:
                 print(f"loadgen: serve daemon at {serve_sock} "
                       f"unreachable: {e}", file=sys.stderr)
@@ -520,6 +570,9 @@ def main(argv=None):
         "wall_s": round(wall, 3),
         "served": serve_sock is not None,
     }
+    if tenant:
+        run_info["tenant"] = tenant
+        run_info["priority"] = priority or "interactive"
     artifact = slo.record(verdicts, run_info, jax_version=jax_version)
     journal.emit(
         "slo_probe", **run_info, device_kind=kind,

@@ -145,6 +145,53 @@ fi
       after=("prewarm_all",),
       inputs=("tpukernels/serve", "tools/loadgen.py",
               "tools/serve_ctl.py")),
+    # 0b''. fleet probe (docs/SERVING.md §fleet): 1 router + 2 worker
+    #       daemons, a 60 s skewed-TENANT burst (a hot bursty tenant
+    #       beside a steady one — the fairness scenario the router's
+    #       token buckets exist for) driven through the front socket,
+    #       one worker drained AND restored mid-burst (the rolling-
+    #       restart rehearsal: zero accepted requests may drop), then
+    #       a clean stop whatever the loadgen rcs so a failed burst
+    #       cannot leak a fleet into the next window. Non-gating
+    #       (obs_check picks a confirmed per-tenant breach up as rc 1
+    #       WARN); never stamped; after prewarm_all so the workers
+    #       open onto a warm manifest.
+    S("fleet_probe", """
+set -o pipefail
+fleet_log="docs/logs/fleet_probe_$(date +%Y-%m-%d_%H%M%S).log"
+fleet_probe_body() {
+  python tools/serve_ctl.py start-fleet 2 --wait 60 || return $?
+  front=$(python -c "from tpukernels.serve import fleet
+print(fleet.front_socket_path())")
+  timeout -k 10 100 python tools/loadgen.py --serve "$front" \\
+      --mix all --arrivals bursty --duration 60 --rate 10 \\
+      --requests 0 --shapes record --tenant hot &
+  lg_hot=$!
+  timeout -k 10 100 python tools/loadgen.py --serve "$front" \\
+      --mix all --arrivals poisson --duration 60 --rate 2 \\
+      --requests 0 --shapes record --tenant steady --seed 3 &
+  lg_steady=$!
+  sleep 20
+  python tools/serve_ctl.py drain 0 --wait 30; rc_drain=$?
+  python tools/serve_ctl.py undrain 0 --wait 30; rc_undrain=$?
+  wait $lg_hot; rc_hot=$?
+  wait $lg_steady; rc_steady=$?
+  python tools/serve_ctl.py stop-fleet
+  # the drain/undrain rcs are part of the verdict: a probe that never
+  # actually rehearsed the rolling restart must not report success
+  [ $rc_hot -eq 0 ] && [ $rc_steady -eq 0 ] && \
+    [ $rc_drain -eq 0 ] && [ $rc_undrain -eq 0 ]
+}
+if fleet_probe_body >"$fleet_log" 2>&1; then
+  tail -1 "$fleet_log"
+else
+  echo "WARN: fleet probe failed rc=$? (non-gating) - $fleet_log"
+  exit 1
+fi
+""", gating=False, stamp="never", timeout_s=300, cost_min=3, value=9,
+      after=("prewarm_all",),
+      inputs=("tpukernels/serve", "tools/loadgen.py",
+              "tools/serve_ctl.py")),
     # 0c. bus-bandwidth sweep (docs/OBSERVABILITY.md §scaling): the
     #     paper's multi-chip metric of record, captured as a
     #     structured scaling artifact + busbw_point journal events the

@@ -1,24 +1,40 @@
-"""Operator control of the kernel-serving daemon (docs/SERVING.md).
+"""Operator control of the kernel-serving daemon and fleet
+(docs/SERVING.md).
 
 Usage:
     python tools/serve_ctl.py start [--wait S] [--socket PATH]
     python tools/serve_ctl.py stop [--wait S]
     python tools/serve_ctl.py status
+    python tools/serve_ctl.py start-fleet N [--wait S]
+    python tools/serve_ctl.py stop-fleet [--wait S]
+    python tools/serve_ctl.py drain I [--wait S]
+    python tools/serve_ctl.py undrain I [--wait S]
 
-``start`` spawns ``python -m tpukernels.serve`` detached (its own
-session; stderr appended to ``serve_daemon.log`` beside the socket)
-and waits until the daemon answers a protocol ping. ``stop`` sends
-SIGTERM to the pid the flocked pidfile records and waits for the
-flock to release — the clean-shutdown path that emits ``serve_stop``.
-``status`` is the ``revalidate.py --whos-holding`` idea applied to
-the daemon: liveness is the FLOCK on the pidfile (a dead daemon's
-stale pid never reads as running), the recorded pid is the
-diagnosis, and a live daemon also answers a ping with its stats.
+Single daemon: ``start`` spawns ``python -m tpukernels.serve``
+detached and waits for a protocol ping; ``stop`` SIGTERMs the pid
+the flocked pidfile records and waits for the flock to release;
+``status`` tests the flock (a dead daemon's stale pid never reads as
+running) and prints the ping payload — queue depth, in-flight count
+and per-bucket memo ownership, not bare liveness.
 
-Exit codes: 0 — done (``status``: daemon is up); 1 — failed
-(``status``: daemon is down); 2 — usage error; 3 — ``start`` refused
-because a live daemon already holds the pidfile (the wrapper's
-"already covered" code).
+Fleet (docs/SERVING.md §fleet): ``start-fleet N`` spawns N worker
+daemons (each on its own socket/pidfile/log under the fleet dir,
+tagged ``TPK_SERVE_WORKER_ID``) plus the front-end router on
+``front.sock``, records the layout in ``fleet.json``, and waits for
+every member to answer a ping — point clients (``TPK_SERVE_SOCKET``,
+``loadgen --serve``) at the front socket. ``drain I`` tells the
+router to route worker I's buckets to their ring siblings, waits for
+its in-flight forwards to empty, then stops the worker — zero
+accepted requests drop (requests caught mid-stop fail over through
+the router's transport retry). ``undrain I`` restarts the worker if
+needed and restores it to the ring — together the supervisor-managed
+rolling restart. ``stop-fleet`` stops router then workers.
+``status`` detects a fleet (live router pidfile) and prints the
+router's routing totals plus one line per worker.
+
+Exit codes: 0 — done (``status``: up); 1 — failed (``status``:
+down); 2 — usage error; 3 — ``start``/``start-fleet`` refused
+because a live daemon/router already holds the pidfile.
 """
 
 from __future__ import annotations
@@ -34,16 +50,17 @@ sys.path.insert(0, _REPO)
 
 from tpukernels import _cachedir  # noqa: E402
 from tpukernels.serve import client as serve_client  # noqa: E402
+from tpukernels.serve import fleet as serve_fleet  # noqa: E402
 from tpukernels.serve import protocol as serve_protocol  # noqa: E402
 
 
-def _pidfile_state():
-    """(held, pid_or_None): held = a live daemon process flocks the
-    pidfile (the revalidate_lib convention — test the lock, never
-    trust the pid alone)."""
+def _pidfile_state(path=None):
+    """(held, pid_or_None): held = a live process flocks the pidfile
+    (the revalidate_lib convention — test the lock, never trust the
+    pid alone)."""
     import fcntl
 
-    path = _cachedir.serve_pidfile_path()
+    path = path or _cachedir.serve_pidfile_path()
     try:
         f = open(path)
     except OSError:
@@ -67,6 +84,41 @@ def _ping(socket_path):
             return cli.ping()
     except (OSError, serve_protocol.ProtocolError):
         return None
+
+
+def _control(socket_path, op, worker):
+    """One router control round trip ({"op": drain|undrain,
+    "worker": i}); returns the reply header or None on transport
+    trouble."""
+    import socket as socket_mod
+
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.settimeout(10)
+    try:
+        s.connect(socket_path)
+        serve_protocol.send_frame(
+            s, {"v": serve_protocol.VERSION, "op": op, "worker": worker}
+        )
+        frame = serve_protocol.recv_frame(s)
+    except (OSError, serve_protocol.ProtocolError):
+        return None
+    finally:
+        try:
+            s.close()
+        except OSError:
+            pass
+    return frame[0] if frame else None
+
+
+def _stats_line(stats) -> str:
+    buckets = stats.get("buckets") or []
+    return (f"served={stats.get('served')} "
+            f"rejected={stats.get('rejected')} "
+            f"requeued={stats.get('requeued')} "
+            f"depth={stats.get('depth')}/{stats.get('queue_max')} "
+            f"inflight={stats.get('inflight')} "
+            f"buckets={len(buckets)}"
+            + (f" [{', '.join(buckets)}]" if buckets else ""))
 
 
 def start(wait_s: float, socket_path) -> int:
@@ -106,15 +158,16 @@ def start(wait_s: float, socket_path) -> int:
     return 1
 
 
-def stop(wait_s: float) -> int:
-    held, pid = _pidfile_state()
+def _stop_pidfile(pidfile, what, wait_s: float) -> int:
+    held, pid = _pidfile_state(pidfile)
     if not held:
-        print("serve_ctl: no daemon running"
+        print(f"serve_ctl: no {what} running"
               + (f" (stale pid {pid} in pidfile)" if pid else ""))
         return 0
     if pid is None:
-        print("serve_ctl: pidfile flocked but records no pid - "
-              "inspect by hand (fuser on the socket)", file=sys.stderr)
+        print(f"serve_ctl: {what} pidfile flocked but records no pid "
+              "- inspect by hand (fuser on the socket)",
+              file=sys.stderr)
         return 1
     try:
         os.kill(pid, signal.SIGTERM)
@@ -124,18 +177,199 @@ def stop(wait_s: float) -> int:
         return 1
     deadline = time.monotonic() + wait_s
     while time.monotonic() < deadline:
-        held, _pid = _pidfile_state()
+        held, _pid = _pidfile_state(pidfile)
         if not held:
-            print(f"serve_ctl: daemon (pid {pid}) stopped")
+            print(f"serve_ctl: {what} (pid {pid}) stopped")
             return 0
         time.sleep(0.2)
-    print(f"serve_ctl: daemon (pid {pid}) still holds the pidfile "
+    print(f"serve_ctl: {what} (pid {pid}) still holds the pidfile "
           f"after {wait_s}s - escalate by hand if it is truly wedged",
           file=sys.stderr)
     return 1
 
 
+def stop(wait_s: float) -> int:
+    return _stop_pidfile(_cachedir.serve_pidfile_path(), "daemon",
+                         wait_s)
+
+
+# ------------------------------------------------------------------ #
+# fleet verbs                                                        #
+# ------------------------------------------------------------------ #
+
+def start_fleet(n: int, wait_s: float) -> int:
+    held, pid = _pidfile_state(serve_fleet.router_pidfile_path())
+    if held:
+        print(f"serve_ctl: fleet router already running (pid {pid}) "
+              "- stop-fleet first")
+        return 3
+    front = serve_fleet.front_socket_path()
+    procs, socks = [], []
+    try:
+        for i in range(n):
+            proc, sock = serve_fleet.spawn_worker(i, _REPO)
+            procs.append((f"worker{i}", proc))
+            socks.append(sock)
+        router = serve_fleet.spawn_router(front, socks, _REPO)
+        procs.append(("router", router))
+        serve_fleet.write_config(front, socks)
+    except OSError as e:
+        # a mid-loop spawn failure (full disk, unwritable fleet dir)
+        # must not leak the members already running detached
+        print(f"serve_ctl: cannot spawn the fleet: {e}",
+              file=sys.stderr)
+        _abort_fleet(procs)
+        return 1
+    deadline = time.monotonic() + wait_s
+    pending = [("router", front)] + [
+        (f"worker{i}", s) for i, s in enumerate(socks)
+    ]
+    while pending and time.monotonic() < deadline:
+        for name, proc in procs:
+            if proc.poll() is not None:
+                print(f"serve_ctl: {name} exited "
+                      f"rc={proc.returncode} before answering - see "
+                      f"its log under {serve_fleet.fleet_dir()}",
+                      file=sys.stderr)
+                _abort_fleet(procs)
+                return 1
+        pending = [(name, s) for name, s in pending
+                   if _ping(s) is None]
+        if pending:
+            time.sleep(0.2)
+    if pending:
+        print(f"serve_ctl: {', '.join(n for n, _s in pending)} did "
+              f"not answer within {wait_s}s - stopping the fleet",
+              file=sys.stderr)
+        _abort_fleet(procs)
+        return 1
+    print(f"serve_ctl: fleet up - router on {front}, "
+          f"{n} worker(s)")
+    return 0
+
+
+def _reap(procs):
+    for _name, proc in procs:
+        if proc.poll() is None:
+            proc.terminate()
+    for _name, proc in procs:
+        try:
+            proc.wait(10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _abort_fleet(procs):
+    """Failed start: kill what came up AND drop fleet.json — a stale
+    config of record would make a later drain/undrain respawn orphan
+    workers for a fleet whose router never answered."""
+    _reap(procs)
+    try:
+        os.unlink(serve_fleet.config_path())
+    except OSError:
+        pass
+
+
+def stop_fleet(wait_s: float) -> int:
+    cfg = serve_fleet.load_config()
+    rc = _stop_pidfile(serve_fleet.router_pidfile_path(), "router",
+                       wait_s)
+    workers = (cfg or {}).get("workers") or []
+    for i, _sock in enumerate(workers):
+        wrc = _stop_pidfile(
+            os.path.join(serve_fleet.worker_dir(i), "serve.pid"),
+            f"worker{i}", wait_s,
+        )
+        rc = rc or wrc
+    if rc == 0:
+        # the config of record outlives a FAILED stop on purpose: a
+        # wedged member that survived --wait must stay addressable by
+        # a retry ('stop-fleet' / 'drain I'), not become an orphan
+        # the ctl can no longer name
+        try:
+            os.unlink(serve_fleet.config_path())
+        except OSError:
+            pass
+    return rc
+
+
+def drain(idx: int, wait_s: float) -> int:
+    cfg = serve_fleet.load_config()
+    if not cfg:
+        print("serve_ctl: no fleet.json - is a fleet running?",
+              file=sys.stderr)
+        return 1
+    front = cfg["front"]
+    reply = _control(front, "drain", idx)
+    if not reply or not reply.get("ok"):
+        print(f"serve_ctl: drain refused: "
+              f"{(reply or {}).get('error') or 'router unreachable'}",
+              file=sys.stderr)
+        return 1
+    # wait for the router's in-flight forwards to that worker to
+    # empty, then stop it; a forward still stuck past the wait (a
+    # wedge) is rescued by the router's transport failover when the
+    # worker dies — zero accepted requests drop either way
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        stats = _ping(front)
+        rows = (stats or {}).get("workers") or []
+        if idx < len(rows) and rows[idx].get("inflight") == 0:
+            break
+        time.sleep(0.2)
+    rc = _stop_pidfile(
+        os.path.join(serve_fleet.worker_dir(idx), "serve.pid"),
+        f"worker{idx}", wait_s,
+    )
+    print(f"serve_ctl: worker {idx} drained - its buckets now route "
+          "to their ring siblings")
+    return rc
+
+
+def undrain(idx: int, wait_s: float) -> int:
+    cfg = serve_fleet.load_config()
+    if not cfg:
+        print("serve_ctl: no fleet.json - is a fleet running?",
+              file=sys.stderr)
+        return 1
+    front = cfg["front"]
+    if not 0 <= idx < len(cfg["workers"]):
+        # validate BEFORE spawning: a daemon for an index outside the
+        # fleet would be an orphan stop-fleet can never reach
+        print(f"serve_ctl: worker index {idx} out of range "
+              f"(fleet has {len(cfg['workers'])})", file=sys.stderr)
+        return 2
+    pidfile = os.path.join(serve_fleet.worker_dir(idx), "serve.pid")
+    held, _pid = _pidfile_state(pidfile)
+    if not held:
+        proc, sock = serve_fleet.spawn_worker(idx, _REPO)
+        deadline = time.monotonic() + wait_s
+        while _ping(sock) is None:
+            if proc.poll() is not None:
+                print(f"serve_ctl: worker{idx} exited "
+                      f"rc={proc.returncode} before answering",
+                      file=sys.stderr)
+                return 1
+            if time.monotonic() > deadline:
+                print(f"serve_ctl: worker{idx} did not answer within "
+                      f"{wait_s}s", file=sys.stderr)
+                proc.terminate()
+                return 1
+            time.sleep(0.2)
+    reply = _control(front, "undrain", idx)
+    if not reply or not reply.get("ok"):
+        print(f"serve_ctl: undrain refused: "
+              f"{(reply or {}).get('error') or 'router unreachable'}",
+              file=sys.stderr)
+        return 1
+    print(f"serve_ctl: worker {idx} restored to the ring")
+    return 0
+
+
 def status(socket_path=None) -> int:
+    held, pid = _pidfile_state(serve_fleet.router_pidfile_path())
+    if held:
+        return _fleet_status()
     held, pid = _pidfile_state()
     if not held:
         print("serve_ctl: daemon DOWN"
@@ -146,24 +380,65 @@ def status(socket_path=None) -> int:
         print(f"serve_ctl: pid {pid} holds the pidfile but the "
               "socket does not answer - starting up, or wedged")
         return 1
-    print(
-        f"serve_ctl: daemon UP (pid {stats.get('pid')}) - "
-        f"served={stats.get('served')} rejected={stats.get('rejected')}"
-        f" requeued={stats.get('requeued')} depth={stats.get('depth')}"
-        f"/{stats.get('queue_max')} device={stats.get('device_kind')}"
-        f" uptime={stats.get('uptime_s')}s"
-    )
+    print(f"serve_ctl: daemon UP (pid {stats.get('pid')}) - "
+          + _stats_line(stats)
+          + f" device={stats.get('device_kind')}"
+          f" uptime={stats.get('uptime_s')}s")
     return 0
+
+
+def _fleet_status() -> int:
+    cfg = serve_fleet.load_config() or {}
+    front = cfg.get("front") or serve_fleet.front_socket_path()
+    stats = _ping(front)
+    if stats is None:
+        print("serve_ctl: router holds its pidfile but the front "
+              "socket does not answer - starting up, or wedged")
+        return 1
+    print(f"serve_ctl: fleet UP - router pid {stats.get('pid')}, "
+          f"routed={stats.get('routed')} spilled={stats.get('spilled')}"
+          f" throttled={stats.get('throttled')} "
+          f"device={stats.get('device_kind')} "
+          f"uptime={stats.get('uptime_s')}s")
+    rows = stats.get("workers") or []
+    rc = 0
+    for i, row in enumerate(rows):
+        wstats = _ping(row.get("socket"))
+        state = ("DRAINING" if row.get("draining")
+                 else "cooling" if row.get("cooling") else "up")
+        if wstats is None:
+            print(f"  worker{i}: DOWN ({state}; "
+                  f"routed={row.get('routed')})")
+            if not row.get("draining"):
+                rc = 1
+            continue
+        print(f"  worker{i}: {state} pid {wstats.get('pid')} "
+              f"routed={row.get('routed')} "
+              f"inflight_router={row.get('inflight')} - "
+              + _stats_line(wstats))
+    return rc
 
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
-    if not argv or argv[0] not in ("start", "stop", "status"):
+    verbs = ("start", "stop", "status", "start-fleet", "stop-fleet",
+             "drain", "undrain")
+    if not argv or argv[0] not in verbs:
         print(__doc__, file=sys.stderr)
         return 2
     cmd = argv[0]
+    rest = argv[1:]
+    count = None
+    if cmd in ("start-fleet", "drain", "undrain"):
+        if not rest or not rest[0].isdigit():
+            print(__doc__, file=sys.stderr)
+            print(f"serve_ctl: {cmd} needs a count/index",
+                  file=sys.stderr)
+            return 2
+        count = int(rest[0])
+        rest = rest[1:]
     wait_s, socket_path = 30.0, None
-    it = iter(argv[1:])
+    it = iter(rest)
     try:
         for a in it:
             if a == "--wait":
@@ -182,6 +457,18 @@ def main(argv=None):
         return start(wait_s, socket_path)
     if cmd == "stop":
         return stop(wait_s)
+    if cmd == "start-fleet":
+        if count < 1:
+            print("serve_ctl: start-fleet needs N >= 1",
+                  file=sys.stderr)
+            return 2
+        return start_fleet(count, wait_s)
+    if cmd == "stop-fleet":
+        return stop_fleet(wait_s)
+    if cmd == "drain":
+        return drain(count, wait_s)
+    if cmd == "undrain":
+        return undrain(count, wait_s)
     return status(socket_path)
 
 

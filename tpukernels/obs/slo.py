@@ -135,14 +135,26 @@ def min_requests() -> int:
     return val
 
 
+def base_kernel(kernel: str) -> str:
+    """Strip a ``@tenant`` suffix off a per-tenant series name
+    (``loadgen --tenant hot`` records ``scan@hot`` histograms so a
+    fleet's per-tenant tails earn their own ``slo.json`` rows —
+    docs/SERVING.md §fleet). Targets and kernel sources always
+    resolve against the base kernel; the verdict keyspace keeps the
+    tenant, so one tenant's breach never masks (or clears)
+    another's."""
+    return kernel.split("@", 1)[0]
+
+
 def resolve_target_s(kernel: str, kind: str, shape_class: str):
     """(target_seconds, basis) for one kernel on one device kind and
     shape class, or (None, reason) when no row applies. Resolution
     mirrors ``roofline.resolve_kind``: an exact ``kind|class`` row
     wins; an unknown TPU kind borrows the v5-lite row (basis flagged
     ``assumed-...``); anything else falls back to the cpu row for the
-    same shape class. The ``TPK_SLO_SCALE`` multiplier applies last."""
-    rows = TARGETS.get(kernel)
+    same shape class. A ``kernel@tenant`` series resolves the base
+    kernel's row. The ``TPK_SLO_SCALE`` multiplier applies last."""
+    rows = TARGETS.get(base_kernel(kernel))
     if not rows:
         return None, "no-target-row"
     key = f"{kind}|{shape_class}"
@@ -256,7 +268,7 @@ def entry_key(kernel: str, shape_class: str, kind: str,
 def _sources(kernel: str):
     from tpukernels import aot
 
-    return aot.KERNEL_SOURCES.get(kernel, ())
+    return aot.KERNEL_SOURCES.get(base_kernel(kernel), ())
 
 
 def record(verdicts: dict, run_info: dict | None = None,

@@ -6,8 +6,12 @@ The batch suite's serving half: a Unix-domain-socket daemon
 ``registry.dispatch`` — the compiled-executable memo, fault point and
 integrity guard the batch paths already trust — plus the wire
 protocol (``protocol.py``), shape bucketing onto the AOT avatars
-(``bucketing.py``) and the jax-free client (``client.py``) that
-``capi.run_from_c`` and ``tools/loadgen.py --serve`` use.
+(``bucketing.py``), the jax-free client (``client.py``) that
+``capi.run_from_c`` and ``tools/loadgen.py --serve`` use, and the
+scale-out fleet (``router.py``/``fleet.py``, §fleet): a front-end
+router that consistently hashes each (kernel, bucket) onto one of N
+worker daemons with deterministic spill, live drain and per-tenant
+token-bucket fairness.
 
 Stdlib + numpy at import time; jax loads inside the daemon's dispatch
 path only.

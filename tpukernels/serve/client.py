@@ -53,7 +53,8 @@ def default_socket_path() -> str:
 
 
 def dispatch_with_backpressure(cli, kernel, args, statics,
-                               max_rejections: int = 10):
+                               max_rejections: int = 10,
+                               jitter=None):
     """``cli.dispatch`` honoring admission control: a
     :class:`ServeRejected` is retried after the daemon's
     ``retry_after_s`` hint, up to ``max_rejections`` times, then
@@ -61,7 +62,15 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
     (``capi._dispatch``, ``loadgen.run_serve``) share; only the
     give-up action differs, so it stays with the caller. Transport
     errors and daemon-reported :class:`ServeError` propagate
-    untouched."""
+    untouched.
+
+    ``jitter`` (a ``random.Random``, deterministically seeded by the
+    caller) decorrelates the retries: the raw hint is scaled by a
+    uniform 0.5x-1.5x draw per retry, so a burst of clients rejected
+    together does not sleep the same hint and re-stampede a
+    recovering daemon in lockstep (the thundering-herd fix — seeded,
+    so a loadgen run's schedule stays byte-reproducible). ``None``
+    keeps the raw hint."""
     tries = 0
     while True:
         try:
@@ -70,7 +79,10 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
             tries += 1
             if tries >= max_rejections:
                 raise
-            time.sleep(e.retry_after_s)
+            wait = e.retry_after_s
+            if jitter is not None:
+                wait *= 0.5 + jitter.random()
+            time.sleep(wait)
 
 
 class ServeClient:
@@ -79,9 +91,17 @@ class ServeClient:
     after transport errors; not thread-safe — give each client thread
     its own instance."""
 
-    def __init__(self, socket_path=None, timeout_s=None):
+    def __init__(self, socket_path=None, timeout_s=None,
+                 tenant=None, priority=None):
+        # tenant/priority ride every dispatch header: the fleet
+        # router's admission point (per-tenant token buckets,
+        # priority classes — docs/SERVING.md §fleet) reads them; the
+        # single daemon carries tenant through to its journal
+        # evidence and ignores priority
         self.socket_path = socket_path or default_socket_path()
         self.timeout_s = timeout_s
+        self.tenant = tenant
+        self.priority = priority
         self._sock = None
         self._rid = 0
 
@@ -152,11 +172,14 @@ class ServeClient:
         arrays = [np.asarray(a) for a in args]
         specs, payloads = protocol.pack_arrays(arrays)
         self._rid += 1
-        header, out_payloads = self._roundtrip(
-            {"v": protocol.VERSION, "op": "dispatch", "id": self._rid,
-             "kernel": kernel, "statics": statics, "args": specs},
-            payloads,
-        )
+        req = {"v": protocol.VERSION, "op": "dispatch",
+               "id": self._rid, "kernel": kernel, "statics": statics,
+               "args": specs}
+        if self.tenant is not None:
+            req["tenant"] = self.tenant
+        if self.priority is not None:
+            req["priority"] = self.priority
+        header, out_payloads = self._roundtrip(req, payloads)
         if not header.get("ok"):
             msg = header.get("error") or "daemon error"
             if header.get("kind") == "overloaded":

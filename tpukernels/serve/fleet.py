@@ -1,0 +1,157 @@
+"""Fleet layout + process lifecycle for the sharded serving fleet
+(docs/SERVING.md §fleet).
+
+One fleet = one front-end router (``tpukernels/serve/router.py``) +
+N worker daemons (each a plain ``python -m tpukernels.serve`` on its
+own socket, pidfile and log). This module owns where all of that
+lives on disk and how the processes are spawned — ``tools/
+serve_ctl.py``'s fleet verbs (``start-fleet``/``stop-fleet``/
+``drain``/``undrain``/``status``) are thin over it.
+
+Layout (under ``fleet_dir()``, default ``<serve_dir>/fleet``;
+``TPK_SERVE_FLEET_DIR`` redirects — tests isolate it via the
+already-isolated ``TPK_SERVE_DIR``):
+
+    fleet.json          # config of record: front socket + workers
+    front.sock          # the router's socket — point clients here
+    router.pid          # router's flocked pidfile (revalidate_lib
+                        # convention, like the worker daemons')
+    router.log          # router stderr
+    worker0/            # worker 0's TPK_SERVE_DIR: socket, pidfile,
+    worker1/            # daemon log — the PR-10 single-daemon layout,
+    ...                 # one instance per worker
+
+Each worker is spawned with ``TPK_SERVE_WORKER_ID=<i>`` in its
+environment — the hook ``TPK_FAULT_PLAN`` ``env`` clauses use to
+fault ONE worker of a fleet (the wedged-worker failover chaos proof)
+and the tag its daemon log lines carry. ``TPK_SERVE_SOCKET`` is
+scrubbed from worker/router children: it is the CLIENT routing
+switch, and a fleet member resolving its own socket through it would
+dispatch into itself (or worse, into a different fleet).
+
+Stdlib-only at import, like the rest of the serve package's server
+side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from tpukernels import _cachedir
+
+
+def fleet_dir(env=None) -> str:
+    """``TPK_SERVE_FLEET_DIR`` when set, else ``fleet/`` under the
+    serve dir (same read-the-env-per-call rule as every _cachedir
+    path)."""
+    target = os.environ if env is None else env
+    d = target.get("TPK_SERVE_FLEET_DIR")
+    if d:
+        return d
+    return os.path.join(_cachedir.serve_dir(env), "fleet")
+
+
+def config_path(env=None) -> str:
+    return os.path.join(fleet_dir(env), "fleet.json")
+
+
+def front_socket_path(env=None) -> str:
+    return os.path.join(fleet_dir(env), "front.sock")
+
+
+def router_pidfile_path(env=None) -> str:
+    return os.path.join(fleet_dir(env), "router.pid")
+
+
+def worker_dir(i: int, env=None) -> str:
+    return os.path.join(fleet_dir(env), f"worker{i}")
+
+
+def worker_socket_path(i: int, env=None) -> str:
+    return os.path.join(worker_dir(i, env), "serve.sock")
+
+
+def load_config():
+    """The fleet.json config of record, or None when no fleet was
+    started here. Tolerant read: a corrupt file reads as no fleet
+    (start-fleet rewrites it)."""
+    try:
+        with open(config_path()) as f:
+            cfg = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(cfg, dict) or not cfg.get("workers"):
+        return None
+    return cfg
+
+
+def write_config(front: str, workers) -> dict:
+    cfg = {
+        "front": front,
+        "workers": list(workers),
+        "written": round(time.time(), 3),
+        "pid": os.getpid(),
+    }
+    d = fleet_dir()
+    os.makedirs(d, exist_ok=True)
+    tmp = config_path() + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(cfg, f, indent=1, sort_keys=True)
+    os.replace(tmp, config_path())
+    return cfg
+
+
+def _child_env(extra=None) -> dict:
+    """A fleet child's environment: the operator's env minus the
+    client routing switch (module docstring), plus overrides."""
+    env = dict(os.environ)
+    env.pop("TPK_SERVE_SOCKET", None)
+    env.update(extra or {})
+    return env
+
+
+def spawn_worker(i: int, repo: str):
+    """Spawn worker ``i`` detached (own session, stderr appended to
+    its daemon log), on its own socket/dir. Returns (proc,
+    socket_path)."""
+    d = worker_dir(i)
+    os.makedirs(d, exist_ok=True)
+    sock = worker_socket_path(i)
+    log = open(os.path.join(d, "serve_daemon.log"), "a")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpukernels.serve",
+             "--socket", sock],
+            cwd=repo, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=log,
+            env=_child_env({"TPK_SERVE_DIR": d,
+                            "TPK_SERVE_WORKER_ID": str(i)}),
+        )
+    finally:
+        log.close()
+    return proc, sock
+
+
+def spawn_router(front: str, worker_sockets, repo: str):
+    """Spawn the router detached on the front socket over the given
+    worker sockets. Returns the Popen."""
+    d = fleet_dir()
+    os.makedirs(d, exist_ok=True)
+    log = open(os.path.join(d, "router.log"), "a")
+    argv = [sys.executable, "-m", "tpukernels.serve.router",
+            "--socket", front]
+    for w in worker_sockets:
+        argv += ["--worker", w]
+    try:
+        proc = subprocess.Popen(
+            argv, cwd=repo, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=log,
+            env=_child_env(),
+        )
+    finally:
+        log.close()
+    return proc

@@ -1,0 +1,673 @@
+"""Front-end router for the sharded serving fleet (docs/SERVING.md
+§fleet).
+
+PR 10's daemon is one process: a single wedged bucket or one hot
+client caps the whole service. This module is the scale-out front
+end: a daemon that accepts the SAME ``protocol.py`` framing on one
+front socket and forwards every dispatch to one of N worker daemons
+(each a plain ``python -m tpukernels.serve`` process on its own
+socket). Because the router is protocol-compatible with the single
+daemon, every existing client — ``ServeClient``, ``capi.run_from_c``
+via ``TPK_SERVE_SOCKET``, ``loadgen --serve`` — talks to a fleet by
+pointing at the front socket, unchanged.
+
+The routing disciplines, each CPU-chaos-proven (tests/test_fleet.py):
+
+- **Consistent bucket routing** — each request is hashed by its
+  (kernel, bucket) key (``bucketing.bucket_id``, the same key the
+  worker's batch/lock layer uses) with a deterministic md5 ring, so
+  every request for one bucket lands on ONE worker: that worker's
+  executable memo owns the bucket and the PR-10 one-compile assertion
+  holds across the whole fleet (test-asserted from ``aot_hit``/
+  ``aot_miss`` journal evidence).
+- **Spill on backpressure** — a worker's admission-control rejection
+  (``retry_after_s``) is NOT bounced to the client: the router
+  forwards the request to the bucket's deterministic ring sibling
+  instead (``serve_spill``, reason ``overloaded``). At most two
+  workers ever compile one bucket — the primary and its fixed
+  sibling — so spill trades one extra compile for absorbed bursts,
+  never a fleet-wide compile storm. Only when the sibling also
+  rejects does the client see ``retry_after_s``.
+- **Failover on transport loss / wedge** — a worker that dies
+  mid-request (the drain-stop window) or answers ``kind: "wedged"``
+  (its own watchdog gave up twice) triggers the same deterministic
+  spill (reasons ``transport`` / ``wedged``); a wedge additionally
+  puts the worker on a routing cooldown (``TPK_ROUTE_COOLDOWN_S``)
+  so its buckets fail over FIRST instead of re-feeding the wedge.
+  Kernels are pure functions of their operands, which is what makes
+  re-dispatching an accepted request on a sibling safe.
+- **Live drain** — the ``{"op": "drain", "worker": i}`` control op
+  (sent by ``serve_ctl drain``) removes a worker from the ring for
+  NEW requests; its buckets deterministically fail over to the ring
+  sibling while in-flight forwards finish (or, if the worker is
+  stopped with forwards still in flight, the transport failover
+  re-queues them through the router — PR 10's requeue path
+  generalized across processes). ``undrain`` restores it. Zero
+  accepted requests drop across a drain + supervisor-managed restart
+  (test-asserted).
+- **Per-tenant fairness** — admission at the router runs a token
+  bucket per ``tenant`` (header field; ``TPK_ROUTE_TENANT_RATE``
+  tokens/s up to ``TPK_ROUTE_TENANT_BURST``, 0 = quotas off). A
+  tenant over quota is answered ``kind: "overloaded"`` with a
+  refill-derived ``retry_after_s`` (``serve_tenant_throttled``) so
+  one hot client backs off while the rest of the fleet's capacity
+  stays available. Priority classes ride the same bucket: a
+  ``"batch"`` request is only admitted while the tenant's bucket
+  retains a reserve (1 + burst/2 tokens) kept for ``"interactive"``
+  traffic, so background load yields first.
+
+The router is deliberately **jax-free** (stdlib + numpy + the
+bucket table): it computes bucket keys from the request header and
+relays operand payloads verbatim — no device, no compile, nothing to
+wedge. Clean-path stdout is EMPTY (notes to stderr, evidence to the
+journal), like the worker daemon.
+
+Run it: ``python -m tpukernels.serve.router --socket FRONT --worker
+W0.sock --worker W1.sock ...`` — or let ``tools/serve_ctl.py
+start-fleet N`` spawn workers + router together
+(``tpukernels/serve/fleet.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import sys
+import threading
+import time
+
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.resilience import journal
+from tpukernels.serve import bucketing, protocol
+
+from tpukernels.serve.server import (  # the daemon's shared fail-loud
+    DEFAULT_REQUEST_TIMEOUT_S,         # knob parser — one copy, not
+    _float_knob,                       # a drifted twin
+)
+
+DEFAULT_TENANT_RATE = 0.0     # tokens/s; 0 = per-tenant quotas OFF
+DEFAULT_TENANT_BURST = 8.0    # token-bucket capacity per tenant
+DEFAULT_COOLDOWN_S = 30.0     # wedged-worker routing cooldown
+
+PRIORITIES = ("interactive", "batch")
+
+# hint cap for throttle replies: at tiny refill rates the raw
+# (need - tokens) / rate hint could tell a client to sleep for
+# minutes — backpressure is a pacing signal, not a ban
+MAX_RETRY_HINT_S = 5.0
+
+
+def ring_order(bucket: str, n: int) -> list:
+    """Deterministic worker preference order for one bucket key over
+    an ``n``-worker fleet: md5 (stable across processes and runs —
+    python's own ``hash`` is salted) picks the primary, then the ring
+    walks forward. Index 0 is the bucket's home, index 1 its one
+    deterministic spill sibling — the whole sharding contract in four
+    lines, importable by tests and operators alike."""
+    h = int(hashlib.md5(bucket.encode()).hexdigest(), 16)
+    return [(h + k) % n for k in range(n)]
+
+
+class _Upstream:
+    """One worker's connection pool. Each pooled socket carries one
+    outstanding request at a time (the protocol's pipelining
+    contract); concurrent forwards to the same worker each take their
+    own connection."""
+
+    def __init__(self, path: str, timeout_s: float):
+        self.path = path
+        self.timeout_s = timeout_s
+        self._idle: list = []
+        self._lock = threading.Lock()
+
+    def acquire(self):
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout_s)
+        try:
+            s.connect(self.path)
+        except OSError:
+            s.close()
+            raise
+        return s
+
+    def release(self, sock, poisoned: bool):
+        if poisoned:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            self._idle.append(sock)
+
+    def close_all(self):
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for s in idle:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class _Conn:
+    """Front-socket connection + send lock (the server.py discipline:
+    frames from concurrent repliers must never interleave)."""
+
+    __slots__ = ("sock", "send_lock")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+
+    def send(self, header, payloads=()):
+        with self.send_lock:
+            protocol.send_frame(self.sock, header, payloads)
+
+
+class Router:
+    def __init__(self, socket_path: str, workers,
+                 tenant_rate=None, tenant_burst=None, cooldown_s=None):
+        if not workers:
+            raise ValueError("router needs at least one --worker socket")
+        self.socket_path = socket_path
+        self.workers = list(workers)
+        self.tenant_rate = (tenant_rate if tenant_rate is not None
+                            else _float_knob("TPK_ROUTE_TENANT_RATE",
+                                             DEFAULT_TENANT_RATE))
+        self.tenant_burst = (tenant_burst if tenant_burst is not None
+                             else _float_knob("TPK_ROUTE_TENANT_BURST",
+                                              DEFAULT_TENANT_BURST,
+                                              floor=1.0))
+        self.cooldown_s = (cooldown_s if cooldown_s is not None
+                           else _float_knob("TPK_ROUTE_COOLDOWN_S",
+                                            DEFAULT_COOLDOWN_S))
+        # upstream patience: the worker's own watchdog bounds a
+        # request (slow-grace + requeue-once + wedged-twice), so the
+        # router waits comfortably past that before calling transport
+        req_t = _float_knob("TPK_SERVE_REQUEST_TIMEOUT_S",
+                            DEFAULT_REQUEST_TIMEOUT_S, floor=0.1)
+        self._pools = [_Upstream(w, timeout_s=req_t * 8 + 30)
+                       for w in self.workers]
+        self._stop = threading.Event()
+        self._listener = None
+        self._lock = threading.Lock()
+        self._draining: set = set()          # worker indices
+        self._cooldown: dict = {}            # idx -> until (perf_counter)
+        self._inflight = [0] * len(self.workers)
+        self._routed_to = [0] * len(self.workers)
+        self._routed = 0
+        self._spilled = 0
+        self._throttled = 0
+        self._rejected = 0
+        self._tenants: dict = {}             # tenant -> [tokens, last]
+        self._meta = {"device_kind": None, "jax": None}
+        self._meta_next_try = 0.0            # unresolved-meta rate limit
+        self._t0 = time.time()
+        # fail-fast on a misconfigured bucket table, like the worker:
+        # the router and its workers MUST shard on the same table
+        bucketing.bucket_configs()
+
+    # -------------------------------------------------------------- #
+    # lifecycle                                                      #
+    # -------------------------------------------------------------- #
+
+    def serve_forever(self):
+        d = os.path.dirname(self.socket_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._listener = socket.socket(socket.AF_UNIX,
+                                       socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(128)
+        self._listener.settimeout(0.5)
+        journal.emit(
+            "serve_start", role="router", socket=self.socket_path,
+            workers=len(self.workers), worker_sockets=self.workers,
+            tenant_rate=self.tenant_rate,
+            tenant_burst=self.tenant_burst,
+        )
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _addr = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(
+                    target=self._client_loop, args=(_Conn(conn),),
+                    daemon=True, name="route-client",
+                ).start()
+        finally:
+            self.shutdown()
+
+    def stop(self, *_sig):
+        self._stop.set()
+
+    def shutdown(self):
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+            for pool in self._pools:
+                pool.close_all()
+            journal.emit(
+                "serve_stop", role="router", routed=self._routed,
+                spilled=self._spilled, throttled=self._throttled,
+                rejected=self._rejected,
+                uptime_s=round(time.time() - self._t0, 3),
+            )
+
+    # -------------------------------------------------------------- #
+    # front side                                                     #
+    # -------------------------------------------------------------- #
+
+    def _client_loop(self, conn: _Conn):
+        try:
+            while not self._stop.is_set():
+                frame = protocol.recv_frame(conn.sock)
+                if frame is None:
+                    return
+                header, payloads = frame
+                op = header.get("op")
+                if op == "ping":
+                    conn.send(self._stats())
+                elif op == "dispatch":
+                    self._route(conn, header, payloads)
+                elif op in ("drain", "undrain"):
+                    conn.send(self._control(op, header))
+                else:
+                    conn.send({"v": protocol.VERSION,
+                               "id": header.get("id"), "ok": False,
+                               "kind": "error",
+                               "error": f"unknown op {op!r}"})
+        except (protocol.ProtocolError, OSError):
+            pass  # poisoned/hung-up FRONT connection: only it dies
+        finally:
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def _stats(self) -> dict:
+        meta = self._worker_meta()
+        now = time.perf_counter()
+        with self._lock:
+            rows = [
+                {
+                    "socket": w,
+                    "draining": i in self._draining,
+                    "cooling": self._cooldown.get(i, 0.0) > now,
+                    "inflight": self._inflight[i],
+                    "routed": self._routed_to[i],
+                }
+                for i, w in enumerate(self.workers)
+            ]
+            return {
+                "op": "pong", "ok": True, "v": protocol.VERSION,
+                "role": "router", "pid": os.getpid(),
+                "workers": rows, "n_workers": len(self.workers),
+                "routed": self._routed, "spilled": self._spilled,
+                "throttled": self._throttled,
+                "rejected": self._rejected,
+                "uptime_s": round(time.time() - self._t0, 3),
+                # loadgen --serve stamps its verdicts with these —
+                # the fleet's device identity is its workers'
+                "device_kind": meta["device_kind"],
+                "jax": meta["jax"],
+            }
+
+    def _worker_meta(self) -> dict:
+        """device_kind / jax version borrowed from the first worker
+        that knows them (workers resolve both lazily at their first
+        dispatch). Cached once ANY field resolves — the same
+        predicate the store uses — and unresolved retries are
+        rate-limited to one fan-out per second: a status/drain poll
+        loop pinging the front socket 5x/s must not multiply into
+        N upstream pings each. Meta pings skip draining workers and
+        bypass the in-flight accounting drain waits on."""
+        now = time.perf_counter()
+        with self._lock:
+            if (self._meta["jax"] is not None
+                    or self._meta["device_kind"] is not None):
+                return dict(self._meta)
+            if now < self._meta_next_try:
+                return dict(self._meta)
+            self._meta_next_try = now + 1.0
+            candidates = [i for i in range(len(self.workers))
+                          if i not in self._draining]
+        for idx in candidates:
+            pool = self._pools[idx]
+            sock = None
+            ok = False
+            try:
+                sock = pool.acquire()
+                protocol.send_frame(
+                    sock, {"v": protocol.VERSION, "op": "ping"}
+                )
+                frame = protocol.recv_frame(sock)
+                ok = frame is not None
+            except (OSError, protocol.ProtocolError):
+                continue
+            finally:
+                if sock is not None:
+                    pool.release(sock, poisoned=not ok)
+            if not ok:
+                continue
+            header = frame[0]
+            if header.get("device_kind") or header.get("jax"):
+                with self._lock:
+                    self._meta = {
+                        "device_kind": header.get("device_kind"),
+                        "jax": header.get("jax"),
+                    }
+                break
+        with self._lock:
+            return dict(self._meta)
+
+    def _control(self, op: str, header: dict) -> dict:
+        idx = header.get("worker")
+        if not isinstance(idx, int) or isinstance(idx, bool) or \
+                not 0 <= idx < len(self.workers):
+            return {"v": protocol.VERSION, "ok": False, "kind": "error",
+                    "error": f"bad worker index {idx!r} "
+                             f"(fleet has {len(self.workers)})"}
+        with self._lock:
+            if op == "drain":
+                self._draining.add(idx)
+            else:
+                self._draining.discard(idx)
+                self._cooldown.pop(idx, None)
+            inflight = self._inflight[idx]
+        # flush the worker's idle connection pool both ways: drained
+        # workers get stopped (their pooled sockets go stale), and an
+        # undrained worker is usually a FRESH process on the same
+        # socket path — forwarding on a stale socket would read as a
+        # spurious transport spill against a healthy restored worker
+        self._pools[idx].close_all()
+        journal.emit(
+            "serve_drain", worker=idx, socket=self.workers[idx],
+            phase="begin" if op == "drain" else "undrain",
+            inflight=inflight,
+        )
+        print(f"# route: worker {idx} "
+              + ("DRAINING" if op == "drain" else "restored")
+              + f" ({inflight} in flight)", file=sys.stderr)
+        return {"v": protocol.VERSION, "ok": True, "worker": idx,
+                "draining": op == "drain", "inflight": inflight}
+
+    # -------------------------------------------------------------- #
+    # admission: per-tenant token buckets, priority reserve          #
+    # -------------------------------------------------------------- #
+
+    def _admit_tenant(self, tenant: str, priority: str):
+        """(admitted, retry_after_s). Quotas off (rate <= 0) admit
+        everything. A batch request must leave 1 + burst/2 tokens —
+        the reserve interactive traffic draws on — so background load
+        yields first when a tenant runs hot."""
+        rate = self.tenant_rate
+        if rate <= 0:
+            return True, 0.0
+        need = 1.0 if priority == "interactive" else \
+            1.0 + self.tenant_burst / 2.0
+        now = time.perf_counter()
+        with self._lock:
+            tokens, last = self._tenants.get(
+                tenant, (self.tenant_burst, now)
+            )
+            tokens = min(self.tenant_burst,
+                         tokens + (now - last) * rate)
+            if tokens >= need:
+                self._tenants[tenant] = [tokens - 1.0, now]
+                return True, 0.0
+            self._tenants[tenant] = [tokens, now]
+        retry = min(MAX_RETRY_HINT_S,
+                    max(0.05, (need - tokens) / rate))
+        return False, round(retry, 3)
+
+    # -------------------------------------------------------------- #
+    # routing                                                        #
+    # -------------------------------------------------------------- #
+
+    def _order(self, bucket: str) -> list:
+        """[primary, spill_sibling, ...] for one bucket: the md5 ring
+        with draining workers removed and cooling (recently wedged)
+        workers deferred to last resort. Falls back to the raw ring
+        when everything is draining/cooling — routing SOMEWHERE
+        loudly beats rejecting everything silently."""
+        ring = ring_order(bucket, len(self.workers))
+        now = time.perf_counter()
+        with self._lock:
+            draining = set(self._draining)
+            cooling = {i for i, t in self._cooldown.items() if t > now}
+        alive = [i for i in ring if i not in draining]
+        warm = [i for i in alive if i not in cooling]
+        return (warm + [i for i in alive if i in cooling]) or ring
+
+    def _forward(self, idx: int, header: dict, payloads):
+        """One upstream round trip; raises OSError/ProtocolError on
+        transport loss. In-flight accounting is what ``drain`` waits
+        on."""
+        with self._lock:
+            self._inflight[idx] += 1
+        pool = self._pools[idx]
+        sock = None
+        ok = False
+        try:
+            sock = pool.acquire()
+            protocol.send_frame(sock, header, payloads)
+            frame = protocol.recv_frame(sock)
+            if frame is None:
+                raise protocol.ProtocolError(
+                    "worker hung up mid-request"
+                )
+            ok = True
+            return frame
+        finally:
+            if sock is not None:
+                pool.release(sock, poisoned=not ok)
+            with self._lock:
+                self._inflight[idx] -= 1
+
+    def _route(self, conn: _Conn, header: dict, payloads):
+        rid = header.get("id")
+
+        def reply(h, p=()):
+            try:
+                conn.send(h, p)
+            except (OSError, protocol.ProtocolError):
+                pass  # client gone; the decision is journaled anyway
+
+        tenant = header.get("tenant") or "-"
+        priority = header.get("priority") or "interactive"
+        try:
+            if priority not in PRIORITIES:
+                raise ValueError(
+                    f"unknown priority {priority!r}; known: "
+                    f"{PRIORITIES}"
+                )
+            kernel = header["kernel"]
+            statics = dict(header.get("statics") or {})
+            arrays = protocol.unpack_arrays(
+                header.get("args") or [], payloads
+            )
+            spec, _how = bucketing.bucket_for(kernel, arrays, statics)
+            bucket = bucketing.bucket_id(kernel, spec, statics, arrays)
+        except (KeyError, ValueError, TypeError, AttributeError,
+                protocol.ProtocolError) as e:
+            # malformed dispatches die HERE, at the front door — a
+            # worker never sees a request the router could not hash
+            reply({"v": protocol.VERSION, "id": rid, "ok": False,
+                   "kind": "error", "error": f"bad request: {e}"})
+            return
+        admitted, retry = self._admit_tenant(tenant, priority)
+        if not admitted:
+            with self._lock:
+                self._throttled += 1
+            obs_metrics.inc("serve.throttled")
+            journal.emit(
+                "serve_tenant_throttled", tenant=tenant,
+                priority=priority, kernel=kernel, request=rid,
+                retry_after_s=retry,
+            )
+            reply({"v": protocol.VERSION, "id": rid, "ok": False,
+                   "kind": "overloaded", "throttled": True,
+                   "tenant": tenant, "retry_after_s": retry,
+                   "error": (f"tenant {tenant!r} over quota "
+                             f"({priority}); retry after {retry}s")})
+            return
+        order = self._order(bucket)
+        idx = order[0]
+        spilled_from = None
+        reason = None
+        for hop in range(2):
+            try:
+                resp, out_payloads = self._forward(idx, header,
+                                                   payloads)
+            except (OSError, protocol.ProtocolError) as e:
+                resp, out_payloads = None, ()
+                reason = "transport"
+                err = e
+            else:
+                if resp.get("ok"):
+                    reason = None
+                elif resp.get("kind") == "overloaded":
+                    reason = "overloaded"
+                elif resp.get("kind") == "wedged":
+                    reason = "wedged"
+                    with self._lock:
+                        self._cooldown[idx] = (time.perf_counter()
+                                               + self.cooldown_s)
+                    print(f"# route: worker {idx} WEDGED on "
+                          f"{kernel} - cooling "
+                          f"{self.cooldown_s:.0f}s", file=sys.stderr)
+                else:
+                    reason = None  # an honest dispatch error: relay it
+            if reason is None:
+                break
+            sibling = next((j for j in order if j != idx), None)
+            if hop == 1 or sibling is None:
+                # no (further) sibling: surface the failure honestly
+                if resp is None:
+                    resp = {"v": protocol.VERSION, "id": rid,
+                            "ok": False, "kind": "error",
+                            "error": (f"worker {idx} unreachable: "
+                                      f"{err!r}")}
+                    with self._lock:
+                        self._rejected += 1
+                break
+            with self._lock:
+                self._spilled += 1
+            obs_metrics.inc("serve.spills")
+            journal.emit(
+                "serve_spill", kernel=kernel, bucket=bucket,
+                request=rid, from_worker=idx, to_worker=sibling,
+                reason=reason, tenant=tenant,
+            )
+            spilled_from, idx = idx, sibling
+        with self._lock:
+            self._routed += 1
+            self._routed_to[idx] += 1
+        obs_metrics.inc("serve.routed")
+        journal.emit(
+            "serve_route", kernel=kernel, bucket=bucket, request=rid,
+            worker=idx, tenant=tenant, priority=priority,
+            spilled_from=spilled_from,
+            ok=bool(resp.get("ok")),
+        )
+        reply(resp, out_payloads)
+
+
+# ------------------------------------------------------------------ #
+# CLI entry (python -m tpukernels.serve.router)                      #
+# ------------------------------------------------------------------ #
+
+def main(argv=None):
+    import signal
+
+    from tpukernels.serve import fleet as serve_fleet
+    from tpukernels.serve import server as serve_server
+
+    argv = sys.argv[1:] if argv is None else list(argv)
+    socket_path = None
+    workers: list = []
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--socket":
+                socket_path = next(it)
+            elif a == "--worker":
+                workers.append(next(it))
+            elif a in ("-h", "--help"):
+                print(__doc__, file=sys.stderr)
+                return 0
+            else:
+                print(__doc__, file=sys.stderr)
+                print(f"route: unknown argument {a!r}", file=sys.stderr)
+                return 2
+    except StopIteration:
+        print(f"route: {a} needs a value", file=sys.stderr)
+        return 2
+    if socket_path is None:
+        socket_path = serve_fleet.front_socket_path()
+    if not workers:
+        print("route: at least one --worker SOCKET is required",
+              file=sys.stderr)
+        return 2
+
+    if os.environ.get("TPK_HEALTH_JOURNAL") is None:
+        os.environ["TPK_HEALTH_JOURNAL"] = journal.default_path()
+    try:
+        router = Router(socket_path, workers)
+    except (ValueError, OSError) as e:
+        print(f"route: {e}", file=sys.stderr)
+        return 2
+    try:
+        pidfile = serve_server._hold_pidfile(
+            serve_fleet.router_pidfile_path()
+        )
+    except RuntimeError as e:
+        print(f"route: {e}", file=sys.stderr)
+        return 3
+
+    from tpukernels.obs import scaling as obs_scaling
+
+    # env-derived stamp only: the router is jax-free by design and
+    # must never initialize a backend (the workers stamp probed
+    # inventories of their own)
+    obs_scaling.emit_inventory("serve_router")
+    signal.signal(signal.SIGTERM, router.stop)
+    signal.signal(signal.SIGINT, router.stop)
+    print(f"# route: listening on {socket_path} "
+          f"(pid {os.getpid()}, {len(workers)} worker(s))",
+          file=sys.stderr)
+    try:
+        router.serve_forever()
+    except OSError as e:
+        print(f"route: cannot serve on {socket_path}: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        try:
+            pidfile.close()
+            os.unlink(serve_fleet.router_pidfile_path())
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,10 +116,10 @@ class _Request:
     __slots__ = ("serial", "rid", "kernel", "statics", "arrays",
                  "spec", "pad_frac", "bucket", "conn", "t_enq",
                  "t_start", "requeues", "patience", "done", "lock",
-                 "worker_ident")
+                 "worker_ident", "tenant")
 
     def __init__(self, serial, rid, kernel, statics, arrays, spec,
-                 pad_frac, bucket, conn):
+                 pad_frac, bucket, conn, tenant=None):
         self.serial = serial  # server-side key: client ids can collide
         self.rid = rid
         self.kernel = kernel
@@ -129,6 +129,7 @@ class _Request:
         self.pad_frac = pad_frac
         self.bucket = bucket
         self.conn = conn
+        self.tenant = tenant
         self.t_enq = time.perf_counter()
         self.t_start = None
         self.requeues = 0
@@ -349,10 +350,18 @@ class Server:
                 pass
 
     def _stats(self) -> dict:
+        with self._lock:
+            inflight = len(self._inflight)
+            # the bucket-lock table is exactly the set of compiled-
+            # program buckets this daemon has ever dispatched — the
+            # per-bucket memo-ownership answer a fleet status wants
+            buckets = sorted(self._bucket_locks)
         return {
             "op": "pong", "pid": os.getpid(),
             "served": self._served, "rejected": self._rejected,
             "requeued": self._requeued, "depth": self._q.depth(),
+            "inflight": inflight, "buckets": buckets,
+            "worker_id": os.environ.get("TPK_SERVE_WORKER_ID"),
             "queue_max": self.queue_max, "workers": self.workers,
             "uptime_s": round(time.time() - self._t0, 3),
             # report-only, like jax below: a liveness ping must never
@@ -394,7 +403,7 @@ class Server:
             serial = self._next_rid
         req = _Request(serial, rid if rid is not None else serial,
                        kernel, statics, arrays, spec, pad_frac,
-                       bucket, conn)
+                       bucket, conn, tenant=header.get("tenant"))
         try:
             self._q.put_nowait(req)
         except _queue_mod.Full:
@@ -614,7 +623,8 @@ class Server:
         # wedge finally unwound, or the requeue raced us) — discard
 
     def _finish(self, req: _Request, outs, error=None,
-                queue_wait=None, batch_size=None, wall=None):
+                queue_wait=None, batch_size=None, wall=None,
+                kind="error"):
         if wall is None:
             # watchdog caller (wedged-twice): the retry attempt's own
             # start is still in req.t_start here. _execute passes its
@@ -641,10 +651,11 @@ class Server:
         else:
             obs_metrics.inc("serve.errors")
             header = {"v": protocol.VERSION, "id": req.rid, "ok": False,
-                      "kind": "error", "error": error}
+                      "kind": kind, "error": error}
             payloads = ()
         journal.emit(
             "serve_request", kernel=req.kernel, request=req.rid,
+            tenant=req.tenant,
             bucket=req.bucket, pad_frac=round(req.pad_frac, 6),
             bucketed=req.spec is not None,
             wall_s=round(wall, 6),
@@ -769,8 +780,11 @@ class Server:
             # bounce off its own backpressure on the retry
             self._q.put_nowait(req, force=True)
         elif req.claim_done():
+            # structured kind: the fleet router keys failover on it —
+            # a worker that wedged twice should not be fed this
+            # bucket again until it cools (docs/SERVING.md §fleet)
             self._finish(
-                req, None,
+                req, None, kind="wedged",
                 error=(f"request wedged twice (> "
                        f"{self.request_timeout_s}s each attempt)"),
             )
