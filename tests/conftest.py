@@ -266,6 +266,13 @@ os.environ.pop("TPK_SERVE_SOCKET", None)
 # (fleet.json, front socket, router pidfile) is cleared so
 # start-fleet's double-start refusal starts from a clean slate.
 os.environ.pop("TPK_SERVE_FLEET_DIR", None)
+# Wire-path knobs (docs/SERVING.md §wire format / §continuous
+# batching) are scrubbed too: an operator's exported lane/threshold/
+# window choices would silently change which lane (and which batch
+# policy) the serve tests exercise — the tests pin them explicitly.
+os.environ.pop("TPK_SERVE_SHM", None)
+os.environ.pop("TPK_SERVE_SHM_MIN_BYTES", None)
+os.environ.pop("TPK_SERVE_BATCH_ADAPT", None)
 if "TPK_SERVE_DIR" not in os.environ:
     import tempfile
 

@@ -436,6 +436,10 @@ def test_batch_window_coalesces_same_bucket(tmp_path):
         "TPK_SERVE_MAX_PAD_FRAC": "0.9",
         "TPK_SERVE_WORKERS": "1",
         "TPK_SERVE_BATCH_WINDOW_MS": "400",
+        # fixed-window mode: this test pins the WINDOW's coalescing
+        # semantics; the adaptive policy has its own tests
+        # (tests/test_serve_zero_copy.py)
+        "TPK_SERVE_BATCH_ADAPT": "0",
     }) as (sock, journal, _proc):
         x = (np.arange(6000) % 17).astype(np.int32)
         want = np.cumsum(x, dtype=np.int64).astype(np.int32)
@@ -569,6 +573,10 @@ def test_batch_members_behind_wedge_are_rescued(tmp_path):
         "TPK_SERVE_MAX_PAD_FRAC": "0.9",
         "TPK_SERVE_WORKERS": "1",
         "TPK_SERVE_BATCH_WINDOW_MS": "500",
+        # fixed window: the rescue path needs members COALESCED
+        # behind the wedge — the adaptive window would dispatch the
+        # lone first request immediately and never form the batch
+        "TPK_SERVE_BATCH_ADAPT": "0",
         "TPK_SERVE_REQUEST_TIMEOUT_S": "2",
         "TPK_FAULT_PLAN": plan,
     }) as (sock, journal, _proc):

@@ -1,0 +1,665 @@
+"""CPU suite for the zero-copy wire path + continuous batching
+(docs/SERVING.md §wire format / §continuous batching; ISSUE 12).
+
+Covers: the copy-free send/recv path (memoryview payloads, no
+``bytes()`` materialization), frame-boundary cases (payload exactly
+at the small-frame threshold and at the oversize cap, zero-length
+payloads), the shm segment lifecycle (create/map/torn/dead-creator
+sweep), lane negotiation (shm client vs an inline-only daemon and vs
+an old server that predates ``lanes``), the torn-segment
+poisons-only-its-connection contract, the daemon-side zero-copy
+proof (``serve.bytes_copied`` stays 0 across warm exact-fit shm
+dispatches), the adaptive batch window (collapse-to-zero idle,
+widen under burst), the fleet router's O(1) descriptor forwarding,
+and the tier-1 copy-budget smoke: ``loadgen --serve`` →
+``serve_copy_budget`` journal evidence → ``obs_report --check``
+gating a synthetic copy regression like a bench regression.
+"""
+
+import contextlib
+import json
+import os
+import socket as socket_mod
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_distributed import _scrubbed_env
+from test_serve import SCAN_BUCKET, _daemon, _events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# an exact-fit avatar at the canary-free shape the shm tests use:
+# 8192 int32 = 32 KiB per payload, comfortably over the small-frame
+# threshold so the inline comparison paths stream, not join
+EXACT = np.arange(8192, dtype=np.int32) % 17
+EXACT_WANT = np.cumsum(EXACT, dtype=np.int64).astype(np.int32)
+
+
+# ---------------------------------------------------------------- #
+# protocol: zero-copy send path + frame boundaries                 #
+# ---------------------------------------------------------------- #
+
+def test_pack_arrays_returns_views_not_copies():
+    """Satellite 1: the send path must not materialize ``bytes()``
+    twins — pack_arrays hands back buffer views over the operands
+    themselves for contiguous arrays."""
+    from tpukernels.serve import protocol
+
+    arr = np.arange(4096, dtype=np.int32)
+    specs, payloads = protocol.pack_arrays([arr])
+    assert specs == [{"shape": [4096], "dtype": "int32"}]
+    view = np.frombuffer(payloads[0], dtype=np.int32)
+    assert np.shares_memory(view, arr), \
+        "pack_arrays must return a view, not a copy"
+
+
+def test_recv_frame_returns_views_over_one_blob():
+    from tpukernels.serve import protocol
+
+    a, b = socket_mod.socketpair()
+    try:
+        arrays = [np.arange(100, dtype=np.int32),
+                  np.ones((4, 5), np.float32)]
+        specs, payloads = protocol.pack_arrays(arrays)
+        sent = protocol.send_frame(a, {"op": "dispatch",
+                                       "args": specs}, payloads)
+        assert sent == 100 * 4 + 20 * 4
+        header, got = protocol.recv_frame(b)
+        assert all(isinstance(p, memoryview) for p in got)
+        outs = protocol.unpack_arrays(header["args"], got)
+        for orig, back in zip(arrays, outs):
+            np.testing.assert_array_equal(orig, back)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_boundary_small_frame_threshold():
+    """Payloads exactly at / one past the small-frame join threshold
+    take the two different send paths; both must roundtrip
+    byte-identically."""
+    from tpukernels.serve import protocol
+
+    for n in (protocol.SMALL_FRAME, protocol.SMALL_FRAME + 1):
+        a, b = socket_mod.socketpair()
+        try:
+            payload = bytes(range(256)) * (n // 256) + b"x" * (n % 256)
+            assert len(payload) == n
+            got = []
+
+            def reader(sock=b, got=got):
+                got.append(protocol.recv_frame(sock))
+
+            t = threading.Thread(target=reader)
+            t.start()
+            sent = protocol.send_frame(a, {"op": "x"}, [payload])
+            t.join(30)
+            assert sent == n
+            header, payloads = got[0]
+            assert header == {"op": "x"}
+            assert len(payloads) == 1 and payloads[0] == payload
+        finally:
+            a.close()
+            b.close()
+
+
+def test_frame_boundary_oversize_cap(monkeypatch):
+    """Exactly AT the payload cap is a legal frame; one byte past it
+    is rejected on send, and a crafted preamble claiming past-cap is
+    rejected on recv BEFORE any payload is read."""
+    from tpukernels.serve import protocol
+
+    monkeypatch.setattr(protocol, "MAX_PAYLOAD", 4096)
+    a, b = socket_mod.socketpair()
+    try:
+        at_cap = b"\xab" * 4096
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(protocol.recv_frame(b))
+        )
+        t.start()
+        assert protocol.send_frame(a, {"op": "x"}, [at_cap]) == 4096
+        t.join(30)
+        assert got[0][1][0] == at_cap
+        with pytest.raises(protocol.ProtocolError, match="too large"):
+            protocol.send_frame(a, {"op": "x"}, [at_cap + b"y"])
+        # recv side: a preamble claiming cap+1 dies without reading
+        a.sendall(protocol._PREAMBLE.pack(protocol.MAGIC, 2, 4097)
+                  + b"{}")
+        with pytest.raises(protocol.ProtocolError, match="absurd"):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_zero_length_payloads_roundtrip():
+    from tpukernels.serve import protocol
+
+    a, b = socket_mod.socketpair()
+    try:
+        empty = np.zeros(0, np.int32)
+        data = np.arange(7, dtype=np.int32)
+        specs, payloads = protocol.pack_arrays([empty, data, empty])
+        protocol.send_frame(a, {"op": "x", "args": specs}, payloads)
+        header, got = protocol.recv_frame(b)
+        outs = protocol.unpack_arrays(header["args"], got)
+        assert outs[0].shape == (0,) and outs[2].shape == (0,)
+        np.testing.assert_array_equal(outs[1], data)
+        # zero-length payloads never go to shm, whatever the threshold
+        descs, wire, segs, staged = protocol.stage_shm_payloads(
+            payloads, min_bytes=0
+        )
+        assert staged == 28 and len(segs) == 1
+        assert descs[0] is None and descs[2] is None
+        for seg in segs:
+            seg.close()
+            seg.unlink()
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------- #
+# shm segments: lifecycle units                                    #
+# ---------------------------------------------------------------- #
+
+def test_shm_segment_roundtrip_torn_and_sweep():
+    from tpukernels.serve import protocol
+
+    if not protocol.shm_available():
+        pytest.skip("no usable /dev/shm on this host")
+    data = os.urandom(4096)
+    seg = protocol.ShmSegment(4096)
+    try:
+        assert seg.write(data) == 4096
+        mm = protocol.open_shm(seg.name, 4096)
+        assert bytes(mm[:]) == data
+        mm.close()
+        # a reader claiming MORE than the file holds = torn
+        with pytest.raises(protocol.ProtocolError, match="torn"):
+            protocol.open_shm(seg.name, 8192)
+    finally:
+        seg.close()
+        seg.unlink()
+    # unlinked: now the name itself is torn
+    with pytest.raises(protocol.ProtocolError, match="torn"):
+        protocol.open_shm(seg.name, 4096)
+    # names outside the namespace are rejected, never path-joined
+    for bad in ("../etc/passwd", "x/y", "psm_123", "", None):
+        with pytest.raises(protocol.ProtocolError, match="shm"):
+            protocol.open_shm(bad, 64)
+    # dead-creator sweep: a segment named for a pid that cannot exist
+    dead = f"tpkserve-{2 ** 22 + 1}-0-deadbeef"
+    with open(os.path.join(protocol.SHM_DIR, dead), "wb") as f:
+        f.write(b"\0" * 16)
+    live = protocol.ShmSegment(16)
+    try:
+        assert protocol.sweep_stale_segments() >= 1
+        assert not os.path.exists(os.path.join(protocol.SHM_DIR, dead))
+        # the live creator's segment survives the sweep
+        assert os.path.exists(os.path.join(protocol.SHM_DIR, live.name))
+    finally:
+        live.close()
+        live.unlink()
+        with contextlib.suppress(OSError):
+            os.unlink(os.path.join(protocol.SHM_DIR, dead))
+
+
+def test_check_shm_descs_front_door():
+    """The router's structural ``_shm`` validation: malformed
+    descriptors must die as bad requests at the front door, never
+    ride upstream to read as worker transport loss."""
+    from tpukernels.serve import protocol
+
+    args = [{"shape": [8192], "dtype": "int32"}]
+    good = {"args": args,
+            "_shm": [{"name": "tpkserve-1-0-deadbeef",
+                      "nbytes": 32768}]}
+    protocol.check_shm_descs(good, 0)          # passes
+    protocol.check_shm_descs({"args": args}, 1)  # no _shm: passes
+    bad_cases = [
+        ({"args": args, "_shm": [{"name": "x"}]}, 0),       # bad name
+        ({"args": args, "_shm": "nope"}, 0),                # not a list
+        ({"args": args, "_shm": []}, 0),                    # wrong arity
+        ({"args": args,
+          "_shm": [{"name": "tpkserve-1-0-deadbeef"}]}, 0),  # no nbytes
+        ({"args": args,
+          "_shm": [{"name": "tpkserve-1-0-deadbeef",
+                    "nbytes": -4}]}, 0),                    # bad size
+        (good, 1),                      # inline count disagrees
+        ({"args": args, "_shm": [None]}, 0),  # slot inline, no payload
+    ]
+    for header, n_payloads in bad_cases:
+        with pytest.raises(protocol.ProtocolError):
+            protocol.check_shm_descs(dict(header), n_payloads)
+
+
+# ---------------------------------------------------------------- #
+# adaptive batch window: policy unit                               #
+# ---------------------------------------------------------------- #
+
+def test_adaptive_window_policy(monkeypatch):
+    """The continuous-batching policy, pinned: 0 when idle (empty
+    queue) or when arrivals are slower than the cap; ~7 projected
+    gaps under burst, capped; the fixed mode returns the knob
+    verbatim."""
+    from tpukernels.serve import server as serve_server
+
+    srv = serve_server.Server(
+        socket_path="/nonexistent/unused.sock", queue_max=4,
+        workers=1, batch_window_ms=2.0, request_timeout_s=60,
+    )
+    assert srv.batch_adapt is True  # the default
+    # idle: empty queue dispatches immediately, whatever the EWMA says
+    srv._arrival_ewma = 0.0001
+    assert srv._window_s(0) == 0.0
+    # no arrival history yet: nothing to project, dispatch now
+    srv._arrival_ewma = None
+    assert srv._window_s(3) == 0.0
+    # burst: gap 0.2ms -> 7 gaps = 1.4ms, under the 2ms cap
+    srv._arrival_ewma = 0.0002
+    assert srv._window_s(3) == pytest.approx(0.0014)
+    # heavier projection than the cap: capped
+    srv._arrival_ewma = 0.0005
+    assert srv._window_s(3) == pytest.approx(0.002)
+    # arrivals slower than the cap: waiting is pure latency
+    srv._arrival_ewma = 0.01
+    assert srv._window_s(3) == 0.0
+    # fixed mode: the PR-10 semantics verbatim
+    monkeypatch.setenv("TPK_SERVE_BATCH_ADAPT", "0")
+    fixed = serve_server.Server(
+        socket_path="/nonexistent/unused.sock", queue_max=4,
+        workers=1, batch_window_ms=2.0, request_timeout_s=60,
+    )
+    fixed._arrival_ewma = 0.01
+    assert fixed.batch_adapt is False
+    assert fixed._window_s(0) == pytest.approx(0.002)
+    assert fixed._window_s(3) == pytest.approx(0.002)
+
+
+# ---------------------------------------------------------------- #
+# copy-budget verdict unit                                         #
+# ---------------------------------------------------------------- #
+
+def test_analyze_copy_budget_verdicts():
+    from tpukernels.obs import trend
+
+    def ev(lane, bpr, expected_zero, sock="/tmp/s.sock"):
+        return {"kind": "serve_copy_budget", "socket": sock,
+                "lane": lane, "requests": 10,
+                "bytes_per_request": bpr,
+                "expected_zero": expected_zero}
+
+    # a clean zero-copy run and a bounded inline run are both ok
+    v = trend.analyze_copy_budget(
+        [ev("shm", 0, True), ev("inline", 48000.0, False)]
+    )
+    assert {x["verdict"] for x in v.values()} == {"ok"}
+    # a single copied byte on an expected-zero run gates
+    v = trend.analyze_copy_budget([ev("shm", 0.1, True)])
+    (only,) = v.values()
+    assert only["verdict"] == "copy_regression" and only["flags"]
+    # only the LATEST event per (socket, lane) is judged
+    v = trend.analyze_copy_budget(
+        [ev("shm", 409.6, True), ev("shm", 0, True)]
+    )
+    (only,) = v.values()
+    assert only["verdict"] == "ok"
+    # inline is never gated, whatever the byte count
+    v = trend.analyze_copy_budget([ev("inline", 10 ** 9, False)])
+    (only,) = v.values()
+    assert only["verdict"] == "ok"
+
+
+# ---------------------------------------------------------------- #
+# daemon e2e: zero-copy proof, negotiation, torn segment           #
+# ---------------------------------------------------------------- #
+
+def test_shm_lane_end_to_end_zero_copy(tmp_path, monkeypatch):
+    """The headline: warm exact-fit dispatches over the negotiated
+    shm lane move every operand and result through /dev/shm — the
+    daemon's ``serve.bytes_copied`` does not move at all, and neither
+    does the client's. No segments leak."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import protocol
+
+    if not protocol.shm_available():
+        pytest.skip("no usable /dev/shm on this host")
+    monkeypatch.setenv("TPK_SERVE_SHM_MIN_BYTES", "0")
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_SHM_MIN_BYTES": "0",
+    }) as (sock, journal, _proc):
+        with serve_client.ServeClient(sock, timeout_s=120) as c:
+            ping = c.ping()
+            assert ping.get("lanes") == ["inline", "shm"]
+            assert ping.get("shm_min_bytes") == 0
+            for _ in range(4):
+                np.testing.assert_array_equal(
+                    c.dispatch("scan", EXACT), EXACT_WANT
+                )
+            after = c.ping()
+            assert after.get("bytes_copied") == 0, \
+                "warm shm path must copy NOTHING daemon-side"
+            assert c.bytes_copied == 0 and c.inline_payloads == 0
+            assert c.staged_payloads == 4
+    events = _events(journal)
+    neg = [e for e in events
+           if e.get("kind") == "serve_lane_negotiated"]
+    assert len(neg) == 1 and neg[0].get("lane") == "shm"
+    served = [e for e in events if e.get("kind") == "serve_request"]
+    assert len(served) == 4 and all(e.get("ok") for e in served)
+    leftovers = [n for n in os.listdir(protocol.SHM_DIR)
+                 if n.startswith("tpkserve-")]
+    assert not leftovers, f"leaked segments: {leftovers}"
+
+
+def test_shm_client_against_inline_only_daemon(tmp_path, monkeypatch):
+    """Negotiation falls back cleanly: a daemon with the lane
+    switched off advertises inline only, and an shm-capable client
+    speaks inline to it — right answers, zero staged segments."""
+    from tpukernels.serve import client as serve_client
+
+    monkeypatch.setenv("TPK_SERVE_SHM_MIN_BYTES", "0")
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_SHM": "0",
+    }) as (sock, _journal, _proc):
+        with serve_client.ServeClient(sock, timeout_s=120) as c:
+            assert c.ping().get("lanes") == ["inline"]
+            np.testing.assert_array_equal(
+                c.dispatch("scan", EXACT), EXACT_WANT
+            )
+            assert c.staged_payloads == 0
+            assert c.inline_payloads == 1
+            assert c.bytes_copied > 0  # inline lane is O(tensor)
+
+
+def test_shm_client_against_old_server(monkeypatch, tmp_path):
+    """A pre-lanes server (its pong has no ``lanes`` key) pins the
+    client to the inline lane — the request frame carries no ``_shm``
+    and every payload rides the socket."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import protocol
+
+    monkeypatch.setenv("TPK_SERVE_SHM_MIN_BYTES", "0")
+    sock_path = str(tmp_path / "old.sock")
+    listener = socket_mod.socket(socket_mod.AF_UNIX,
+                                 socket_mod.SOCK_STREAM)
+    listener.bind(sock_path)
+    listener.listen(1)
+    seen = {}
+
+    def old_server():
+        conn, _ = listener.accept()
+        with contextlib.closing(conn):
+            header, _p = protocol.recv_frame(conn)
+            assert header.get("op") == "ping"
+            protocol.send_frame(conn, {"v": 1, "op": "pong",
+                                       "ok": True})  # NO lanes key
+            header, payloads = protocol.recv_frame(conn)
+            seen["header"] = header
+            seen["n_payloads"] = len(payloads)
+            arr = protocol.unpack_arrays(header["args"], payloads)[0]
+            specs, outs = protocol.pack_arrays([arr])
+            protocol.send_frame(
+                conn, {"v": 1, "id": header["id"], "ok": True,
+                       "outputs": specs}, outs,
+            )
+
+    t = threading.Thread(target=old_server, daemon=True)
+    t.start()
+    try:
+        with serve_client.ServeClient(sock_path, timeout_s=30) as c:
+            out = c.dispatch("scan", EXACT)
+        np.testing.assert_array_equal(out, EXACT)  # echo server
+        assert "_shm" not in seen["header"]
+        assert "shm_ok" not in seen["header"]
+        assert seen["n_payloads"] == 1
+        t.join(30)
+    finally:
+        listener.close()
+
+
+def test_torn_shm_segment_poisons_only_its_connection(tmp_path):
+    """A dispatch naming a segment that does not exist is a desynced
+    stream: that CONNECTION dies (EOF/reset), the daemon does not —
+    a fresh client is served normally right after."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import protocol
+
+    if not protocol.shm_available():
+        pytest.skip("no usable /dev/shm on this host")
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_SHM_MIN_BYTES": "0",
+    }) as (sock, journal, _proc):
+        raw = socket_mod.socket(socket_mod.AF_UNIX,
+                                socket_mod.SOCK_STREAM)
+        raw.connect(sock)
+        raw.settimeout(30)
+        try:
+            protocol.send_frame(raw, {
+                "v": 1, "op": "dispatch", "id": 1, "kernel": "scan",
+                "statics": {}, "shm_ok": True,
+                "args": [{"shape": [8192], "dtype": "int32"}],
+                "_shm": [{"name": "tpkserve-999999-0-deadbeef",
+                          "nbytes": 32768}],
+            })
+            with pytest.raises((ConnectionResetError,
+                                protocol.ProtocolError)):
+                if protocol.recv_frame(raw) is None:
+                    raise protocol.ProtocolError("clean EOF")
+        finally:
+            raw.close()
+        # the daemon survived: a fresh connection is served
+        with serve_client.ServeClient(sock, timeout_s=120) as c:
+            np.testing.assert_array_equal(
+                c.dispatch("scan", EXACT), EXACT_WANT
+            )
+    served = [e for e in _events(journal)
+              if e.get("kind") == "serve_request"]
+    assert len(served) == 1 and served[0].get("ok")
+
+
+def test_adaptive_window_idle_collapses_burst_widens(tmp_path):
+    """Continuous batching, live: an idle request dispatches with a
+    0 ms window (ping reports it); a same-bucket burst behind a slow
+    dispatch widens the window (ping catches a nonzero value
+    mid-burst) and coalesces."""
+    from tpukernels.serve import client as serve_client
+
+    plan = json.dumps({"slow_dispatch": {"kernel": "scan",
+                                         "delay_s": 0.3}})
+    with _daemon(tmp_path, {
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_WORKERS": "1",
+        "TPK_SERVE_BATCH_WINDOW_MS": "400",
+        "TPK_FAULT_PLAN": plan,
+    }) as (sock, journal, _proc):
+        with serve_client.ServeClient(sock, timeout_s=120) as c:
+            np.testing.assert_array_equal(
+                c.dispatch("scan", EXACT), EXACT_WANT
+            )
+            ping = c.ping()
+            assert ping.get("batch_adapt") is True
+            assert ping.get("batch_window_ms") == 0.0, \
+                "an idle request must not pay the window"
+        errors = []
+
+        def one():
+            try:
+                with serve_client.ServeClient(sock,
+                                              timeout_s=120) as cc:
+                    np.testing.assert_array_equal(
+                        cc.dispatch("scan", EXACT), EXACT_WANT
+                    )
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=one) for _ in range(6)]
+        for t in threads:
+            t.start()
+        # the burst queues behind the slow first dispatch; once a
+        # pickup sees a non-empty queue the window must widen
+        widened = 0.0
+        deadline = time.monotonic() + 30
+        with serve_client.ServeClient(sock, timeout_s=30) as mon:
+            while time.monotonic() < deadline:
+                w = mon.ping().get("batch_window_ms") or 0.0
+                widened = max(widened, w)
+                if widened > 0:
+                    break
+                time.sleep(0.02)
+        for t in threads:
+            t.join(120)
+        assert not errors, errors
+        assert widened > 0.0, "burst pickups must widen the window"
+    served = [e for e in _events(journal)
+              if e.get("kind") == "serve_request"]
+    assert len(served) == 7 and all(e.get("ok") for e in served)
+    assert max(e.get("batch_size") or 0 for e in served) >= 2
+
+
+# ---------------------------------------------------------------- #
+# fleet: the router forwards descriptors, not tensors              #
+# ---------------------------------------------------------------- #
+
+def test_fleet_router_forwards_shm_descriptors(tmp_path, monkeypatch):
+    """Through a router + worker fleet on the shm lane, the front-end
+    relays only names: the router's own bytes_copied stays 0 while
+    answers stay exact — the fleet path stopped being O(tensor)."""
+    from test_fleet import _fleet
+
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import protocol
+
+    if not protocol.shm_available():
+        pytest.skip("no usable /dev/shm on this host")
+    monkeypatch.setenv("TPK_SERVE_SHM_MIN_BYTES", "0")
+    with _fleet(tmp_path, n=2, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_SHM_MIN_BYTES": "0",
+    }) as (front, journal, _env):
+        with serve_client.ServeClient(front, timeout_s=120) as c:
+            ping = c.ping()
+            assert "shm" in (ping.get("lanes") or []), \
+                "the front socket must advertise its workers' lanes"
+            for _ in range(3):
+                np.testing.assert_array_equal(
+                    c.dispatch("scan", EXACT), EXACT_WANT
+                )
+            after = c.ping()
+            assert after.get("bytes_copied") == 0, \
+                "the router must relay descriptors, not tensors"
+            assert c.staged_payloads == 3 and c.bytes_copied == 0
+    events = _events(journal)
+    routed = [e for e in events if e.get("kind") == "serve_route"]
+    assert len(routed) == 3 and all(e.get("ok") for e in routed)
+    leftovers = [n for n in os.listdir(protocol.SHM_DIR)
+                 if n.startswith("tpkserve-")]
+    assert not leftovers, f"leaked segments: {leftovers}"
+
+
+# ---------------------------------------------------------------- #
+# tier-1 copy-budget smoke: loadgen -> journal -> obs_report gate  #
+# ---------------------------------------------------------------- #
+
+def test_copy_budget_smoke_and_trend_gate(tmp_path):
+    """The acceptance loop, mechanical end to end: a fully-negotiated
+    shm ``loadgen --serve`` run stamps ``serve_copy_budget`` with 0
+    bytes/request and ``expected_zero`` (rc 0 through ``obs_report
+    --check``); the same run inline is bounded but nonzero; and a
+    synthetic expected-zero run that copied bytes flips the check to
+    rc 1 as a ``copy_regression`` — a copy regression gates like a
+    bench regression."""
+    from tpukernels.serve import protocol
+
+    if not protocol.shm_available():
+        pytest.skip("no usable /dev/shm on this host")
+    slo_dir = tmp_path / "slo"
+    slo_dir.mkdir()
+    loadgen = os.path.join(REPO, "tools", "loadgen.py")
+    obs_report = os.path.join(REPO, "tools", "obs_report.py")
+    with _daemon(tmp_path, {
+        "TPK_SERVE_SHM_MIN_BYTES": "0",
+    }) as (sock, _journal, _proc):
+
+        def run_loadgen(journal, extra_env=None):
+            env = _scrubbed_env(None)
+            env["TPK_SLO_DIR"] = str(slo_dir)
+            env["TPK_HEALTH_JOURNAL"] = journal
+            env["TPK_SERVE_SHM_MIN_BYTES"] = "0"
+            env.update(extra_env or {})
+            return subprocess.run(
+                [sys.executable, loadgen, "--serve", sock,
+                 "--kernel", "scan", "--arrivals", "poisson",
+                 "--seed", "7", "--requests", "25", "--rate", "50"],
+                capture_output=True, text=True, timeout=300,
+                cwd=REPO, env=env,
+            )
+
+        shm_journal = str(tmp_path / "lg_shm.jsonl")
+        r = run_loadgen(shm_journal)
+        assert r.returncode == 0, r.stdout + r.stderr
+        (budget,) = [e for e in _events(shm_journal)
+                     if e.get("kind") == "serve_copy_budget"]
+        assert budget["lane"] == "shm"
+        assert budget["expected_zero"] is True
+        assert budget["daemon_bytes_copied"] == 0
+        assert budget["bytes_per_request"] == 0
+        assert budget["client_bytes_copied"] == 0
+        assert budget["inline_payloads"] == 0
+
+        inline_journal = str(tmp_path / "lg_inline.jsonl")
+        r = run_loadgen(inline_journal, {"TPK_SERVE_SHM": "0"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        (budget,) = [e for e in _events(inline_journal)
+                     if e.get("kind") == "serve_copy_budget"]
+        assert budget["lane"] == "inline"
+        assert budget["expected_zero"] is False
+        # bounded: request + response payload traffic per request,
+        # nothing more (scan canary = 4093 int32 each way ~= 33 KB)
+        assert 0 < budget["bytes_per_request"] < 100_000
+
+        env = _scrubbed_env(None)
+        env["TPK_SLO_DIR"] = str(slo_dir)
+        chk = subprocess.run(
+            [sys.executable, obs_report, "--check",
+             "--journal", shm_journal],
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO, env=env,
+        )
+        assert chk.returncode == 0, chk.stdout + chk.stderr
+        assert "0 copy-budget regression(s)" in chk.stdout
+
+    # the gate: a zero-copy run that copied bytes fails the check
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({
+        "kind": "serve_copy_budget", "socket": "/tmp/s.sock",
+        "lane": "shm", "lanes": ["inline", "shm"], "requests": 25,
+        "daemon_bytes_copied": 102400, "bytes_per_request": 4096.0,
+        "expected_zero": True, "pid": 1,
+    }) + "\n")
+    env = _scrubbed_env(None)
+    env["TPK_SLO_DIR"] = str(slo_dir)
+    chk = subprocess.run(
+        [sys.executable, obs_report, "--check",
+         "--journal", str(bad)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=env,
+    )
+    assert chk.returncode == 1, chk.stdout + chk.stderr
+    assert "copy_regression" in chk.stdout
+    assert "1 copy-budget regression(s)" in chk.stdout

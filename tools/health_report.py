@@ -322,6 +322,18 @@ def _fmt(ev):
         return (f"{ts} [pid {pid}] tenant {ev.get('tenant')} "
                 f"THROTTLED ({ev.get('priority')} {ev.get('kernel')} "
                 f"request; retry after {ev.get('retry_after_s')}s)")
+    if kind == "serve_lane_negotiated":
+        return (f"{ts} [pid {pid}] serve shm payload lane ENGAGED "
+                f"({ev.get('kernel')} request {ev.get('request')})")
+    if kind == "serve_copy_budget":
+        return (f"{ts} [pid {pid}] serve copy budget: "
+                f"{ev.get('bytes_per_request')}B/request over "
+                f"{ev.get('requests')} request(s), {ev.get('lane')} "
+                "lane"
+                + (" - ZERO-COPY CONTRACT"
+                   + ("" if not ev.get("daemon_bytes_copied")
+                      else " VIOLATED")
+                   if ev.get("expected_zero") else ""))
     if kind == "device_inventory":
         n = ev.get("n_devices")
         return (f"{ts} [pid {pid}] device inventory ({ev.get('site')}, "

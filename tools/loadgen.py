@@ -346,6 +346,7 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
     cli = serve_client.ServeClient(socket_path, tenant=tenant,
                                    priority=priority)
     stats = cli.ping()  # reachability gate: a dead socket aborts HERE
+    bytes_before = stats.get("bytes_copied")
     prepared = {}
     for kernel in sorted({k for _t, k in schedule}):
         prepared[kernel] = _operands_np(kernel, shape_class)
@@ -376,6 +377,36 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
         stats = cli.ping()
     except (OSError, serve_protocol.ProtocolError):
         pass
+    # copy-budget evidence (docs/SERVING.md §copy accounting): the
+    # daemon-side serve.bytes_copied delta over this run, per request
+    # (warms included — they ride the same lane). ``expected_zero``
+    # marks the run the trend checker may GATE on: the shm lane was
+    # negotiated, this client staged every operand, and the daemon's
+    # threshold shms every response too — on such a run a single
+    # copied byte is a zero-copy-path regression, flagged like a
+    # bench regression by obs_report --check.
+    bytes_after = stats.get("bytes_copied")
+    if (isinstance(bytes_before, (int, float))
+            and isinstance(bytes_after, (int, float))):
+        n_req = len(schedule) + len(prepared)
+        delta = max(0, bytes_after - bytes_before)
+        lanes = stats.get("lanes") or ["inline"]
+        shm_used = cli.staged_payloads > 0
+        journal.emit(
+            "serve_copy_budget", socket=socket_path,
+            lane="shm" if shm_used else "inline", lanes=lanes,
+            requests=n_req,
+            daemon_bytes_copied=delta,
+            bytes_per_request=round(delta / max(1, n_req), 3),
+            client_bytes_copied=cli.bytes_copied,
+            staged_payloads=cli.staged_payloads,
+            inline_payloads=cli.inline_payloads,
+            expected_zero=bool(
+                "shm" in lanes and shm_used
+                and cli.inline_payloads == 0
+                and stats.get("shm_min_bytes") == 0
+            ),
+        )
     cli.close()
     return stats
 

@@ -39,10 +39,14 @@ Sections (docs/OBSERVABILITY.md):
    ``docs/logs/scaling_*.json`` / ``SCALING_r*.json`` artifacts,
    weak-scaling efficiency per program, and the MULTICHIP dryrun-wall
    series. Fake-device artifacts render flagged and never gate.
-9. **Metric snapshots** — the last ``metrics`` event per process:
-   counters (probe retries, watchdog kills, tuning-cache traffic),
-   gauges, latency histograms (count-weighted p50/p95/p99 + exact
-   max).
+9. **Serve copy budget** — payload bytes the serving daemon copied
+   per request by lane, from the ``serve_copy_budget`` events
+   ``loadgen --serve`` stamps (docs/SERVING.md §copy accounting).
+   The negotiated shm warm path's budget is exactly zero.
+10. **Metric snapshots** — the last ``metrics`` event per process:
+    counters (probe retries, watchdog kills, tuning-cache traffic),
+    gauges, latency histograms (count-weighted p50/p95/p99 + exact
+    max).
 
 Exit-code signaling (``tools/tpu_revalidate.sh`` runs ``--check``
 non-gating and keys a WARN off it):
@@ -57,9 +61,12 @@ non-gating and keys a WARN off it):
         trend OR validated bus-bw scaling series — the paper's
         multi-chip headline gates exactly like its single-chip
         slopes), a confirmed output-integrity corruption (a wrong
-        answer is worse than a slow one), or a confirmed p99 SLO
+        answer is worse than a slow one), a confirmed p99 SLO
         breach (a degraded tail is a regression users feel before the
-        slope moves) — all of these gate identically;
+        slope moves), or a ``copy_regression`` (payload bytes copied
+        per request on the serve path's negotiated zero-copy shm
+        lane — docs/SERVING.md §copy accounting) — all of these gate
+        identically;
     2 — usage error (never 1: rc 1 is reserved for real findings).
 ``below_scaling_efficiency`` prints as non-gating information, the
 ``below_roofline`` pattern.
@@ -380,6 +387,33 @@ def scaling_section(analysis, out):
             )
 
 
+def copy_section(events, out):
+    """Serve copy-budget table from the ``serve_copy_budget`` events
+    ``loadgen --serve`` stamps (docs/SERVING.md §copy accounting):
+    payload bytes the daemon copied per request, by lane. The shm
+    warm path's budget is exactly zero — a nonzero ``expected_zero``
+    row is a ``copy_regression`` and gates like a bench
+    regression."""
+    verdicts = trend.analyze_copy_budget(events)
+    if not verdicts:
+        return
+    out.append("")
+    out.append(f"== serve copy budget ({len(verdicts)} lane "
+               "measurement(s)) ==")
+    hdr = (f"{'series':<34} {'lane':<7} {'req':>5} "
+           f"{'bytes/request':>14}  verdict")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for name, v in verdicts.items():
+        out.append(
+            f"{name:<34} {v['lane']:<7} {v['requests'] or 0:>5} "
+            f"{v['bytes_per_request']:>14,.1f}  {v['verdict']}"
+            + (" (zero-copy contract)" if v["expected_zero"] else "")
+        )
+        for flag in v["flags"]:
+            out.append(f"    {flag}")
+
+
 def metrics_section(events, out):
     snaps = [e for e in events if e.get("kind") == "metrics"]
     out.append("")
@@ -503,6 +537,19 @@ def main(argv=None):
                 f"{e.get('shape_class')} shapes on "
                 f"{e.get('device_kind')})"
             )
+        # a copied byte on the negotiated zero-copy serve path gates
+        # like a regression: the whole point of the shm lane is that
+        # steady-state serving copies NOTHING, and the budget is
+        # machine-checked from the serve_copy_budget evidence
+        # (docs/SERVING.md §copy accounting)
+        copy_bad = {
+            n: v for n, v in trend.analyze_copy_budget(events).items()
+            if v["verdict"] == "copy_regression"
+        }
+        for name, v in copy_bad.items():
+            print(f"{name}: copy_regression")
+            for flag in v["flags"]:
+                print(f"  {flag}")
         # validated (non-fake) bus-bw scaling series gate exactly like
         # bench trends — the paper's multi-chip headline must not be
         # the one layer that can regress silently
@@ -533,9 +580,11 @@ def main(argv=None):
             f"{len(corrupt)} confirmed output-integrity failure(s), "
             f"{len(breaches)} confirmed SLO breach(es), "
             f"{len(scaling_bad)} scaling regression(s), "
+            f"{len(copy_bad)} copy-budget regression(s), "
             f"{len(below_eff)} below-scaling-efficiency (non-gating)"
         )
-        return 1 if bad or corrupt or breaches or scaling_bad else 0
+        return 1 if (bad or corrupt or breaches or scaling_bad
+                     or copy_bad) else 0
 
     if roofline_only:
         out = []
@@ -547,6 +596,10 @@ def main(argv=None):
     events, _bad = _journal.load_events(journal_paths)
     scaling_analysis = trend.analyze_scaling_repo(root, eps=eps)
     scaling_bad = _scaling.gating_findings(scaling_analysis)
+    copy_bad = {
+        n: v for n, v in trend.analyze_copy_budget(events).items()
+        if v["verdict"] == "copy_regression"
+    }
     trend_section(verdicts, out)
     roofline_section(verdicts, out)
     span_section(events, out)
@@ -555,13 +608,15 @@ def main(argv=None):
     integrity_section(events, out)
     slo_section(out)
     scaling_section(scaling_analysis, out)
+    copy_section(events, out)
     metrics_section(events, out)
     out.append("")
-    if bad or scaling_bad:
+    if bad or scaling_bad or copy_bad:
         out.append(
             "VERDICT: " + "; ".join(
                 f"{n} {v['verdict']}"
-                for n, v in {**bad, **scaling_bad}.items()
+                for n, v in {**bad, **scaling_bad,
+                             **copy_bad}.items()
             )
         )
     else:
@@ -574,7 +629,7 @@ def main(argv=None):
             )
         )
     print("\n".join(out))
-    return 1 if bad or scaling_bad else 0
+    return 1 if bad or scaling_bad or copy_bad else 0
 
 
 if __name__ == "__main__":

@@ -112,11 +112,18 @@ def _control(socket_path, op, worker):
 
 def _stats_line(stats) -> str:
     buckets = stats.get("buckets") or []
+    # copied/window: the zero-copy + continuous-batching evidence a
+    # fleet operator reads here instead of the journal — copied stays
+    # 0 while the shm warm path is engaged, window collapses to 0ms
+    # when the daemon idles (docs/SERVING.md)
     return (f"served={stats.get('served')} "
             f"rejected={stats.get('rejected')} "
             f"requeued={stats.get('requeued')} "
             f"depth={stats.get('depth')}/{stats.get('queue_max')} "
             f"inflight={stats.get('inflight')} "
+            f"copied={stats.get('bytes_copied')}B "
+            f"window={stats.get('batch_window_ms')}ms "
+            f"lanes={','.join(stats.get('lanes') or ['inline'])} "
             f"buckets={len(buckets)}"
             + (f" [{', '.join(buckets)}]" if buckets else ""))
 
@@ -398,6 +405,8 @@ def _fleet_status() -> int:
     print(f"serve_ctl: fleet UP - router pid {stats.get('pid')}, "
           f"routed={stats.get('routed')} spilled={stats.get('spilled')}"
           f" throttled={stats.get('throttled')} "
+          f"relayed={stats.get('bytes_copied')}B "
+          f"lanes={','.join(stats.get('lanes') or ['inline'])} "
           f"device={stats.get('device_kind')} "
           f"uptime={stats.get('uptime_s')}s")
     rows = stats.get("workers") or []

@@ -99,7 +99,12 @@ _DTYPES = {
 # sharing a connection would interleave frames and cross-deliver
 # responses), rebuilt when the knob changes; any transport failure
 # falls back to the in-process registry.dispatch path (retained by
-# contract) with one stderr note.
+# contract) with one stderr note. Payload lanes ride the client's
+# ping-time negotiation (docs/SERVING.md §wire format): against a
+# daemon that advertises shm, operands at or over
+# TPK_SERVE_SHM_MIN_BYTES move through /dev/shm segments instead of
+# the socket — the C driver's big buffers stop being copied per hop —
+# and against anything else the inline lane works unchanged.
 import threading as _threading
 
 _SERVE_TLS = _threading.local()  # .client: this thread's ServeClient

@@ -345,6 +345,53 @@ def _roofline_check(metric, latest):
     }
 
 
+def analyze_copy_budget(events) -> dict:
+    """Zero-copy wire-path verdicts over the ``serve_copy_budget``
+    journal events ``loadgen --serve`` stamps (docs/SERVING.md §copy
+    accounting). The budget is ABSOLUTE, not a time series, so only
+    the latest event per (socket, lane) is judged: a run stamped
+    ``expected_zero`` — the shm lane fully negotiated, every operand
+    staged, every response under the threshold — that still copied
+    payload bytes is a ``copy_regression`` and gates in
+    ``obs_report --check`` exactly like a bench regression. Inline
+    runs are ``ok`` with their per-request byte count reported: the
+    inline lane is O(tensor) by construction, its budget is the lane
+    choice itself."""
+    latest = {}
+    for e in events:
+        if e.get("kind") == "serve_copy_budget":
+            latest[(str(e.get("socket")), str(e.get("lane")))] = e
+    verdicts = {}
+    for (sock, lane), e in sorted(latest.items()):
+        bpr = e.get("bytes_per_request") or 0
+        # gate on the RAW delta, not the per-request rounding: a few
+        # copied bytes over thousands of requests round to 0.0/req
+        # but still break the zero-copy contract
+        raw = e.get("daemon_bytes_copied")
+        copied = raw if _is_measurement(raw) else bpr
+        name = f"copy/{lane}[{os.path.basename(sock)}]"
+        flags = []
+        if e.get("expected_zero") and copied > 0:
+            verdict = "copy_regression"
+            flags.append(
+                f"COPY REGRESSION: {copied} payload byte(s) copied "
+                f"({bpr}/request) on a fully-negotiated shm run over "
+                f"{e.get('requests')} request(s) - the zero-copy "
+                "warm path is no longer zero"
+            )
+        else:
+            verdict = "ok"
+        verdicts[name] = {
+            "verdict": verdict,
+            "lane": lane,
+            "bytes_per_request": bpr,
+            "requests": e.get("requests"),
+            "expected_zero": bool(e.get("expected_zero")),
+            "flags": flags,
+        }
+    return verdicts
+
+
 def analyze_repo(root, eps=CEILING_EPS) -> dict:
     """One-call path for tools: series + baseline + verdicts."""
     return analyze(load_series(root), load_baseline(root), eps=eps)

@@ -183,6 +183,29 @@ def bucket_for(kernel: str, arrays, statics: dict):
     return spec, pad_frac
 
 
+def spec_stubs(specs):
+    """Allocation-free operand stand-ins built from wire arg specs
+    (``[{"shape", "dtype"}, ...]``) — zero-stride broadcasts with the
+    right shape/dtype/size, enough for :func:`bucket_for` /
+    :func:`bucket_id` which only read layout. How the fleet router
+    hashes a request without touching (or even receiving) its payload
+    bytes: with the shm lane the tensors never pass through the
+    front-end at all. Malformed specs raise ``ValueError``/
+    ``TypeError`` — the router's bad-request surface."""
+    from tpukernels.serve import protocol
+
+    out = []
+    for spec in specs or ():
+        name = spec.get("dtype")
+        if name not in protocol.DTYPES:
+            raise ValueError(f"unsupported dtype {name!r} in spec")
+        shape = tuple(int(d) for d in spec.get("shape", ()))
+        out.append(
+            np.broadcast_to(np.zeros((), protocol.DTYPES[name]), shape)
+        )
+    return out
+
+
 def _consistent(kernel: str, arrays) -> bool:
     """Cross-operand shape agreement for multi-operand kernels — the
     constraints ``registry.dispatch`` itself would enforce. Only
@@ -199,21 +222,39 @@ def _consistent(kernel: str, arrays) -> bool:
     return True  # single-data-operand kernels
 
 
-def pad_args(kernel: str, spec, arrays):
+def pad_args(kernel: str, spec, arrays, pool=None):
     """Zero-pad the request's operands up to the avatar shapes.
     Returns ``(padded_arrays, meta)`` — ``meta`` carries what
     :func:`unpad_outputs` needs (native shapes + the data-arg pad
-    count for the hist0 correction)."""
+    count for the hist0 correction) plus ``copied_bytes``, the
+    staging bytes this call copied (the serve daemon's
+    ``serve.bytes_copied`` evidence).
+
+    ``pool`` (a per-bucket ``{arg_index: buffer}`` dict) reuses the
+    avatar-shaped staging buffers across requests so the warm padded
+    path allocates nothing — the CALLER must serialize access (the
+    daemon holds the bucket lock)."""
     want = _spec_args(spec)
     padded, orig_shapes = [], []
-    for a, (dtype, shape) in zip(arrays, want):
+    copied = 0
+    for i, (a, (dtype, shape)) in enumerate(zip(arrays, want)):
         a = np.asarray(a)
         orig_shapes.append(tuple(a.shape))
         if tuple(a.shape) == shape:
             padded.append(a)
             continue
-        buf = np.zeros(shape, dtype=a.dtype)
+        buf = pool.get(i) if pool is not None else None
+        if (buf is None or buf.shape != shape
+                or buf.dtype != a.dtype):
+            buf = np.zeros(shape, dtype=a.dtype)
+            if pool is not None:
+                pool[i] = buf
+        else:
+            # reused staging buffer: re-zero before the copy so the
+            # previous request's longer operand cannot bleed through
+            buf[...] = 0
         buf[tuple(slice(0, d) for d in a.shape)] = a
+        copied += int(a.nbytes)
         padded.append(buf)
     data_pad = 0
     for a, (dtype, shape) in zip(arrays, want):
@@ -223,7 +264,8 @@ def pad_args(kernel: str, spec, arrays):
             )
             break
     return padded, {"orig_shapes": orig_shapes, "data_pad": data_pad,
-                    "rule": PAD_RULES.get(kernel)}
+                    "rule": PAD_RULES.get(kernel),
+                    "copied_bytes": copied}
 
 
 def unpad_outputs(kernel: str, meta, outputs):

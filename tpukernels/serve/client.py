@@ -30,6 +30,7 @@ import time
 import numpy as np
 
 from tpukernels import _cachedir
+from tpukernels.obs import metrics as obs_metrics
 from tpukernels.serve import protocol
 
 
@@ -89,7 +90,20 @@ class ServeClient:
     """One connection, one outstanding request at a time (the
     protocol's pipelining contract). Connects lazily and reconnects
     after transport errors; not thread-safe — give each client thread
-    its own instance."""
+    its own instance.
+
+    Payload lanes (docs/SERVING.md §wire format): the first dispatch
+    on a connection negotiates via a ping — a server advertising
+    ``shm`` in its ``lanes`` gets operands at or over
+    ``TPK_SERVE_SHM_MIN_BYTES`` written straight into ``/dev/shm``
+    segments (unlinked once the response arrives) and may answer the
+    same way (this client maps, copies out, and unlinks immediately
+    — the receiver-unlinks contract). Everything else — old servers,
+    hosts without ``/dev/shm``, ``TPK_SERVE_SHM=0`` — stays on the
+    inline lane unchanged. ``inline_payloads``/``staged_payloads``/
+    ``bytes_copied`` expose this side's lane traffic (mirrored into
+    ``serve.bytes_copied.<kernel>``) so loadgen can stamp the
+    copy-budget evidence."""
 
     def __init__(self, socket_path=None, timeout_s=None,
                  tenant=None, priority=None):
@@ -104,6 +118,10 @@ class ServeClient:
         self.priority = priority
         self._sock = None
         self._rid = 0
+        self._lanes = None      # negotiated at ping time; None=unknown
+        self.inline_payloads = 0
+        self.staged_payloads = 0
+        self.bytes_copied = 0
 
     # ---------------------------------------------------------- #
     # transport                                                  #
@@ -129,6 +147,9 @@ class ServeClient:
             except OSError:
                 pass
             self._sock = None
+        # a reconnect may land on a restarted (or different) server:
+        # renegotiate lanes rather than trust a stale advertisement
+        self._lanes = None
 
     def __enter__(self):
         return self
@@ -138,9 +159,12 @@ class ServeClient:
         return False
 
     def _roundtrip(self, header, payloads=()):
+        """One frame out, one frame back; returns ``(header, payloads,
+        sent_inline_bytes)`` — the send-side copy accounting rides
+        along so :meth:`dispatch` can attribute it per kernel."""
         sock = self._connected()
         try:
-            protocol.send_frame(sock, header, payloads)
+            sent = protocol.send_frame(sock, header, payloads)
             frame = protocol.recv_frame(sock)
         except (OSError, protocol.ProtocolError):
             self.close()  # poisoned stream: next call reconnects
@@ -150,7 +174,7 @@ class ServeClient:
             raise protocol.ProtocolError(
                 "daemon hung up before answering"
             )
-        return frame
+        return frame[0], frame[1], sent
 
     # ---------------------------------------------------------- #
     # operations                                                 #
@@ -158,10 +182,16 @@ class ServeClient:
 
     def ping(self) -> dict:
         """Liveness + stats (pid, served/rejected/requeued counts,
-        queue depth, device_kind, jax version)."""
-        header, _payloads = self._roundtrip(
+        queue depth, device_kind, jax version) — and the lane
+        negotiation point: the pong's ``lanes`` (absent on old
+        servers = inline only) decides whether later dispatches may
+        use the shm lane."""
+        header, _payloads, _sent = self._roundtrip(
             {"v": protocol.VERSION, "op": "ping"}
         )
+        lanes = header.get("lanes")
+        self._lanes = ([str(x) for x in lanes]
+                       if isinstance(lanes, list) else ["inline"])
         return header
 
     def dispatch(self, kernel: str, *args, **statics):
@@ -171,6 +201,11 @@ class ServeClient:
         when the daemon bucketed it."""
         arrays = [np.asarray(a) for a in args]
         specs, payloads = protocol.pack_arrays(arrays)
+        use_shm = False
+        if protocol.shm_enabled():
+            if self._lanes is None:
+                self.ping()  # negotiate once per connection
+            use_shm = "shm" in (self._lanes or ())
         self._rid += 1
         req = {"v": protocol.VERSION, "op": "dispatch",
                "id": self._rid, "kernel": kernel, "statics": statics,
@@ -179,7 +214,30 @@ class ServeClient:
             req["tenant"] = self.tenant
         if self.priority is not None:
             req["priority"] = self.priority
-        header, out_payloads = self._roundtrip(req, payloads)
+        segs: list = []
+        if use_shm:
+            req["shm_ok"] = True  # the server may answer via shm too
+            try:
+                descs, wire, segs, _staged = (
+                    protocol.stage_shm_payloads(payloads)
+                )
+            except OSError:
+                descs = None  # exhausted /dev/shm: inline still works
+            if descs is not None:
+                req["_shm"] = descs
+                payloads = wire
+        try:
+            header, out_payloads, sent = self._roundtrip(req, payloads)
+        finally:
+            # request-segment lifecycle: the creator unlinks once the
+            # round trip is over (the worker mapped them, or never
+            # will) — crash windows are covered by the daemon's
+            # dead-creator sweep
+            for seg in segs:
+                seg.close()
+                seg.unlink()
+        self._count(kernel, sent,
+                    inline=len(payloads), staged=len(segs))
         if not header.get("ok"):
             msg = header.get("error") or "daemon error"
             if header.get("kind") == "overloaded":
@@ -187,7 +245,37 @@ class ServeClient:
                     msg, float(header.get("retry_after_s") or 0.1)
                 )
             raise ServeError(msg)
+        resp_descs = [d for d in (header.get("_shm") or ()) if d]
+        out_payloads, resp_inline, maps = (
+            protocol.resolve_shm_payloads(header, out_payloads)
+        )
+        self._count(kernel, resp_inline)
         outs = protocol.unpack_arrays(
             header.get("outputs") or [], out_payloads
         )
+        if maps:
+            # receiver-unlinks contract: copy the results out of the
+            # server's response segments, then free + unlink them NOW
+            # (the returned arrays must not pin shared memory)
+            outs = [np.array(o) for o in outs]
+            del out_payloads
+            for mm in maps:
+                try:
+                    mm.close()
+                except BufferError:
+                    pass
+            for d in resp_descs:
+                protocol.unlink_shm(d.get("name"))
         return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def _count(self, kernel: str, nbytes: int, inline: int = 0,
+               staged: int = 0):
+        """Client-side half of the copy accounting: inline payload
+        bytes through the socket, mirrored into the same
+        ``serve.bytes_copied.<kernel>`` counter the daemon keeps —
+        every layer's number is its own socket traffic."""
+        self.inline_payloads += inline
+        self.staged_payloads += staged
+        if nbytes:
+            self.bytes_copied += nbytes
+            obs_metrics.inc(f"serve.bytes_copied.{kernel}", nbytes)

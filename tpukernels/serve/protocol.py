@@ -25,6 +25,30 @@ Responses:
     {"v": 1, "id": 7, "ok": false, "error": "...",
      "kind": "overloaded", "retry_after_s": 0.25}
 
+Two payload lanes (docs/SERVING.md §wire format):
+
+- **inline** — payload bytes ride the socket after the header, split
+  by ``_lens``. Every byte crosses the kernel socket buffer twice
+  (send + recv), which is what ``serve.bytes_copied`` counts; the
+  user-space side is zero-copy both ways (buffers stream through
+  ``sendall`` as memoryviews, and :func:`recv_frame` hands back
+  memoryview slices of one recv blob).
+- **shm** — a payload at or over ``TPK_SERVE_SHM_MIN_BYTES`` moves
+  through a named ``/dev/shm`` segment the sender writes and the
+  receiver maps read-only; only ``{"name", "nbytes"}`` rides the
+  header (``_shm``, one slot per payload, null = inline). Negotiated
+  at ping time (``lanes`` in the pong): a peer that never advertises
+  ``shm`` is spoken to inline forever, so old servers and mapping-
+  incapable clients keep working unchanged. Raw files + ``mmap``
+  rather than ``multiprocessing.shared_memory`` on purpose: no
+  resource-tracker side effects in either process, and the reader
+  needs only two syscalls. Lifecycle contract: request segments are
+  created AND unlinked by the client (after its response arrives);
+  response segments are created by the server and unlinked by the
+  client as soon as it maps them (the server keeps an aged ledger and
+  a start-time dead-creator sweep for the crash windows) — see
+  docs/SERVING.md §shm lifecycle.
+
 The module is transport-math only — no sockets are created here, no
 jax is imported, and the dtype table is exactly the C ABI's
 (``capi._DTYPES``): the serve daemon is one more consumer of the same
@@ -33,7 +57,11 @@ two-dtype contract, not a new one.
 
 from __future__ import annotations
 
+import itertools
 import json
+import mmap
+import os
+import re
 import struct
 
 import numpy as np
@@ -47,18 +75,352 @@ _PREAMBLE = struct.Struct(">4sIQ")
 MAX_HEADER = 1 << 20
 MAX_PAYLOAD = 1 << 32
 
+# at or under this many payload bytes, one syscall (head + payloads
+# joined) beats streaming buffers separately; over it, buffers stream
+# as-is so no user-space frame copy is ever materialized
+SMALL_FRAME = 1 << 16
+
 # the C ABI's dtype surface (capi._DTYPES), by canonical numpy name
 DTYPES = {
     "float32": np.float32,
     "int32": np.int32,
 }
 
+# ------------------------------------------------------------------ #
+# shm lane plumbing                                                  #
+# ------------------------------------------------------------------ #
+
+SHM_DIR = "/dev/shm"
+DEFAULT_SHM_MIN_BYTES = 1 << 16
+
+# creator pid is IN the name: leak-on-crash cleanup needs nothing but
+# a directory listing and a kill -0 (sweep_stale_segments)
+_SHM_NAME_RE = re.compile(r"^tpkserve-(\d+)-\d+-[0-9a-f]+$")
+_SHM_SEQ = itertools.count()
+_SHM_PROBE: list = []  # memoized shm_available() verdict
+
 
 class ProtocolError(Exception):
     """The stream is not speaking this protocol (bad magic, absurd
-    lengths, truncated frame, unknown dtype). Callers must treat the
-    connection as poisoned — there is no resync."""
+    lengths, truncated frame, unknown dtype, torn shm segment).
+    Callers must treat the connection as poisoned — there is no
+    resync."""
 
+
+def _view(p) -> memoryview:
+    """A flat byte view of one payload buffer — no copy for bytes /
+    bytearray / C-contiguous arrays, which is every payload the
+    serving stack produces (``pack_arrays`` canonicalizes)."""
+    m = p if isinstance(p, memoryview) else memoryview(p)
+    if m.format != "B" or m.ndim != 1:
+        m = m.cast("B")
+    return m
+
+
+def shm_min_bytes() -> int:
+    """``TPK_SERVE_SHM_MIN_BYTES`` (default 64 KiB), fail-loud parse:
+    below it, one inline syscall beats creating + mapping a segment."""
+    raw = os.environ.get("TPK_SERVE_SHM_MIN_BYTES")
+    if raw is None or not raw.strip():
+        return DEFAULT_SHM_MIN_BYTES
+    try:
+        val = int(raw)
+    except ValueError:
+        val = -1
+    if val < 0:
+        raise ValueError(
+            f"TPK_SERVE_SHM_MIN_BYTES={raw!r}: expected an int >= 0"
+        )
+    return val
+
+
+def shm_available() -> bool:
+    """Can this process create and map ``/dev/shm`` segments? Probed
+    once (create + map + unlink of a page) and memoized — the
+    negotiation predicate, not a knob."""
+    if not _SHM_PROBE:
+        try:
+            seg = ShmSegment(8)
+            try:
+                seg.write(b"\0" * 8)
+                mm = open_shm(seg.name, 8)
+                mm.close()
+            finally:
+                seg.close()
+                seg.unlink()
+            _SHM_PROBE.append(True)
+        except (OSError, ProtocolError, ValueError):
+            _SHM_PROBE.append(False)
+    return _SHM_PROBE[0]
+
+
+def shm_enabled() -> bool:
+    """The shm lane's routing predicate: ``TPK_SERVE_SHM`` not
+    switched off (``0``/``off``/``none``/``false``) AND the host can
+    actually map (:func:`shm_available`)."""
+    raw = os.environ.get("TPK_SERVE_SHM")
+    if raw is not None and raw.strip().lower() in (
+            "0", "off", "none", "false"):
+        return False
+    return shm_available()
+
+
+class ShmSegment:
+    """One creator-owned shared-memory segment: a raw ``/dev/shm``
+    file sized exactly ``nbytes``, mapped read-write by its creator.
+    The creator writes payload bytes in (:meth:`write`), ships only
+    ``{"name", "nbytes"}`` over the wire, and — per the lifecycle
+    contract in the module docstring — whoever the contract names
+    unlinks it; :meth:`unlink` after the fact is idempotent."""
+
+    __slots__ = ("name", "nbytes", "_mm")
+
+    def __init__(self, nbytes: int):
+        if nbytes <= 0 or nbytes > MAX_PAYLOAD:
+            raise ValueError(f"bad shm segment size {nbytes}")
+        self.nbytes = nbytes
+        fd = None
+        for _attempt in range(4):
+            name = (f"tpkserve-{os.getpid()}-{next(_SHM_SEQ)}-"
+                    f"{os.urandom(4).hex()}")
+            try:
+                fd = os.open(os.path.join(SHM_DIR, name),
+                             os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+                break
+            except FileExistsError:
+                continue
+        if fd is None:
+            raise OSError(f"cannot create an shm segment under {SHM_DIR}")
+        try:
+            # fallocate, not ftruncate: tmpfs truncation is sparse, so
+            # an exhausted /dev/shm would pass creation and SIGBUS the
+            # first write — allocation must fail HERE as ENOSPC so the
+            # caller's documented inline fallback can fire
+            os.posix_fallocate(fd, 0, nbytes)
+            self._mm = mmap.mmap(fd, nbytes)
+        except BaseException:
+            # never leak the file: the dead-pid sweep skips segments
+            # whose creator (us) is alive
+            with_err = os.path.join(SHM_DIR, name)
+            try:
+                os.unlink(with_err)
+            except OSError:
+                pass
+            raise
+        finally:
+            os.close(fd)
+        self.name = name
+
+    def write(self, buf, offset: int = 0) -> int:
+        """Copy ``buf`` into the segment at ``offset``; returns the
+        byte count (the caller's ``serve.bytes_copied`` evidence —
+        staging an already-materialized buffer is a counted copy)."""
+        v = _view(buf)
+        self._mm[offset:offset + v.nbytes] = v
+        return v.nbytes
+
+    def close(self):
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass  # live numpy exports keep the mapping until GC
+
+    def unlink(self):
+        try:
+            os.unlink(os.path.join(SHM_DIR, self.name))
+        except OSError:
+            pass
+
+
+def open_shm(name, nbytes):
+    """Map one named segment read-only; returns the ``mmap`` (itself a
+    valid payload buffer for :func:`unpack_arrays`). Any defect — a
+    name outside the ``tpkserve-`` namespace, a missing file, a file
+    shorter than the header claims — is a TORN segment: the stream
+    that described it is desynced or hostile, so this raises
+    :class:`ProtocolError` and the connection dies, never the
+    daemon."""
+    if not isinstance(name, str) or not _SHM_NAME_RE.match(name):
+        raise ProtocolError(f"bad shm segment name {name!r}")
+    if (not isinstance(nbytes, int) or isinstance(nbytes, bool)
+            or nbytes <= 0 or nbytes > MAX_PAYLOAD):
+        raise ProtocolError(f"bad shm segment size {nbytes!r}")
+    try:
+        fd = os.open(os.path.join(SHM_DIR, name), os.O_RDONLY)
+    except OSError as e:
+        raise ProtocolError(f"torn shm segment {name}: {e}") from None
+    try:
+        if os.fstat(fd).st_size < nbytes:
+            raise ProtocolError(
+                f"torn shm segment {name}: file is "
+                f"{os.fstat(fd).st_size}B, header claims {nbytes}B"
+            )
+        try:
+            return mmap.mmap(fd, nbytes, prot=mmap.PROT_READ)
+        except (ValueError, OSError) as e:
+            raise ProtocolError(
+                f"torn shm segment {name}: {e}"
+            ) from None
+    finally:
+        os.close(fd)
+
+
+def unlink_shm(name) -> bool:
+    """Unlink one segment by name (idempotent; bad names are ignored
+    rather than trusted). The receiver-unlinks half of the response
+    lifecycle, and the failed-send cleanup hook."""
+    if not isinstance(name, str) or not _SHM_NAME_RE.match(name):
+        return False
+    try:
+        os.unlink(os.path.join(SHM_DIR, name))
+        return True
+    except OSError:
+        return False
+
+
+def sweep_stale_segments() -> int:
+    """Leak-on-crash cleanup: unlink every ``tpkserve-*`` segment
+    whose creator pid is dead (the name carries it). Run at daemon /
+    router start — a process that died between creating a segment and
+    its peer unlinking it can leak at most until the next start."""
+    try:
+        names = os.listdir(SHM_DIR)
+    except OSError:
+        return 0
+    removed = 0
+    for name in names:
+        m = _SHM_NAME_RE.match(name)
+        if not m:
+            continue
+        try:
+            os.kill(int(m.group(1)), 0)
+            continue            # creator alive: its lifecycle, not ours
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue            # EPERM: alive under another uid
+        try:
+            os.unlink(os.path.join(SHM_DIR, name))
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
+def stage_shm_payloads(payloads, min_bytes=None):
+    """Sender half of the shm lane: move every payload at or over the
+    threshold into a fresh segment. Returns ``(descs, wire_payloads,
+    segments, staged_bytes)`` — ``descs`` is the header's ``_shm``
+    list (one slot per payload, null = still inline) or None when
+    nothing crossed the threshold (the frame then has no shm marker
+    at all and an old receiver parses it untouched);
+    ``wire_payloads`` are the inline remainder in order; ``segments``
+    must outlive the round trip and be closed/unlinked per the
+    lifecycle contract; ``staged_bytes`` is the counted staging
+    copy."""
+    if min_bytes is None:
+        min_bytes = shm_min_bytes()
+    descs, wire, segs, staged = [], [], [], 0
+    try:
+        for p in payloads:
+            v = _view(p)
+            if v.nbytes >= max(1, min_bytes):
+                seg = ShmSegment(v.nbytes)
+                segs.append(seg)
+                staged += seg.write(v)
+                descs.append({"name": seg.name, "nbytes": v.nbytes})
+            else:
+                descs.append(None)
+                wire.append(v)
+    except (OSError, ValueError):
+        # a failed creation mid-list (exhausted /dev/shm) must not
+        # leak the segments already created — the caller falls back
+        # to the inline lane
+        for seg in segs:
+            seg.close()
+            seg.unlink()
+        raise
+    if not segs:
+        return None, wire, [], 0
+    return descs, wire, segs, staged
+
+
+def check_shm_descs(header, n_payloads: int):
+    """Structural validation of a frame's ``_shm`` against its arg
+    specs and inline payload count WITHOUT opening anything — the
+    fleet router's front-door check (docs/SERVING.md §fleet): a
+    malformed descriptor must die there as a bad request, not ride
+    upstream to poison worker connections and masquerade as
+    transport loss. Raises :class:`ProtocolError`; a frame with no
+    ``_shm`` passes untouched."""
+    descs = header.get("_shm")
+    if descs is None:
+        return
+    args = header.get("args") or []
+    if not isinstance(descs, list) or len(descs) != len(args):
+        raise ProtocolError(
+            f"malformed _shm: expected {len(args)} slot(s), "
+            f"got {descs!r}"
+        )
+    inline = 0
+    for d in descs:
+        if d is None:
+            inline += 1
+            continue
+        if not (isinstance(d, dict)
+                and isinstance(d.get("name"), str)
+                and _SHM_NAME_RE.match(d["name"])
+                and isinstance(d.get("nbytes"), int)
+                and not isinstance(d.get("nbytes"), bool)
+                and 0 < d["nbytes"] <= MAX_PAYLOAD):
+            raise ProtocolError(f"malformed _shm slot {d!r}")
+    if inline != n_payloads:
+        raise ProtocolError(
+            f"_shm leaves {inline} inline payload(s) but the frame "
+            f"carries {n_payloads}"
+        )
+
+
+def resolve_shm_payloads(header, payloads):
+    """Receiver half: splice mapped segments back into payload order.
+    Pops ``_shm`` from ``header`` and returns ``(full_payloads,
+    inline_bytes, maps)`` — ``maps`` are the read-only mmaps backing
+    the spliced entries (kept alive by the numpy views
+    :func:`unpack_arrays` builds over them; freed by refcount once
+    the arrays die). A malformed ``_shm`` or a torn segment raises
+    :class:`ProtocolError` — the poisoned-connection contract."""
+    descs = header.pop("_shm", None)
+    inline_bytes = sum(len(p) for p in payloads)
+    if descs is None:
+        return list(payloads), inline_bytes, []
+    if not isinstance(descs, list):
+        raise ProtocolError(f"malformed _shm {descs!r}")
+    full, maps = [], []
+    it = iter(payloads)
+    try:
+        for d in descs:
+            if d is None:
+                full.append(next(it))
+                continue
+            if not isinstance(d, dict):
+                raise ProtocolError(f"malformed _shm slot {d!r}")
+            mm = open_shm(d.get("name"), d.get("nbytes"))
+            maps.append(mm)
+            full.append(mm)
+    except StopIteration:
+        raise ProtocolError(
+            "_shm names fewer inline payloads than the frame carries"
+        ) from None
+    if next(it, None) is not None:
+        raise ProtocolError(
+            "frame carries inline payloads _shm does not account for"
+        )
+    return full, inline_bytes, maps
+
+
+# ------------------------------------------------------------------ #
+# framing                                                            #
+# ------------------------------------------------------------------ #
 
 def _recv_exact(sock, n: int) -> bytes:
     """Read exactly ``n`` bytes or raise — a short read mid-frame is a
@@ -75,36 +437,43 @@ def _recv_exact(sock, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def send_frame(sock, header: dict, payloads=()) -> None:
+def send_frame(sock, header, payloads=()) -> int:
     """Serialize one frame onto ``sock``. ``payloads`` is a sequence
-    of bytes-like buffers; their lengths are recorded in the wire
-    header (``_lens``) so :func:`recv_frame` can split the blob
-    without trusting the semantic fields."""
-    payloads = [bytes(p) for p in payloads]
+    of buffer-likes (bytes, memoryviews, contiguous arrays) streamed
+    as-is — no ``bytes()`` materialization on the send path; their
+    lengths are recorded in the wire header (``_lens``) so
+    :func:`recv_frame` can split the blob without trusting the
+    semantic fields. Returns the inline payload bytes pushed through
+    the socket — the send-side half of the ``serve.bytes_copied``
+    accounting (an shm-lane frame returns 0: only names ride the
+    wire)."""
+    views = [_view(p) for p in payloads]
     wire = dict(header)
-    wire["_lens"] = [len(p) for p in payloads]
+    wire["_lens"] = [v.nbytes for v in views]
     hb = json.dumps(wire, separators=(",", ":")).encode()
-    total = sum(len(p) for p in payloads)
+    total = sum(v.nbytes for v in views)
     if len(hb) > MAX_HEADER or total > MAX_PAYLOAD:
         raise ProtocolError(
             f"frame too large (header {len(hb)}B, payload {total}B)"
         )
     head = _PREAMBLE.pack(MAGIC, len(hb), total) + hb
-    if total <= (1 << 16):
-        # small frames: one syscall beats avoiding a tiny copy
-        sock.sendall(head + b"".join(payloads))
-        return
-    # multi-MB operand/output frames: send buffers as-is instead of
-    # materializing an extra full-frame copy on the hot path
+    if total <= SMALL_FRAME:
+        # small frames: one syscall beats avoiding a tiny join
+        sock.sendall(b"".join([head, *views]))
+        return total
+    # multi-MB operand/output frames: stream each buffer as-is — the
+    # kernel socket copy is the only byte-touching left on this path
     sock.sendall(head)
-    for p in payloads:
-        sock.sendall(p)
+    for v in views:
+        sock.sendall(v)
+    return total
 
 
 def recv_frame(sock):
-    """Read one frame; returns ``(header, [payload_bytes, ...])`` or
-    ``None`` on a clean EOF at a frame boundary (the peer hung up
-    between requests — not an error)."""
+    """Read one frame; returns ``(header, [payload_view, ...])`` —
+    payloads are zero-copy memoryview slices over the one received
+    blob — or ``None`` on a clean EOF at a frame boundary (the peer
+    hung up between requests — not an error)."""
     first = sock.recv(1)
     if not first:
         return None
@@ -132,7 +501,7 @@ def recv_frame(sock):
         raise ProtocolError(
             f"payload lengths {lens} disagree with frame total {total}"
         )
-    blob = _recv_exact(sock, total)
+    blob = memoryview(_recv_exact(sock, total))
     payloads, off = [], 0
     for n in lens:
         payloads.append(blob[off:off + n])
@@ -141,13 +510,16 @@ def recv_frame(sock):
 
 
 # ------------------------------------------------------------------ #
-# array <-> (spec, bytes)                                            #
+# array <-> (spec, buffer)                                           #
 # ------------------------------------------------------------------ #
 
 def pack_arrays(arrays):
-    """``([{"shape", "dtype"}, ...], [bytes, ...])`` for a sequence of
-    numpy arrays (0-d arrays carry host scalars — the dispatch memo's
-    canonicalization contract)."""
+    """``([{"shape", "dtype"}, ...], [buffer, ...])`` for a sequence
+    of numpy arrays (0-d arrays carry host scalars — the dispatch
+    memo's canonicalization contract). Payloads are memoryviews over
+    the arrays themselves — zero-copy for C-contiguous operands,
+    which is every array this stack produces (``ascontiguousarray``
+    canonicalizes the rest)."""
     specs, payloads = [], []
     for a in arrays:
         a = np.asarray(a)
@@ -158,14 +530,15 @@ def pack_arrays(arrays):
                 f"{sorted(DTYPES)}"
             )
         specs.append({"shape": list(a.shape), "dtype": name})
-        payloads.append(np.ascontiguousarray(a).tobytes())
+        payloads.append(_view(np.ascontiguousarray(a)))
     return specs, payloads
 
 
 def unpack_arrays(specs, payloads):
-    """Rebuild numpy arrays from specs + raw buffers; validates byte
-    counts so a desynced stream fails loudly, never reshapes
-    garbage."""
+    """Rebuild numpy arrays from specs + raw buffers (bytes,
+    memoryviews, or read-only shm mmaps — all zero-copy views);
+    validates byte counts so a desynced stream fails loudly, never
+    reshapes garbage."""
     if len(specs) != len(payloads):
         raise ProtocolError(
             f"{len(specs)} array spec(s) but {len(payloads)} payload(s)"

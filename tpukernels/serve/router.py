@@ -57,10 +57,16 @@ The routing disciplines, each CPU-chaos-proven (tests/test_fleet.py):
   traffic, so background load yields first.
 
 The router is deliberately **jax-free** (stdlib + numpy + the
-bucket table): it computes bucket keys from the request header and
-relays operand payloads verbatim — no device, no compile, nothing to
-wedge. Clean-path stdout is EMPTY (notes to stderr, evidence to the
-journal), like the worker daemon.
+bucket table): it computes bucket keys from the request header's arg
+SPECS alone (``bucketing.spec_stubs`` — it never reads a payload
+byte) and relays inline payloads verbatim — no device, no compile,
+nothing to wedge. On the shm lane (docs/SERVING.md §wire format) it
+relays only segment DESCRIPTORS: the client writes a tensor once
+into ``/dev/shm`` and the owning worker maps it, so the fleet
+front-end stops being O(tensor) entirely
+(``serve.bytes_copied.<kernel>`` counts what still crosses it
+inline). Clean-path stdout is EMPTY (notes to stderr, evidence to
+the journal), like the worker daemon.
 
 Run it: ``python -m tpukernels.serve.router --socket FRONT --worker
 W0.sock --worker W1.sock ...`` — or let ``tools/serve_ctl.py
@@ -164,9 +170,9 @@ class _Conn:
         self.sock = sock
         self.send_lock = threading.Lock()
 
-    def send(self, header, payloads=()):
+    def send(self, header, payloads=()) -> int:
         with self.send_lock:
-            protocol.send_frame(self.sock, header, payloads)
+            return protocol.send_frame(self.sock, header, payloads)
 
 
 class Router:
@@ -207,6 +213,13 @@ class Router:
         self._tenants: dict = {}             # tenant -> [tokens, last]
         self._meta = {"device_kind": None, "jax": None}
         self._meta_next_try = 0.0            # unresolved-meta rate limit
+        # lane advertisement relayed from the workers: the router
+        # itself never maps a segment — it forwards descriptors — but
+        # clients negotiate against the FRONT socket, so the pong must
+        # carry what the workers can do (docs/SERVING.md §wire format)
+        self._lanes_cache = None
+        self._shm_min_cache = None
+        self._bytes_copied = 0               # relayed inline payload B
         self._t0 = time.time()
         # fail-fast on a misconfigured bucket table, like the worker:
         # the router and its workers MUST shard on the same table
@@ -227,11 +240,14 @@ class Router:
         self._listener.bind(self.socket_path)
         self._listener.listen(128)
         self._listener.settimeout(0.5)
+        # the router is a start point too: reclaim segments whose
+        # creator died before its peer unlinked them
+        swept = protocol.sweep_stale_segments()
         journal.emit(
             "serve_start", role="router", socket=self.socket_path,
             workers=len(self.workers), worker_sockets=self.workers,
             tenant_rate=self.tenant_rate,
-            tenant_burst=self.tenant_burst,
+            tenant_burst=self.tenant_burst, shm_swept=swept,
         )
         try:
             while not self._stop.is_set():
@@ -323,6 +339,12 @@ class Router:
                 "routed": self._routed, "spilled": self._spilled,
                 "throttled": self._throttled,
                 "rejected": self._rejected,
+                # lane negotiation happens against the FRONT socket:
+                # relay what the workers advertised (None until one
+                # answered = clients stay inline, the safe default)
+                "lanes": self._lanes_cache or ["inline"],
+                "shm_min_bytes": self._shm_min_cache,
+                "bytes_copied": self._bytes_copied,
                 "uptime_s": round(time.time() - self._t0, 3),
                 # loadgen --serve stamps its verdicts with these —
                 # the fleet's device identity is its workers'
@@ -368,6 +390,18 @@ class Router:
             if not ok:
                 continue
             header = frame[0]
+            with self._lock:
+                if self._lanes_cache is None:
+                    # lanes are static per worker process — cache them
+                    # off the FIRST pong, before any dispatch resolves
+                    # device_kind, so a client's negotiation ping gets
+                    # an answer immediately
+                    lanes = header.get("lanes")
+                    self._lanes_cache = (
+                        [str(x) for x in lanes]
+                        if isinstance(lanes, list) else ["inline"]
+                    )
+                    self._shm_min_cache = header.get("shm_min_bytes")
             if header.get("device_kind") or header.get("jax"):
                 with self._lock:
                     self._meta = {
@@ -482,6 +516,16 @@ class Router:
             with self._lock:
                 self._inflight[idx] -= 1
 
+    def _count_copied(self, kernel: str, nbytes: int):
+        """Relayed inline payload bytes — the router's share of the
+        ``serve.bytes_copied`` story. Shm-lane requests relay only
+        descriptors, so the fleet front-end stops being O(tensor)."""
+        if not nbytes:
+            return
+        obs_metrics.inc(f"serve.bytes_copied.{kernel}", nbytes)
+        with self._lock:
+            self._bytes_copied += nbytes
+
     def _route(self, conn: _Conn, header: dict, payloads):
         rid = header.get("id")
 
@@ -489,7 +533,12 @@ class Router:
             try:
                 conn.send(h, p)
             except (OSError, protocol.ProtocolError):
-                pass  # client gone; the decision is journaled anyway
+                # client gone; the decision is journaled anyway — but
+                # a worker's response segments no one will ever map
+                # must not wait for its aged sweep
+                for d in (h.get("_shm") or ()):
+                    if isinstance(d, dict):
+                        protocol.unlink_shm(d.get("name"))
 
         tenant = header.get("tenant") or "-"
         priority = header.get("priority") or "interactive"
@@ -501,9 +550,16 @@ class Router:
                 )
             kernel = header["kernel"]
             statics = dict(header.get("statics") or {})
-            arrays = protocol.unpack_arrays(
-                header.get("args") or [], payloads
-            )
+            # layout-only stubs: routing needs shapes and dtypes, not
+            # data — the router never reads (with the shm lane, never
+            # even receives) a payload byte. Byte-count validation is
+            # the worker's unpack, one hop later.
+            arrays = bucketing.spec_stubs(header.get("args") or [])
+            # structural _shm validation at the front door: a
+            # malformed descriptor must be a bad request HERE, not a
+            # worker-side ProtocolError the spill logic would misread
+            # as transport loss against two healthy workers
+            protocol.check_shm_descs(header, len(payloads))
             spec, _how = bucketing.bucket_for(kernel, arrays, statics)
             bucket = bucketing.bucket_id(kernel, spec, statics, arrays)
         except (KeyError, ValueError, TypeError, AttributeError,
@@ -582,6 +638,14 @@ class Router:
             self._routed += 1
             self._routed_to[idx] += 1
         obs_metrics.inc("serve.routed")
+        # inline payload bytes this request made the router relay
+        # (request upstream + response downstream); an shm-lane
+        # request contributes 0 — only names crossed this process
+        self._count_copied(
+            kernel,
+            sum(len(p) for p in payloads)
+            + sum(len(p) for p in out_payloads),
+        )
         journal.emit(
             "serve_route", kernel=kernel, bucket=bucket, request=rid,
             worker=idx, tenant=tenant, priority=priority,

@@ -19,9 +19,24 @@ The service disciplines, each CPU-chaos-proven (tests/test_serve.py):
   per-kernel correctness rules) so a diverse client shape population
   collapses onto a handful of warm executables;
   ``serve.bucket_pad_frac`` makes the padding waste observable.
-- **Batching** — same-bucket requests arriving within
-  ``TPK_SERVE_BATCH_WINDOW_MS`` are coalesced to one worker and
-  served back-to-back on one warm executable (``serve.batch_size``).
+- **Continuous batching** — same-bucket requests are coalesced to one
+  worker and served back-to-back on one warm executable
+  (``serve.batch_size``). The coalescing window is ADAPTIVE
+  (``TPK_SERVE_BATCH_ADAPT``, on by default): it collapses to 0 the
+  moment the queue is empty — an idle request dispatches immediately
+  — and widens toward the ``TPK_SERVE_BATCH_WINDOW_MS`` cap under
+  burst, steered by the admission path's inter-arrival EWMA
+  (``serve.batch_window_ms`` gauges the live value).
+- **Zero-copy wire path** — payloads at or over
+  ``TPK_SERVE_SHM_MIN_BYTES`` ride ``/dev/shm`` segments the client
+  writes and this daemon maps read-only (negotiated at ping time;
+  inline remains for small tensors and old clients), response
+  payloads are written ONCE into segments of their own (the single
+  producer-to-consumer move every lane needs), and the per-bucket
+  pad staging buffers are reused across requests — the warm shm
+  path copies zero payload bytes beyond that handoff and allocates
+  no staging buffers (``serve.bytes_copied.<kernel>`` is the
+  machine-checked evidence; docs/SERVING.md §wire format).
 - **Admission control** — the request queue is bounded
   (``TPK_SERVE_QUEUE_MAX``); at depth, new requests are REJECTED
   immediately with a ``retry_after_s`` hint (``serve_rejected``)
@@ -72,6 +87,19 @@ DEFAULT_WORKERS = 2
 DEFAULT_BATCH_WINDOW_MS = 2.0
 DEFAULT_REQUEST_TIMEOUT_S = 60.0
 
+# adaptive batching aims to gather about this many same-bucket
+# requests per window under burst: the window widens to ~7 projected
+# inter-arrival gaps (capped by TPK_SERVE_BATCH_WINDOW_MS) and
+# collapses to 0 the moment the queue is empty, so an idle request
+# never pays the window (docs/SERVING.md §continuous batching)
+BATCH_TARGET = 8
+
+# response shm segments the client should have mapped-and-unlinked
+# long ago (its own socket timeout bounds the wait) are reclaimed by
+# the watchdog after this grace — the leak-on-crash backstop for a
+# client that died between our send and its map
+SHM_RESPONSE_GRACE_S = 120.0
+
 # kernel-level SO_SNDTIMEO on accepted sockets: a client that stops
 # READING (SIGSTOP'd, hung) would otherwise block a worker forever in
 # sendall once the response outgrows the socket buffer — invisibly to
@@ -110,16 +138,26 @@ def _float_knob(name: str, default: float, floor: float = 0.0) -> float:
     return val
 
 
+def _on_knob(name: str, default: bool = True) -> bool:
+    """An on-by-default switch knob (the TPK_AOT_CACHE convention):
+    ``0``/``off``/``none``/``false`` disable, anything else keeps the
+    default."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    return raw.strip().lower() not in ("0", "off", "none", "false")
+
+
 class _Request:
     """One in-flight dispatch request and its lifecycle state."""
 
     __slots__ = ("serial", "rid", "kernel", "statics", "arrays",
                  "spec", "pad_frac", "bucket", "conn", "t_enq",
                  "t_start", "requeues", "patience", "done", "lock",
-                 "worker_ident", "tenant")
+                 "worker_ident", "tenant", "shm_ok")
 
     def __init__(self, serial, rid, kernel, statics, arrays, spec,
-                 pad_frac, bucket, conn, tenant=None):
+                 pad_frac, bucket, conn, tenant=None, shm_ok=False):
         self.serial = serial  # server-side key: client ids can collide
         self.rid = rid
         self.kernel = kernel
@@ -130,6 +168,7 @@ class _Request:
         self.bucket = bucket
         self.conn = conn
         self.tenant = tenant
+        self.shm_ok = shm_ok       # client negotiated the shm lane
         self.t_enq = time.perf_counter()
         self.t_start = None
         self.requeues = 0
@@ -154,15 +193,18 @@ class _Conn:
     requests while the reader thread may be rejecting the client's
     next one — frames must never interleave on the wire."""
 
-    __slots__ = ("sock", "send_lock")
+    __slots__ = ("sock", "send_lock", "lane_logged")
 
     def __init__(self, sock):
         self.sock = sock
         self.send_lock = threading.Lock()
+        self.lane_logged = False   # serve_lane_negotiated once per conn
 
-    def send(self, header, payloads=()):
+    def send(self, header, payloads=()) -> int:
+        """Returns the inline payload bytes pushed through the socket
+        (``send_frame``'s copy accounting)."""
         with self.send_lock:
-            protocol.send_frame(self.sock, header, payloads)
+            return protocol.send_frame(self.sock, header, payloads)
 
 
 class _BoundedQueue:
@@ -241,6 +283,21 @@ class Server:
         self._requeued = 0
         self._t0 = time.time()
         self._service_ewma = 0.05           # retry-after hint basis
+        # continuous batching: the admission path tracks an
+        # inter-arrival EWMA (fast attack — one short gap IS a burst —
+        # slow release) the coalescing window is derived from
+        self.batch_adapt = _on_knob("TPK_SERVE_BATCH_ADAPT")
+        self._arrival_ewma = None
+        self._last_arrival = None
+        self._last_window_ms = 0.0
+        # zero-copy wire path: lane capability + copy accounting
+        # (knobs validated here so a typo refuses to start the daemon,
+        # the TPK_SERVE_BUCKETS fail-fast rule)
+        self._shm = protocol.shm_enabled()
+        self._shm_min = protocol.shm_min_bytes()
+        self._bytes_copied = 0
+        self._shm_ledger: list = []         # (name, t) response segs
+        self._pad_pool: dict = {}           # bucket -> {arg_i: buf}
         self._device_kind = None            # resolved by 1st dispatch
         # fail-fast: a misconfigured TPK_SERVE_BUCKETS (typo'd path,
         # malformed JSON) must refuse to start the daemon, not surface
@@ -265,11 +322,16 @@ class Server:
         self._listener.bind(self.socket_path)
         self._listener.listen(64)
         self._listener.settimeout(0.5)
+        # leak-on-crash cleanup (docs/SERVING.md §shm lifecycle):
+        # segments whose creator died before its peer unlinked them
+        swept = protocol.sweep_stale_segments()
         journal.emit(
             "serve_start", socket=self.socket_path,
             queue_max=self.queue_max, workers=self.workers,
             batch_window_ms=round(self.batch_window_s * 1e3, 3),
+            batch_adapt=self.batch_adapt,
             request_timeout_s=self.request_timeout_s,
+            lanes=self._lanes(), shm_swept=swept,
         )
         for _ in range(self.workers):
             self._spawn_worker()
@@ -335,7 +397,21 @@ class Server:
                     conn.send(dict(self._stats(), v=protocol.VERSION,
                                    ok=True))
                 elif op == "dispatch":
-                    self._admit(conn, header, payloads)
+                    # shm resolution happens HERE, not in _admit: a
+                    # torn segment is a desynced/hostile stream and
+                    # must poison this CONNECTION (the ProtocolError
+                    # contract), never become a per-request error
+                    payloads, inline_bytes, shm_maps = (
+                        protocol.resolve_shm_payloads(header, payloads)
+                    )
+                    if shm_maps and not conn.lane_logged:
+                        conn.lane_logged = True
+                        journal.emit("serve_lane_negotiated",
+                                     lane="shm",
+                                     kernel=header.get("kernel"),
+                                     request=header.get("id"))
+                    self._admit(conn, header, payloads,
+                                inline_bytes=inline_bytes)
                 else:
                     conn.send({"v": protocol.VERSION,
                                "id": header.get("id"), "ok": False,
@@ -363,6 +439,16 @@ class Server:
             "inflight": inflight, "buckets": buckets,
             "worker_id": os.environ.get("TPK_SERVE_WORKER_ID"),
             "queue_max": self.queue_max, "workers": self.workers,
+            # lane negotiation (docs/SERVING.md §wire format): a
+            # client enables shm ONLY after seeing it advertised here,
+            # so an old server (no "lanes" key) is spoken to inline
+            "lanes": self._lanes(),
+            "shm_min_bytes": self._shm_min if self._shm else None,
+            # the zero-copy + continuous-batching evidence operators
+            # read off `serve_ctl status` without opening the journal
+            "bytes_copied": self._bytes_copied,
+            "batch_window_ms": self._last_window_ms,
+            "batch_adapt": self.batch_adapt,
             "uptime_s": round(time.time() - self._t0, 3),
             # report-only, like jax below: a liveness ping must never
             # force backend init in the reader thread (None until the
@@ -377,8 +463,36 @@ class Server:
         mod = sys.modules.get("jax")
         return getattr(mod, "__version__", None)
 
-    def _admit(self, conn: _Conn, header: dict, payloads):
+    def _lanes(self) -> list:
+        return ["inline", "shm"] if self._shm else ["inline"]
+
+    def _count_copied(self, kernel: str, nbytes: int):
+        """One process-wide + one per-kernel bytes-copied bump — the
+        counter the copy-budget smoke regresses (docs/SERVING.md
+        §copy accounting)."""
+        if not nbytes:
+            return
+        obs_metrics.inc(f"serve.bytes_copied.{kernel}", nbytes)
+        with self._lock:
+            self._bytes_copied += nbytes
+
+    def _admit(self, conn: _Conn, header: dict, payloads,
+               inline_bytes: int = 0):
         rid = header.get("id")
+        now = time.perf_counter()
+        with self._lock:
+            # inter-arrival EWMA: fast attack (one short gap IS a
+            # burst — the window must widen on the second arrival, not
+            # the tenth), slow release back toward idle
+            if self._last_arrival is not None:
+                gap = now - self._last_arrival
+                if (self._arrival_ewma is None
+                        or gap < self._arrival_ewma):
+                    self._arrival_ewma = gap
+                else:
+                    self._arrival_ewma = (0.8 * self._arrival_ewma
+                                          + 0.2 * gap)
+            self._last_arrival = now
         try:
             kernel = header["kernel"]
             statics = dict(header.get("statics") or {})
@@ -398,12 +512,17 @@ class Server:
             conn.send({"v": protocol.VERSION, "id": rid, "ok": False,
                        "kind": "error", "error": f"bad request: {e}"})
             return
+        # the request's inline payload bytes crossed the socket — the
+        # recv-side half of the copy accounting (an shm-lane request
+        # counts 0 here: the worker maps what the client wrote)
+        self._count_copied(kernel, inline_bytes)
         with self._lock:
             self._next_rid += 1
             serial = self._next_rid
         req = _Request(serial, rid if rid is not None else serial,
                        kernel, statics, arrays, spec, pad_frac,
-                       bucket, conn, tenant=header.get("tenant"))
+                       bucket, conn, tenant=header.get("tenant"),
+                       shm_ok=bool(header.get("shm_ok")))
         try:
             self._q.put_nowait(req)
         except _queue_mod.Full:
@@ -440,9 +559,13 @@ class Server:
             first = self._q.get(timeout=0.5)
             if first is None:
                 continue
+            window = self._window_s(self._q.depth())
+            self._last_window_ms = round(window * 1e3, 3)
+            obs_metrics.gauge("serve.batch_window_ms",
+                              self._last_window_ms)
             batch = [first]
-            if self.batch_window_s > 0:
-                deadline = time.perf_counter() + self.batch_window_s
+            if window > 0:
+                deadline = time.perf_counter() + window
                 while True:
                     batch.extend(self._q.take_matching(
                         first.bucket, self.queue_max - len(batch)
@@ -496,6 +619,24 @@ class Server:
                     # requeued whatever was left in `pending`
                     return
 
+    def _window_s(self, depth: int) -> float:
+        """The continuous-batching coalescing window for one pickup.
+        Fixed mode (``TPK_SERVE_BATCH_ADAPT=0``) returns the knob
+        verbatim. Adaptive mode: an EMPTY queue means the request is
+        alone — dispatch NOW, idle traffic never pays the window; a
+        non-empty queue under burst (inter-arrival EWMA shorter than
+        the max window) widens to ~``BATCH_TARGET`` projected
+        arrivals, capped at ``TPK_SERVE_BATCH_WINDOW_MS``; arrivals
+        slower than the cap mean waiting buys nothing — 0 again."""
+        if not self.batch_adapt:
+            return self.batch_window_s
+        if depth <= 0:
+            return 0.0
+        gap = self._arrival_ewma
+        if gap is None or gap >= self.batch_window_s:
+            return 0.0
+        return min(self.batch_window_s, gap * (BATCH_TARGET - 1))
+
     def _retire_if_abandoned(self) -> bool:
         """True when the watchdog abandoned THIS worker — and forget
         its ident on the way out: thread idents are recycled after
@@ -546,6 +687,12 @@ class Server:
                     self._bucket_locks[bucket] = [
                         threading.Lock(), None
                     ]
+                    # the abandoned holder may still be INSIDE its
+                    # dispatch, aliasing this bucket's pad staging
+                    # buffers (jnp.asarray is zero-copy on CPU) — the
+                    # retry must never re-zero/overwrite them under a
+                    # live attempt, so it gets a fresh pool
+                    self._pad_pool.pop(bucket, None)
 
     def _execute(self, req: _Request, batch_size: int):
         import numpy as np
@@ -568,16 +715,26 @@ class Server:
             obs_metrics.observe("serve.bucket_pad_frac", req.pad_frac)
         cell = None
         try:
-            if req.spec is not None:
-                args, meta = bucketing.pad_args(req.kernel, req.spec,
-                                                req.arrays)
-            else:
-                args, meta = req.arrays, None
             import jax
             import jax.numpy as jnp
 
-            jargs = tuple(jnp.asarray(a) for a in args)
+            # bucket lock FIRST: the per-bucket pad staging pool can
+            # only be reused while this thread owns the bucket (and by
+            # the time the lock releases, jnp.asarray + the completed
+            # dispatch are done with the staging buffers)
             cell = self._acquire_bucket(req.bucket)
+            if req.spec is not None:
+                with self._lock:
+                    pool = self._pad_pool.setdefault(req.bucket, {})
+                args, meta = bucketing.pad_args(req.kernel, req.spec,
+                                                req.arrays, pool=pool)
+                # padding is a genuinely extra staging copy — counted,
+                # unlike the one producer-to-consumer payload move
+                self._count_copied(req.kernel,
+                                   meta.get("copied_bytes") or 0)
+            else:
+                args, meta = req.arrays, None
+            jargs = tuple(jnp.asarray(a) for a in args)
             with trace.span(f"serve/{req.kernel}", bucket=req.bucket):
                 out = registry.dispatch(req.kernel, *jargs,
                                         **req.statics)
@@ -632,6 +789,7 @@ class Server:
             # different attempt by the time a slow original unwinds.
             wall = time.perf_counter() - (req.t_start or req.t_enq)
         payloads = ()
+        segs: list = []
         if error is None:
             # an out-of-contract output (a dtype outside the wire's
             # two) must become an error RESPONSE, not an exception
@@ -648,6 +806,21 @@ class Server:
             obs_metrics.observe(f"serve.wall_s.{req.kernel}", wall)
             header = {"v": protocol.VERSION, "id": req.rid, "ok": True,
                       "outputs": specs}
+            if req.shm_ok and self._shm:
+                # response lane: big outputs land in segments the
+                # client maps-and-unlinks; only names ride the wire.
+                # An exhausted /dev/shm degrades to inline, never to
+                # a failed response.
+                try:
+                    shm_descs, payloads2, segs, _staged = (
+                        protocol.stage_shm_payloads(payloads,
+                                                    self._shm_min)
+                    )
+                except OSError:
+                    shm_descs = None
+                if shm_descs is not None:
+                    header["_shm"] = shm_descs
+                    payloads = payloads2
         else:
             obs_metrics.inc("serve.errors")
             header = {"v": protocol.VERSION, "id": req.rid, "ok": False,
@@ -665,9 +838,28 @@ class Server:
             ok=error is None, error=error,
         )
         try:
-            req.conn.send(header, payloads)
+            sent = req.conn.send(header, payloads)
         except (OSError, protocol.ProtocolError):
-            pass  # client gone/stalled; the work is journaled anyway
+            # client gone/stalled; the work is journaled anyway — and
+            # response segments no one will ever map are unlinked NOW
+            for seg in segs:
+                seg.close()
+                seg.unlink()
+        else:
+            self._count_copied(req.kernel, sent)
+            if segs:
+                now = time.perf_counter()
+                with self._lock:
+                    self._shm_ledger.extend(
+                        (seg.name, now) for seg in segs
+                    )
+                for seg in segs:
+                    seg.close()  # the client unlinks on map; the aged
+                    #              ledger is the crash backstop
+                if not req.conn.lane_logged:
+                    req.conn.lane_logged = True
+                    journal.emit("serve_lane_negotiated", lane="shm",
+                                 kernel=req.kernel, request=req.rid)
 
     # -------------------------------------------------------------- #
     # watchdog: abandon wedged workers, requeue once                 #
@@ -708,6 +900,19 @@ class Server:
                     if r.t_start is not None
                     and now - r.t_start > grace * (1 + r.patience)
                 ]
+                expired = [
+                    n for n, t in self._shm_ledger
+                    if now - t > SHM_RESPONSE_GRACE_S
+                ]
+                if expired:
+                    self._shm_ledger = [
+                        (n, t) for n, t in self._shm_ledger
+                        if now - t <= SHM_RESPONSE_GRACE_S
+                    ]
+            for name in expired:
+                # normally ENOENT (the client mapped and unlinked);
+                # a real unlink here is the crashed-client backstop
+                protocol.unlink_shm(name)
             for req in overdue:
                 self._handle_wedge(req)
 
