@@ -273,6 +273,9 @@ os.environ.pop("TPK_SERVE_FLEET_DIR", None)
 os.environ.pop("TPK_SERVE_SHM", None)
 os.environ.pop("TPK_SERVE_SHM_MIN_BYTES", None)
 os.environ.pop("TPK_SERVE_BATCH_ADAPT", None)
+# An exported coverage floor would flip the request-tracing verdict
+# tests (docs/OBSERVABILITY.md §request tracing) — they pin their own.
+os.environ.pop("TPK_TRACE_COVERAGE_MIN", None)
 if "TPK_SERVE_DIR" not in os.environ:
     import tempfile
 

@@ -1,0 +1,555 @@
+"""CPU suite for fleet-wide request tracing (docs/OBSERVABILITY.md
+§request tracing; ISSUE 13).
+
+Covers: the ambient per-thread trace context tagging every in-flight
+span with the client-minted request_id, one-id-per-logical-request
+across backpressure retries, timeline assembly edge cases — clock
+anchoring across skewed processes, a request that spills mid-flight
+(home + sibling segments joined), an abandoned-worker gap, a
+pre-request_id old-server journal (the assembler degrades loudly,
+never crashes) — the trace-budget verdicts (`trace_inconsistent`
+gates like the copy budget, `trace_coverage` prints non-gating), the
+request-id journal lint, health_report's (kernel, worker_id) served
+table with spill dedupe, and the e2e acceptance proof: a traced
+loadgen burst against a 2-worker fleet with a wedged worker →
+`trace_report` reconstructs every request's timeline with
+request_id joins across the router spill, clean phase sums within
+the documented tolerance, and the shapes-seen records matching the
+seeded mix.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from test_distributed import _scrubbed_env
+from test_fleet import _fleet
+from test_serve import SCAN_BUCKET, _events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_tool(script, *args, env=None):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", script), *args],
+        capture_output=True, text=True, timeout=180, cwd=REPO,
+        env=env,
+    )
+
+
+def _ev(kind, rid=None, pid=1, t=100.0, **kw):
+    e = {"kind": kind, "pid": pid, "t": t}
+    if rid is not None:
+        e["request_id"] = rid
+    e.update(kw)
+    return e
+
+
+def _span(rid, name, wall, pid=2, t=100.0, depth=1, **kw):
+    return _ev("span", rid=rid, pid=pid, t=t, name=name,
+               wall_s=wall, depth=depth, ok=True, **kw)
+
+
+# ---------------------------------------------------------------- #
+# trace context + client id discipline                             #
+# ---------------------------------------------------------------- #
+
+def test_request_ctx_tags_spans_and_nested_children(
+        monkeypatch, tmp_path):
+    from tpukernels.obs import trace
+
+    jp = tmp_path / "h.jsonl"
+    monkeypatch.setenv("TPK_TRACE", "1")
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(jp))
+    trace.reload()
+    try:
+        assert trace.current_request() is None
+        with trace.request_ctx("r9"):
+            assert trace.current_request() == "r9"
+            trace.emit_span("serve/wait/queue", 0.002, kernel="scan")
+            with trace.span("serve/scan"):
+                with trace.span("aot/compile/scan"):
+                    pass
+        assert trace.current_request() is None
+        # outside the context: untagged, as before
+        with trace.span("probe/liveness"):
+            pass
+    finally:
+        monkeypatch.delenv("TPK_TRACE")
+        trace.reload()
+    spans = _events(jp)
+    by_name = {e["name"]: e for e in spans}
+    assert by_name["serve/wait/queue"]["request_id"] == "r9"
+    assert by_name["serve/wait/queue"]["depth"] == 1
+    assert by_name["serve/scan"]["request_id"] == "r9"
+    assert by_name["serve/scan/aot/compile/scan"]["request_id"] == "r9"
+    assert "request_id" not in by_name["probe/liveness"]
+
+
+def test_backpressure_retries_keep_one_request_id():
+    """One LOGICAL request keeps one causal id across admission-
+    control retries — N fresh ids would shred the timeline into N
+    fake one-hop requests."""
+    from tpukernels.serve import client as serve_client
+
+    class _RejectTwice:
+        def __init__(self):
+            self.seen = []
+            self.next_request_id = None
+            self._n = 0
+
+        def mint_request_id(self):
+            self._n += 1
+            return f"m-{self._n}"
+
+        def dispatch(self, kernel, *a, **s):
+            self.seen.append(self.next_request_id)
+            self.next_request_id = None
+            if len(self.seen) < 3:
+                raise serve_client.ServeRejected("full", 0.0)
+            return 42
+
+    cli = _RejectTwice()
+    assert serve_client.dispatch_with_backpressure(
+        cli, "scan", (), {}) == 42
+    assert cli.seen == ["m-1", "m-1", "m-1"]
+
+
+# ---------------------------------------------------------------- #
+# assembly: phases, anchoring, spills, gaps, old journals           #
+# ---------------------------------------------------------------- #
+
+def test_phase_decomposition_coverage_and_critical_path():
+    from tpukernels.obs import reqtrace
+
+    events = [
+        _ev("serve_client_request", "r1", pid=1, t=10.0,
+            kernel="scan", wall_s=0.02, ok=True),
+        _ev("serve_request", "r1", pid=2, t=10.0, kernel="scan",
+            bucket="scan|8192|-", ok=True, wall_s=0.012,
+            worker_id="0", shapes=[[4093]], dtypes=["int32"],
+            pad_frac=0.5),
+        _span("r1", "serve/wait/queue", 0.004, t=9.99),
+        _span("r1", "serve/wait/lock", 0.001, t=9.991),
+        _span("r1", "serve/pad", 0.001, t=9.992),
+        _span("r1", "serve/scan", 0.010, t=10.0),
+        _span("r1", "serve/scan/dispatch/scan", 0.009, t=10.0,
+              depth=2),
+        _span("r1", "serve/scan/dispatch/scan/aot/compile/scan",
+              0.006, t=9.999, depth=3),
+    ]
+    t = reqtrace.assemble(events)["r1"]
+    ph = t["phases"]
+    assert ph["queue_wait"] == pytest.approx(0.004)
+    assert ph["lock_wait"] == pytest.approx(0.001)
+    assert ph["pad"] == pytest.approx(0.001)
+    assert ph["compile"] == pytest.approx(0.006)
+    # dispatch = top-level serve/scan minus its compile child;
+    # the interior dispatch/scan span must NOT double-count
+    assert ph["dispatch"] == pytest.approx(0.004)
+    assert t["accounted_s"] == pytest.approx(0.016)
+    assert t["coverage"] == pytest.approx(0.8)
+    assert ph["unaccounted"] == pytest.approx(0.004)
+    assert t["clean"] is True
+    assert t["dominant"] == "compile"
+    assert t["worker_id"] == "0"
+    agg = reqtrace.aggregate({"r1": t})
+    assert list(agg) == ["scan|scan|8192|-|-"]
+    row = agg["scan|scan|8192|-|-"]
+    assert row["n"] == 1
+    assert row["phases"]["compile"]["p50_s"] == pytest.approx(0.006)
+
+
+def test_clock_anchoring_across_skewed_processes():
+    """A worker whose wall clock runs 1000 s ahead must not shift
+    the phase arithmetic (durations only) and its lane offsets must
+    anchor to its OWN serve_start, not the client's clock."""
+    from tpukernels.obs import reqtrace
+
+    skew = 1000.0
+    events = [
+        _ev("serve_start", pid=7, t=50.0 + skew, socket="s"),
+        _ev("serve_client_request", "r1", pid=1, t=10.0,
+            kernel="scan", wall_s=0.02, ok=True),
+        _ev("serve_request", "r1", pid=7, t=60.0 + skew,
+            kernel="scan", bucket="b", ok=True, wall_s=0.01),
+        _span("r1", "serve/wait/queue", 0.004, pid=7,
+              t=59.99 + skew),
+        _span("r1", "serve/scan", 0.010, pid=7, t=60.0 + skew),
+    ]
+    t = reqtrace.assemble(events)["r1"]
+    assert t["coverage"] == pytest.approx(0.7)
+    for s in t["segments"]:
+        # anchored to pid 7's own serve_start at t=1050: offsets stay
+        # ~10 s (its uptime), not ~1050 s of cross-clock nonsense
+        assert 9.0 <= s["rel0"] <= 11.0
+
+
+def test_spill_midflight_joins_home_and_sibling_segments():
+    from tpukernels.obs import reqtrace
+
+    events = [
+        _ev("serve_client_request", "r1", pid=1, t=30.0,
+            kernel="scan", wall_s=12.5, ok=True),
+        _ev("serve_route", "r1", pid=5, t=30.0, kernel="scan",
+            bucket="scan|8192|-", worker=1, spilled_from=0, ok=True),
+        _ev("serve_spill", "r1", pid=5, t=29.0, kernel="scan",
+            bucket="scan|8192|-", from_worker=0, to_worker=1,
+            reason="wedged"),
+        # home attempt: wedged-twice failure record
+        _ev("serve_request", "r1", pid=10, t=29.0, kernel="scan",
+            bucket="scan|8192|-", ok=False, error="wedged twice",
+            wall_s=12.0, worker_id="0", requeues=1),
+        _ev("serve_request_requeued", "r1", pid=10, t=23.0,
+            kernel="scan", bucket="scan|8192|-", timeout_s=2),
+        # sibling serves it
+        _ev("serve_request", "r1", pid=11, t=30.0, kernel="scan",
+            bucket="scan|8192|-", ok=True, wall_s=0.4,
+            worker_id="1"),
+        _span("r1", "serve/scan", 0.4, pid=11, t=30.0),
+    ]
+    t = reqtrace.assemble(events)["r1"]
+    assert len(t["server"]) == 2
+    assert t["final"]["worker_id"] == "1"     # the ok record wins
+    assert t["worker_id"] == "1"
+    assert [s["pid"] for s in t["segments"]] == [11]
+    assert t["spills"] and t["spills"][0]["reason"] == "wedged"
+    assert t["requeued"] is True
+    assert t["clean"] is False                # excluded from sum gate
+    assert any(g["kind"] == "abandoned-worker" for g in t["gaps"])
+
+
+def test_missing_server_record_is_an_explicit_gap():
+    from tpukernels.obs import reqtrace
+
+    events = [
+        _ev("serve_client_request", "r2", pid=1, t=5.0,
+            kernel="scan", wall_s=0.01, ok=True),
+    ]
+    t = reqtrace.assemble(events)["r2"]
+    assert [g["kind"] for g in t["gaps"]] == ["missing-server-record"]
+    # a dropped (rejected) request is NOT a gap — the rejection is
+    # the explanation
+    events = [
+        _ev("serve_client_request", "r3", pid=1, t=5.0,
+            kernel="scan", wall_s=0.01, ok=False, error="rejected"),
+        _ev("serve_rejected", "r3", pid=2, t=5.0, kernel="scan",
+            depth=9),
+    ]
+    t = reqtrace.assemble(events)["r3"]
+    assert t["gaps"] == [] and t["rejections"] == 1
+
+
+def test_throttled_request_is_not_clean():
+    """A tenant-throttled-then-retried request's client wall includes
+    backoff sleeps no span covers — it must not feed the consistency
+    gate as a clean timeline."""
+    from tpukernels.obs import reqtrace
+
+    events = [
+        _ev("serve_client_request", "r4", pid=1, t=9.0,
+            kernel="scan", wall_s=2.0, ok=True),
+        _ev("serve_tenant_throttled", "r4", pid=5, t=7.5,
+            kernel="scan", tenant="hot", retry_after_s=1.0),
+        _ev("serve_request", "r4", pid=2, t=9.0, kernel="scan",
+            bucket="b", ok=True, wall_s=0.01),
+        _span("r4", "serve/scan", 0.01, t=9.0),
+    ]
+    t = reqtrace.assemble(events)["r4"]
+    assert t["throttles"] == 1
+    assert t["clean"] is False
+    b = reqtrace.run_budget(events)
+    assert b["clean"] == 0 and "sum_ratio_max" not in b
+
+
+def test_pre_request_id_journal_degrades_loudly(tmp_path):
+    """An old server's journal (serve_request without request_id)
+    assembles to zero timelines, is COUNTED, and crashes nothing —
+    including the trace_report CLI."""
+    from tpukernels.obs import reqtrace
+
+    events = [
+        {"kind": "serve_request", "pid": 2, "t": 1.0,
+         "kernel": "scan", "ok": True, "wall_s": 0.01},
+        {"kind": "serve_request", "pid": 2, "t": 2.0,
+         "kernel": "scan", "ok": True, "wall_s": 0.01},
+        {"kind": "span", "pid": 2, "t": 2.0, "name": "serve/scan",
+         "wall_s": 0.01, "depth": 1},   # untagged span: not joinable
+    ]
+    assert reqtrace.assemble(events) == {}
+    assert reqtrace.untraced_serve_requests(events) == 2
+    assert reqtrace.run_budget(events) is None
+    jp = tmp_path / "old.jsonl"
+    jp.write_text("".join(json.dumps(e) + "\n" for e in events)
+                  + "garbage line\n")
+    r = _run_tool("trace_report.py", str(jp))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "2 serve_request event(s) carry no request_id" in r.stdout
+    assert "no request timelines" in r.stdout
+
+
+# ---------------------------------------------------------------- #
+# verdicts: budget arithmetic + gating                             #
+# ---------------------------------------------------------------- #
+
+def test_trace_budget_verdict_rules(monkeypatch):
+    from tpukernels.obs import trend
+
+    def budget(**kw):
+        e = {"kind": "serve_trace_budget", "socket": "/tmp/a.sock",
+             "requests": 10, "traced": 10, "gaps": 0,
+             "untraced_serve_requests": 0, "coverage_floor": 0.5,
+             "sum_tol": 0.1}
+        e.update(kw)
+        return e
+
+    # consistent + covered -> ok
+    v = trend.analyze_trace_budget(
+        [budget(coverage_mean=0.9, sum_ratio_max=0.95)])
+    assert v["trace[a.sock]"]["verdict"] == "ok"
+    # phase sum past the wall beyond tolerance -> GATES
+    v = trend.analyze_trace_budget(
+        [budget(coverage_mean=1.5, sum_ratio_max=1.5)])
+    assert v["trace[a.sock]"]["verdict"] == "trace_inconsistent"
+    # low coverage -> non-gating flag
+    v = trend.analyze_trace_budget(
+        [budget(coverage_mean=0.2, sum_ratio_max=0.3)])
+    assert v["trace[a.sock]"]["verdict"] == "trace_coverage"
+    # nothing traced (daemon journaled elsewhere) can never gate
+    v = trend.analyze_trace_budget(
+        [budget(traced=0)])
+    assert v["trace[a.sock]"]["verdict"] == "ok"
+    # only the LATEST event per socket is judged (the copy-budget
+    # rule): an old bad run is superseded
+    v = trend.analyze_trace_budget([
+        budget(coverage_mean=1.5, sum_ratio_max=1.5),
+        budget(coverage_mean=0.9, sum_ratio_max=0.95),
+    ])
+    assert v["trace[a.sock]"]["verdict"] == "ok"
+
+
+def test_coverage_min_knob(monkeypatch):
+    from tpukernels.obs import reqtrace
+
+    assert reqtrace.coverage_min() == 0.5
+    monkeypatch.setenv("TPK_TRACE_COVERAGE_MIN", "0.25")
+    assert reqtrace.coverage_min() == 0.25
+    monkeypatch.setenv("TPK_TRACE_COVERAGE_MIN", "1.5")
+    with pytest.raises(ValueError):
+        reqtrace.coverage_min()
+    monkeypatch.setenv("TPK_TRACE_COVERAGE_MIN", "nope")
+    with pytest.raises(ValueError):
+        reqtrace.coverage_min()
+
+
+def test_obs_report_check_gates_trace_inconsistent(tmp_path):
+    env = _scrubbed_env(None)
+    for var, sub in (("TPK_SLO_DIR", "slo"),
+                     ("TPK_SCALING_DIR", "scaling")):
+        d = tmp_path / sub
+        d.mkdir()
+        env[var] = str(d)
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({
+        "kind": "serve_trace_budget", "socket": "/tmp/a.sock",
+        "requests": 5, "traced": 5, "gaps": 0,
+        "untraced_serve_requests": 0, "coverage_floor": 0.5,
+        "sum_tol": 0.1, "coverage_mean": 1.4, "sum_ratio_max": 1.4,
+    }) + "\n")
+    root = tmp_path / "root"
+    (root / "docs" / "logs").mkdir(parents=True)
+    r = _run_tool("obs_report.py", "--check", "--root", str(root),
+                  "--journal", str(bad), env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "trace_inconsistent" in r.stdout
+
+    low = tmp_path / "low.jsonl"
+    low.write_text(json.dumps({
+        "kind": "serve_trace_budget", "socket": "/tmp/a.sock",
+        "requests": 5, "traced": 5, "gaps": 0,
+        "untraced_serve_requests": 0, "coverage_floor": 0.5,
+        "sum_tol": 0.1, "coverage_mean": 0.2, "sum_ratio_max": 0.3,
+    }) + "\n")
+    r = _run_tool("obs_report.py", "--check", "--root", str(root),
+                  "--journal", str(low), env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "trace_coverage (non-gating)" in r.stdout
+
+
+# ---------------------------------------------------------------- #
+# the request-id lint                                              #
+# ---------------------------------------------------------------- #
+
+def test_request_id_lint_catches_untagged_traced_emit(tmp_path):
+    root = tmp_path / "mini"
+    (root / "docs").mkdir(parents=True)
+    (root / "docs" / "OBSERVABILITY.md").write_text(
+        "| `serve_route` | router | stuff |\n\n"
+        "Traced kinds (request-id lint): `serve_route` — every "
+        "production `journal.emit` of these kinds MUST carry a "
+        "`request_id=` field.\n"
+    )
+    (root / "bench.py").write_text(
+        'journal.emit(\n    "serve_route", kernel="scan",\n'
+        '    worker=1)\n'
+    )
+    r = _run_tool("journal_kinds.py", "--root", str(root))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "WITHOUT" in r.stdout and "serve_route" in r.stdout
+    assert "bench.py:1" in r.stdout
+    # parens inside an f-string error message and apostrophes in a
+    # trailing comment must not desync the call scanner;
+    # request_id=None (an untraced old client) passes
+    (root / "bench.py").write_text(
+        'journal.emit(\n'
+        '    "serve_route", kernel="scan",  # the worker\'s id\n'
+        '    error=f"bad ({x})", request_id=None)\n'
+    )
+    r = _run_tool("journal_kinds.py", "--root", str(root))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_request_id_lint_green_on_this_repo():
+    r = _run_tool("journal_kinds.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "traced kind(s) all carry request_id" in r.stdout
+
+
+# ---------------------------------------------------------------- #
+# health_report: per-worker table + spill dedupe                   #
+# ---------------------------------------------------------------- #
+
+def test_serve_table_keyed_by_worker_and_deduped():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import health_report
+
+    events = [
+        # r1 wedged on worker 0, served by worker 1: ONE request
+        _ev("serve_request", "r1", pid=10, t=1.0, kernel="scan",
+            ok=False, error="wedged", wall_s=12.0, worker_id="0"),
+        _ev("serve_request", "r1", pid=11, t=2.0, kernel="scan",
+            ok=True, wall_s=0.4, worker_id="1"),
+        # r2/r3 plain successes on worker 1 (the hot worker)
+        _ev("serve_request", "r2", pid=11, t=3.0, kernel="scan",
+            ok=True, wall_s=0.1, worker_id="1"),
+        _ev("serve_request", "r3", pid=11, t=4.0, kernel="scan",
+            ok=True, wall_s=0.1, worker_id="1"),
+        # an old client without request_id still counts
+        {"kind": "serve_request", "pid": 12, "t": 5.0,
+         "kernel": "scan", "ok": True, "wall_s": 0.1},
+    ]
+    out = "\n".join(health_report._serve_table(events))
+    assert "keyed kernel@worker" in out
+    assert "1 spill/wedge duplicate record(s) deduped" in out
+    # the hot worker is VISIBLE: 3 requests on w1, none on w0
+    assert "scan@w1" in out and "n=3" in out
+    assert "scan@w0" not in out
+    # the plain (worker-less) row keeps its own line
+    lines = [ln for ln in out.splitlines() if "scan " in ln]
+    assert any("n=1" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------- #
+# e2e acceptance: traced loadgen vs a 2-worker fleet with a wedge  #
+# ---------------------------------------------------------------- #
+
+def test_fleet_e2e_traced_timelines_across_wedge(tmp_path):
+    """The ISSUE-13 acceptance proof: a seeded traced loadgen burst
+    against a 2-worker fleet whose scan-bucket home worker wedges →
+    every request's timeline reconstructs with request_id joins
+    across the router spill, clean phase sums stay within the
+    documented tolerance of the client-observed walls, and the
+    shape-mix records match the seeded mix."""
+    from tpukernels.obs import reqtrace
+    from tpukernels.obs import trend as obs_trend
+    from tpukernels.serve import router as serve_router
+
+    primary = serve_router.ring_order("scan|8192|-", 2)[0]
+    # both attempts on the home worker wedge -> wedged answer ->
+    # router spills to the sibling and cools the home
+    plan = json.dumps({"wedge_dispatch": {
+        "kernel": "scan", "times": 2,
+        "env": {"TPK_SERVE_WORKER_ID": str(primary)},
+    }})
+    seed = 7
+    with _fleet(tmp_path, n=2, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_REQUEST_TIMEOUT_S": "2",
+        "TPK_ROUTE_COOLDOWN_S": "120",
+        "TPK_FAULT_PLAN": plan,
+        "TPK_TRACE": "1",
+    }) as (front, journal, env):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+             "--serve", front, "--kernel", "scan", "--requests", "6",
+             "--rate", "20", "--seed", str(seed), "--shapes", "probe"],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+            env=env,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    events = _events(journal)
+    tls = reqtrace.assemble(events)
+    # EVERY request this run minted reconstructs: the warm request
+    # plus the 6 scheduled ones — ids are seeded-deterministic
+    # suffixes under the run's pid scope (lg<seed>-<pid>-...)
+    import re
+
+    want_ids = {e["request_id"] for e in events
+                if e.get("kind") == "serve_client_request"}
+    assert len(want_ids) == 7
+    assert all(
+        re.fullmatch(rf"lg{seed}-\d+-(warm-scan|\d{{5}})", rid)
+        for rid in want_ids
+    ), want_ids
+    assert want_ids <= set(tls)
+    for rid in want_ids:
+        assert tls[rid]["final"] is not None, rid
+        assert tls[rid]["final"]["ok"] is True, rid
+        assert tls[rid]["segments"], f"{rid} has no span evidence"
+    # the wedged request joined HOME and SIBLING across the spill
+    spilled = [t for t in tls.values() if t["spills"]]
+    assert spilled, "no spilled timeline reconstructed"
+    sp = spilled[0]
+    assert len(sp["server"]) == 2
+    assert {e.get("worker_id") for e in sp["server"]} == {"0", "1"}
+    assert any(g["kind"] == "abandoned-worker" for g in sp["gaps"])
+    assert sp["clean"] is False
+    # clean requests: accounted phases within tolerance of the
+    # client-observed wall (the documented consistency contract)
+    clean = [t for t in tls.values()
+             if t["clean"] and t["coverage"] is not None]
+    assert clean, "no clean traced timeline"
+    for t in clean:
+        assert t["coverage"] <= 1.0 + reqtrace.SUM_TOL, \
+            (t["request_id"], t["coverage"])
+    # the loadgen-stamped budget exists and does NOT gate
+    budgets = [e for e in events
+               if e.get("kind") == "serve_trace_budget"]
+    assert budgets and budgets[-1]["traced"] >= len(want_ids) - 1
+    verdicts = obs_trend.analyze_trace_budget(events)
+    assert all(v["verdict"] != "trace_inconsistent"
+               for v in verdicts.values()), verdicts
+    # shape-mix records match the seeded mix: every serve_request of
+    # this run carries scan's requested probe shape (4093), pre-pad
+    reqs = [e for e in events if e.get("kind") == "serve_request"
+            and e.get("request_id") in want_ids]
+    assert reqs
+    assert all(e.get("shapes") == [[4093]] for e in reqs)
+    assert all(e.get("dtypes") == ["int32"] for e in reqs)
+    # trace_report renders the waterfalls from the same journal
+    rid3 = next(r for r in want_ids if r.endswith("-00003"))
+    r = _run_tool("trace_report.py", str(journal),
+                  "--request", rid3)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert f"request {rid3}" in r.stdout
+    assert "critical path:" in r.stdout
+    r = _run_tool("trace_report.py", str(journal))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "phase attribution" in r.stdout

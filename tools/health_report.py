@@ -322,6 +322,23 @@ def _fmt(ev):
         return (f"{ts} [pid {pid}] tenant {ev.get('tenant')} "
                 f"THROTTLED ({ev.get('priority')} {ev.get('kernel')} "
                 f"request; retry after {ev.get('retry_after_s')}s)")
+    if kind == "serve_client_request":
+        # per-request client walls are high-volume; the request-phase
+        # story lives in tools/trace_report.py — only drops narrate
+        if ev.get("ok"):
+            return None
+        return (f"{ts} [pid {pid}] client DROPPED {ev.get('kernel')} "
+                f"request {ev.get('request_id')}: {ev.get('error')}")
+    if kind == "serve_trace_budget":
+        return (f"{ts} [pid {pid}] trace budget: {ev.get('traced')} "
+                f"of {ev.get('requests')} request(s) traced, "
+                f"{ev.get('gaps')} gap(s)"
+                + (f", coverage {ev.get('coverage_mean'):.0%}"
+                   if isinstance(ev.get("coverage_mean"),
+                                 (int, float)) else "")
+                + (f", {ev.get('untraced_serve_requests')} served "
+                   "request(s) WITHOUT request_id"
+                   if ev.get("untraced_serve_requests") else ""))
     if kind == "serve_lane_negotiated":
         return (f"{ts} [pid {pid}] serve shm payload lane ENGAGED "
                 f"({ev.get('kernel')} request {ev.get('request')})")
@@ -480,15 +497,37 @@ def _step_table(events):
 
 
 def _serve_table(events):
-    """Per-kernel served-request aggregate from the high-volume
-    ``serve_request`` events (docs/SERVING.md) — requests, mean wall,
-    mean pad waste, max batch — so the narrative stays readable while
-    nothing is dropped."""
-    rows: dict = {}
+    """Per-(kernel, worker) served-request aggregate from the
+    high-volume ``serve_request`` events (docs/SERVING.md) —
+    requests, mean wall, mean pad waste, max batch — so the
+    narrative stays readable while nothing is dropped. Keyed by
+    (kernel, worker_id), not kernel alone: on a fleet a hot worker
+    must be VISIBLE, not averaged away. A request a spill or wedge
+    made two workers journal (home failure + sibling success) is
+    counted ONCE — deduplicated by request_id, keeping the ok (else
+    latest) record; requests without a request_id (old clients) each
+    count, as before."""
+    chosen: dict = {}   # request_id -> event of record
+    plain: list = []    # pre-request_id events: no dedupe possible
+    dupes = 0
     for ev in events:
         if ev.get("kind") != "serve_request":
             continue
-        r = rows.setdefault(ev.get("kernel", "?"), {
+        rid = ev.get("request_id")
+        if rid is None:
+            plain.append(ev)
+            continue
+        prev = chosen.get(rid)
+        if prev is None:
+            chosen[rid] = ev
+        else:
+            dupes += 1
+            if bool(ev.get("ok")) or not prev.get("ok"):
+                chosen[rid] = ev
+    rows: dict = {}
+    for ev in list(chosen.values()) + plain:
+        key = (ev.get("kernel", "?"), ev.get("worker_id"))
+        r = rows.setdefault(key, {
             "n": 0, "ok": 0, "wall": 0.0, "pad": 0.0, "bucketed": 0,
             "batch_max": 0, "requeued": 0,
         })
@@ -501,11 +540,16 @@ def _serve_table(events):
         r["requeued"] += 1 if ev.get("requeues") else 0
     if not rows:
         return []
-    out = ["served requests (from serve_request events):"]
-    for kernel in sorted(rows):
-        r = rows[kernel]
+    out = ["served requests (from serve_request events, keyed "
+           "kernel@worker):"]
+    if dupes:
+        out.append(f"  ({dupes} spill/wedge duplicate record(s) "
+                   "deduped by request_id)")
+    for kernel, wid in sorted(rows, key=lambda k: (k[0], str(k[1]))):
+        r = rows[(kernel, wid)]
+        label = kernel if wid is None else f"{kernel}@w{wid}"
         out.append(
-            f"  {kernel:<16} n={r['n']:<5} ok={r['ok']:<5} "
+            f"  {label:<16} n={r['n']:<5} ok={r['ok']:<5} "
             f"mean_wall={r['wall'] / r['n']:.4f}s "
             f"bucketed={r['bucketed']} "
             f"mean_pad={r['pad'] / r['n']:.1%} "

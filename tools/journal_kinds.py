@@ -17,6 +17,13 @@ Also warns (without failing) on documented-but-unused kinds — usually
 a callsite that was deleted without its doc row — and fails on
 ``journal.emit`` callsites whose kind is not a string literal, which
 this lint cannot check (none exist today; keep it that way).
+
+Request-id lint (docs/OBSERVABILITY.md §request tracing): the
+catalog's "Traced kinds (request-id lint)" line names the serve-path
+kinds whose every production emit MUST pass a ``request_id=`` field —
+one untagged callsite is a hole in every future timeline, found only
+during the incident the tracing layer exists to shorten. Enforced
+here (rc 1) and therefore in tier-1 via the same test.
 """
 
 from __future__ import annotations
@@ -35,6 +42,55 @@ _DOC = os.path.join(_REPO, "docs", "OBSERVABILITY.md")
 # silently skipped by a too-narrow character class.
 _EMIT_RE = re.compile(r"journal\.emit\(\s*([\"']\w+[\"']|[^\s\"'])")
 _DOC_KIND_RE = re.compile(r"^\|\s*`(\w+)`", re.MULTILINE)
+# the doc PARAGRAPH naming the kinds whose emits must carry
+# request_id= (markdown wraps it across lines, so the match runs to
+# the em dash that ends the kind list, or the blank line before it)
+_TRACED_RE = re.compile(
+    r"Traced kinds \(request-id lint\):(.*?)(?:—|\n\n)", re.DOTALL
+)
+
+
+def _call_text(text: str, start: int) -> str:
+    """The balanced-paren call text from the ``(`` at ``start`` —
+    string literals AND ``#`` comments are skipped, so a paren inside
+    an error message or an apostrophe in a trailing comment cannot
+    desync the scan."""
+    depth, i, n, in_str = 0, start, len(text), None
+    while i < n:
+        c = text[i]
+        if in_str is not None:
+            if c == "\\":
+                i += 2
+                continue
+            if c == in_str:
+                in_str = None
+        elif c == "#":
+            nl = text.find("\n", i)
+            i = n if nl < 0 else nl
+        elif c in "\"'":
+            in_str = c
+        elif c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return text[start:i + 1]
+        i += 1
+    return text[start:]
+
+
+def traced_kinds(doc=_DOC):
+    """Kinds the catalog marks as request-traced (empty set when the
+    doc lacks the marker line — old checkouts and the mini-repo test
+    fixtures lint kind documentation only)."""
+    try:
+        with open(doc) as f:
+            m = _TRACED_RE.search(f.read())
+    except OSError:
+        return set()
+    if not m:
+        return set()
+    return set(re.findall(r"`(\w+)`", m.group(1)))
 
 
 def production_files(repo=_REPO):
@@ -72,6 +128,31 @@ def emitted_kinds(repo=_REPO):
             else:
                 unlintable.append(where)
     return kinds, unlintable
+
+
+def untagged_traced_callsites(repo=_REPO, traced=None):
+    """``[(kind, file:line), ...]`` — production emits of a traced
+    kind whose call text carries no ``request_id=`` field."""
+    if traced is None:
+        traced = traced_kinds(
+            os.path.join(repo, "docs", "OBSERVABILITY.md")
+        )
+    if not traced:
+        return []
+    missing = []
+    for path in production_files(repo):
+        with open(path) as f:
+            text = f.read()
+        rel = os.path.relpath(path, repo)
+        for m in _EMIT_RE.finditer(text):
+            tok = m.group(1)
+            if tok[0] not in "\"'" or tok.strip("\"'") not in traced:
+                continue
+            call = _call_text(text, text.index("(", m.start()))
+            if "request_id" not in call:
+                where = f"{rel}:{text.count(chr(10), 0, m.start()) + 1}"
+                missing.append((tok.strip("\"'"), where))
+    return missing
 
 
 def documented_kinds(doc=_DOC):
@@ -124,6 +205,18 @@ def main(argv=None):
             "unlintable; pass the kind as a string literal"
         )
         rc = 1
+    traced = traced_kinds(
+        os.path.join(repo, "docs", "OBSERVABILITY.md")
+    )
+    untagged = untagged_traced_callsites(repo, traced)
+    for kind, where in untagged:
+        print(
+            f"journal_kinds: traced kind {kind!r} emitted WITHOUT "
+            f"request_id at {where} (docs/OBSERVABILITY.md §request "
+            "tracing: every serve-path emit of a traced kind must "
+            "carry the causal id)"
+        )
+        rc = 1
     unused = documented - set(kinds)
     for kind in sorted(unused):
         print(
@@ -134,7 +227,8 @@ def main(argv=None):
         print(
             f"journal_kinds: OK - {len(kinds)} kinds across "
             f"{sum(len(v) for v in kinds.values())} callsites, all "
-            "documented"
+            f"documented; {len(traced)} traced kind(s) all carry "
+            "request_id"
         )
     return rc
 

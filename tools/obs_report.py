@@ -43,7 +43,16 @@ Sections (docs/OBSERVABILITY.md):
    per request by lane, from the ``serve_copy_budget`` events
    ``loadgen --serve`` stamps (docs/SERVING.md §copy accounting).
    The negotiated shm warm path's budget is exactly zero.
-10. **Metric snapshots** — the last ``metrics`` event per process:
+10. **Request phases** — phase-attribution percentiles per (kernel,
+    bucket, tenant) from the cross-process request timelines
+    ``tpukernels/obs/reqtrace.py`` assembles by joining the serve
+    journals on the client-minted ``request_id``
+    (docs/OBSERVABILITY.md §request tracing; waterfalls via
+    ``tools/trace_report.py``), plus the trace-budget verdicts.
+11. **Shapes seen** — requested (pre-pad) shape mix per (kernel,
+    bucket) with pad waste, from the per-request shape-mix records
+    on ``serve_request`` — ROADMAP item 5's optimizer input.
+12. **Metric snapshots** — the last ``metrics`` event per process:
     counters (probe retries, watchdog kills, tuning-cache traffic),
     gauges, latency histograms (count-weighted p50/p95/p99 + exact
     max).
@@ -63,13 +72,16 @@ non-gating and keys a WARN off it):
         slopes), a confirmed output-integrity corruption (a wrong
         answer is worse than a slow one), a confirmed p99 SLO
         breach (a degraded tail is a regression users feel before the
-        slope moves), or a ``copy_regression`` (payload bytes copied
+        slope moves), a ``copy_regression`` (payload bytes copied
         per request on the serve path's negotiated zero-copy shm
-        lane — docs/SERVING.md §copy accounting) — all of these gate
-        identically;
+        lane — docs/SERVING.md §copy accounting), or a
+        ``trace_inconsistent`` finding (a clean request's accounted
+        phases summed past its client-observed wall — the trace
+        evidence itself is wrong; docs/OBSERVABILITY.md §request
+        tracing) — all of these gate identically;
     2 — usage error (never 1: rc 1 is reserved for real findings).
-``below_scaling_efficiency`` prints as non-gating information, the
-``below_roofline`` pattern.
+``below_scaling_efficiency`` and ``trace_coverage`` print as
+non-gating information, the ``below_roofline`` pattern.
 
 ``--check`` prints only the non-ok verdict lines (machine/CI mode;
 ``below_roofline`` lines print as non-gating information); the
@@ -86,6 +98,7 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from tpukernels.obs import reqtrace as _reqtrace  # noqa: E402
 from tpukernels.obs import scaling as _scaling  # noqa: E402
 from tpukernels.obs import slo as _slo  # noqa: E402
 from tpukernels.obs import trace, trend  # noqa: E402
@@ -414,6 +427,95 @@ def copy_section(events, out):
             out.append(f"    {flag}")
 
 
+def reqtrace_section(events, out):
+    """Request-phase table from the assembled per-request timelines
+    (docs/OBSERVABILITY.md §request tracing): phase-attribution
+    percentiles per (kernel, bucket, tenant) plus the trace-budget
+    verdicts — where the tail actually lives, per request class.
+    Untraced served requests are announced, never silently
+    dropped."""
+    tls = _reqtrace.assemble(events)
+    untraced = _reqtrace.untraced_serve_requests(events)
+    verdicts = trend.analyze_trace_budget(events)
+    if not (tls or untraced or verdicts):
+        return
+    traced = sum(1 for t in tls.values() if t["segments"])
+    out.append("")
+    out.append(f"== request phases ({len(tls)} timeline(s), {traced} "
+               "with span evidence; tools/trace_report.py renders "
+               "waterfalls) ==")
+    if untraced:
+        out.append(f"  NOTE: {untraced} serve_request event(s) carry "
+                   "no request_id - served but not assembled")
+    agg = _reqtrace.aggregate(tls)
+    phases = list(_reqtrace.PHASES)
+    if agg:
+        hdr = (f"{'kernel|bucket|tenant':<40} {'n':>4} "
+               f"{'cli_p99_ms':>10}  dominant phases (p50 ms)")
+        out.append(hdr)
+        out.append("-" * len(hdr))
+        for key, g in agg.items():
+            tops = sorted(
+                ((p, g["phases"][p]["p50_s"])
+                 for p in phases if p in g["phases"]),
+                key=lambda kv: -(kv[1] or 0.0),
+            )[:3]
+            cw = g["client_p99_s"]
+            out.append(
+                f"{key:<40} {g['n']:>4} "
+                + (f"{cw * 1e3:>10.3f}" if cw is not None
+                   else f"{'-':>10}")
+                + "  "
+                + " ".join(f"{p}={v * 1e3:.3f}" for p, v in tops)
+                + (f"  {g['gaps']} gap(s)" if g["gaps"] else "")
+            )
+    for name, v in verdicts.items():
+        out.append(f"  {name}: {v['verdict']} (traced {v['traced']} "
+                   f"of {v['requests']} request(s))")
+        for flag in v["flags"]:
+            out.append(f"    {flag}")
+
+
+def shapes_section(events, out):
+    """Shapes-seen table from the per-request shape-mix records on
+    ``serve_request`` events (docs/OBSERVABILITY.md §request
+    tracing): requested (pre-pad) shapes per (kernel, bucket) with
+    pad waste — the exact traffic evidence ROADMAP item 5's
+    bucket-table optimizer mines."""
+    rows: dict = {}
+    for ev in events:
+        if ev.get("kind") != "serve_request" or not ev.get("shapes"):
+            continue
+        shapes = "+".join(
+            "x".join(str(d) for d in s) or "scalar"
+            for s in ev["shapes"]
+        )
+        key = (ev.get("kernel", "?"), shapes,
+               ev.get("bucket") or "-")
+        r = rows.setdefault(key, {"n": 0, "pad": 0.0, "tenants": set()})
+        r["n"] += 1
+        r["pad"] += ev.get("pad_frac") or 0.0
+        if ev.get("tenant") not in (None, "-"):
+            r["tenants"].add(str(ev.get("tenant")))
+    if not rows:
+        return
+    out.append("")
+    out.append(f"== shapes seen ({len(rows)} distinct (kernel, "
+               "shapes, bucket) mix(es) from serve_request "
+               "records) ==")
+    hdr = (f"{'kernel':<16} {'requested shapes':<26} "
+           f"{'bucket':<30} {'n':>5} {'mean_pad':>9}  tenants")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for (kernel, shapes, bucket), r in sorted(
+            rows.items(), key=lambda kv: (-kv[1]["n"], kv[0])):
+        out.append(
+            f"{kernel:<16} {shapes:<26} {bucket:<30} {r['n']:>5} "
+            f"{r['pad'] / r['n']:>9.1%}  "
+            + (",".join(sorted(r["tenants"])) or "-")
+        )
+
+
 def metrics_section(events, out):
     snaps = [e for e in events if e.get("kind") == "metrics"]
     out.append("")
@@ -550,6 +652,27 @@ def main(argv=None):
             print(f"{name}: copy_regression")
             for flag in v["flags"]:
                 print(f"  {flag}")
+        # an inconsistent request timeline gates like the copy
+        # budget: phase sums past the client wall mean the trace
+        # evidence itself is wrong, and every latency conclusion
+        # drawn from it would be too (docs/OBSERVABILITY.md §request
+        # tracing); low COVERAGE prints non-gating, the
+        # below_roofline pattern
+        trace_verdicts = trend.analyze_trace_budget(events)
+        trace_bad = {
+            n: v for n, v in trace_verdicts.items()
+            if v["verdict"] == "trace_inconsistent"
+        }
+        for name, v in trace_bad.items():
+            print(f"{name}: trace_inconsistent")
+            for flag in v["flags"]:
+                print(f"  {flag}")
+        trace_low = {
+            n: v for n, v in trace_verdicts.items()
+            if v["verdict"] == "trace_coverage"
+        }
+        for name in trace_low:
+            print(f"{name}: trace_coverage (non-gating)")
         # validated (non-fake) bus-bw scaling series gate exactly like
         # bench trends — the paper's multi-chip headline must not be
         # the one layer that can regress silently
@@ -581,10 +704,12 @@ def main(argv=None):
             f"{len(breaches)} confirmed SLO breach(es), "
             f"{len(scaling_bad)} scaling regression(s), "
             f"{len(copy_bad)} copy-budget regression(s), "
+            f"{len(trace_bad)} trace inconsistenc(ies), "
+            f"{len(trace_low)} trace-coverage (non-gating), "
             f"{len(below_eff)} below-scaling-efficiency (non-gating)"
         )
         return 1 if (bad or corrupt or breaches or scaling_bad
-                     or copy_bad) else 0
+                     or copy_bad or trace_bad) else 0
 
     if roofline_only:
         out = []
@@ -600,6 +725,10 @@ def main(argv=None):
         n: v for n, v in trend.analyze_copy_budget(events).items()
         if v["verdict"] == "copy_regression"
     }
+    trace_bad = {
+        n: v for n, v in trend.analyze_trace_budget(events).items()
+        if v["verdict"] == "trace_inconsistent"
+    }
     trend_section(verdicts, out)
     roofline_section(verdicts, out)
     span_section(events, out)
@@ -609,14 +738,16 @@ def main(argv=None):
     slo_section(out)
     scaling_section(scaling_analysis, out)
     copy_section(events, out)
+    reqtrace_section(events, out)
+    shapes_section(events, out)
     metrics_section(events, out)
     out.append("")
-    if bad or scaling_bad or copy_bad:
+    if bad or scaling_bad or copy_bad or trace_bad:
         out.append(
             "VERDICT: " + "; ".join(
                 f"{n} {v['verdict']}"
-                for n, v in {**bad, **scaling_bad,
-                             **copy_bad}.items()
+                for n, v in {**bad, **scaling_bad, **copy_bad,
+                             **trace_bad}.items()
             )
         )
     else:
@@ -629,7 +760,7 @@ def main(argv=None):
             )
         )
     print("\n".join(out))
-    return 1 if bad or scaling_bad or copy_bad else 0
+    return 1 if bad or scaling_bad or copy_bad or trace_bad else 0
 
 
 if __name__ == "__main__":

@@ -118,22 +118,33 @@ fi
     #      at record shapes THROUGH the socket, shut it down cleanly —
     #      so every healthy window also buys a p99 datapoint for the
     #      real service path (queueing, bucketing, batching windows),
-    #      not just in-process dispatch. Non-gating (obs_check picks a
-    #      confirmed breach up as rc 1 WARN), never stamped, after
-    #      prewarm_all so the daemon opens onto a warm manifest; the
-    #      stop runs whatever the loadgen rc so a failed burst cannot
-    #      leak a daemon into the next window.
+    #      not just in-process dispatch. The 60 s is SPLIT 30+30: the
+    #      first half runs TRACED (daemon under TPK_TRACE=1, a fixed
+    #      seed) so every healthy window also banks real request
+    #      timelines for trace_report/obs_report (docs/OBSERVABILITY
+    #      .md §request tracing) at no extra chip cost; the second
+    #      half keeps an untraced tail sample. Non-gating (obs_check
+    #      picks a confirmed breach OR trace_inconsistent up as rc 1
+    #      WARN), never stamped, after prewarm_all so the daemon
+    #      opens onto a warm manifest; the stop runs whatever the
+    #      loadgen rcs so a failed burst cannot leak a daemon into
+    #      the next window.
     S("serve_probe", """
 set -o pipefail
 serve_log="docs/logs/serve_probe_$(date +%Y-%m-%d_%H%M%S).log"
 serve_probe_body() {
-  python tools/serve_ctl.py start --wait 30 || return $?
-  timeout -k 10 100 python tools/loadgen.py --serve default \\
-      --mix all --arrivals poisson --duration 60 --rate 8 \\
+  env TPK_TRACE=1 python tools/serve_ctl.py start --wait 30 \\
+      || return $?
+  timeout -k 10 70 env TPK_TRACE=1 python tools/loadgen.py \\
+      --serve default --mix all --arrivals poisson --duration 30 \\
+      --rate 8 --requests 0 --shapes record --seed 5
+  rc_traced=$?
+  timeout -k 10 70 python tools/loadgen.py --serve default \\
+      --mix all --arrivals poisson --duration 30 --rate 8 \\
       --requests 0 --shapes record
   rc=$?
   python tools/serve_ctl.py stop
-  return $rc
+  [ $rc_traced -eq 0 ] && [ $rc -eq 0 ]
 }
 if serve_probe_body >"$serve_log" 2>&1; then
   tail -1 "$serve_log"
@@ -152,22 +163,29 @@ fi
     #       one worker drained AND restored mid-burst (the rolling-
     #       restart rehearsal: zero accepted requests may drop), then
     #       a clean stop whatever the loadgen rcs so a failed burst
-    #       cannot leak a fleet into the next window. Non-gating
-    #       (obs_check picks a confirmed per-tenant breach up as rc 1
-    #       WARN); never stamped; after prewarm_all so the workers
-    #       open onto a warm manifest.
+    #       cannot leak a fleet into the next window. The fleet runs
+    #       under TPK_TRACE=1 and the steady client is traced too
+    #       (seeded), so the burst ALSO banks cross-process request
+    #       timelines — router spill + drain hops included — at no
+    #       extra chip cost (docs/OBSERVABILITY.md §request tracing).
+    #       Non-gating (obs_check picks a confirmed per-tenant breach
+    #       OR trace_inconsistent up as rc 1 WARN); never stamped;
+    #       after prewarm_all so the workers open onto a warm
+    #       manifest.
     S("fleet_probe", """
 set -o pipefail
 fleet_log="docs/logs/fleet_probe_$(date +%Y-%m-%d_%H%M%S).log"
 fleet_probe_body() {
-  python tools/serve_ctl.py start-fleet 2 --wait 60 || return $?
+  env TPK_TRACE=1 python tools/serve_ctl.py start-fleet 2 \\
+      --wait 60 || return $?
   front=$(python -c "from tpukernels.serve import fleet
 print(fleet.front_socket_path())")
   timeout -k 10 100 python tools/loadgen.py --serve "$front" \\
       --mix all --arrivals bursty --duration 60 --rate 10 \\
       --requests 0 --shapes record --tenant hot &
   lg_hot=$!
-  timeout -k 10 100 python tools/loadgen.py --serve "$front" \\
+  timeout -k 10 100 env TPK_TRACE=1 python tools/loadgen.py \\
+      --serve "$front" \\
       --mix all --arrivals poisson --duration 60 --rate 2 \\
       --requests 0 --shapes record --tenant steady --seed 3 &
   lg_steady=$!

@@ -1,0 +1,237 @@
+"""Per-request timelines from the serve journals: waterfalls + the
+phase-attribution table (docs/OBSERVABILITY.md §request tracing).
+
+Usage:
+    python tools/trace_report.py [journal.jsonl ...]
+    python tools/trace_report.py --request lg7-00042
+    python tools/trace_report.py --slowest 5
+
+With no journal arguments, reads the newest docs/logs/health_*.jsonl
+(the health_report convention). The assembler
+(``tpukernels/obs/reqtrace.py``) joins every process's evidence —
+the client's ``serve_client_request`` walls, the router's
+``serve_route``/``serve_spill`` placements, the workers'
+``serve_request`` records and request-tagged spans — on the
+client-minted ``request_id``, so one report answers "where did THIS
+request's time go" across the whole fleet:
+
+- **aggregate table** — phase-attribution percentiles per (kernel,
+  bucket, tenant): queue wait, lock wait, pad, dispatch, compile,
+  integrity, unaccounted.
+- **waterfalls** — per-request lanes (client / router / worker pids)
+  with per-process offsets anchored to each process's own
+  ``serve_start`` (clock-skew rule), spill hops, explicit GAP lines
+  for abandoned workers, and the request's critical path.
+
+Degrades loudly: ``serve_request`` events without a request_id (an
+old server, tracing off) are counted and announced, never silently
+dropped — and never crash the report.
+
+Exit codes: 0 — report rendered (even when nothing assembled: the
+loud "no timelines" note IS the report); 1 — no journal found;
+2 — usage error.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpukernels.obs import reqtrace  # noqa: E402
+from tpukernels.resilience import journal as _journal  # noqa: E402
+
+_BAR_COLS = 36
+
+
+def _ms(v, width=9):
+    if v is None:
+        return " " * (width - 1) + "-"
+    return f"{v * 1e3:>{width}.3f}"
+
+
+def _bar(rel0, rel1, scale_s):
+    """A fixed-width lane bar for one segment at its per-process
+    offset; degenerate scales render position-less."""
+    if not scale_s or scale_s <= 0:
+        return "." * _BAR_COLS
+    a = min(_BAR_COLS, int(rel0 / scale_s * _BAR_COLS))
+    b = min(_BAR_COLS, max(a + 1, int(rel1 / scale_s * _BAR_COLS)))
+    return " " * a + "=" * (b - a) + " " * (_BAR_COLS - b)
+
+
+def waterfall(t: dict) -> list:
+    """Render one assembled timeline as text lines."""
+    rid = t["request_id"]
+    cw = t["client_wall_s"]
+    out = [
+        f"request {rid}  kernel={t['kernel'] or '?'} "
+        f"bucket={t['bucket'] or '-'} tenant={t['tenant'] or '-'}"
+        + (f" worker={t['worker_id']}" if t["worker_id"] is not None
+           else "")
+        + (f"  client wall {cw * 1e3:.3f}ms" if cw else "")
+        + (f" (coverage {t['coverage']:.0%})"
+           if t["coverage"] is not None else "")
+    ]
+    client = t["client"]
+    if client is not None:
+        out.append(
+            f"  [client pid {client.get('pid')}] "
+            + ("warm " if client.get("warm") else "")
+            + ("ok" if client.get("ok")
+               else f"DROPPED ({client.get('error')})")
+            + (f", {t['rejections']} rejection(s)"
+               if t["rejections"] else "")
+            + (f", {t['throttles']} tenant throttle(s)"
+               if t["throttles"] else "")
+        )
+    for ev in t["route"]:
+        out.append(
+            f"  [router pid {ev.get('pid')}] -> worker "
+            f"{ev.get('worker')}"
+            + (f" (spilled from {ev.get('spilled_from')})"
+               if ev.get("spilled_from") is not None else "")
+        )
+    for ev in t["spills"]:
+        out.append(
+            f"  [router pid {ev.get('pid')}] SPILL worker "
+            f"{ev.get('from_worker')} -> {ev.get('to_worker')} "
+            f"({ev.get('reason')})"
+        )
+    # one lane per process, offsets anchored to that process's own
+    # serve_start; scale = the widest lane so bars stay comparable
+    # within a lane even when clocks are skewed across lanes
+    by_pid: dict = {}
+    for s in t["segments"]:
+        by_pid.setdefault(s["pid"], []).append(s)
+    for pid, segs in by_pid.items():
+        scale = max(s["rel1"] for s in segs) or None
+        for s in segs:
+            out.append(
+                f"  [worker pid {pid}] "
+                f"{_bar(s['rel0'], s['rel1'], scale)} "
+                f"{s['name']:<32} {_ms(s['wall_s'])}ms "
+                f"@+{s['rel0'] * 1e3:.3f}ms"
+                + ("" if s.get("ok", True) else " FAILED")
+            )
+    for g in t["gaps"]:
+        out.append(f"  GAP ({g['kind']}): {g['detail']}")
+    if t.get("critical_path"):
+        out.append(
+            "  critical path: "
+            + " > ".join(f"{ph} {v * 1e3:.3f}ms"
+                         for ph, v in t["critical_path"])
+        )
+    return out
+
+
+def aggregate_table(agg: dict) -> list:
+    phases = [p for p in reqtrace.PHASES]
+    hdr = (f"{'kernel|bucket|tenant':<40} {'n':>4} "
+           f"{'client_p99':>10} "
+           + " ".join(f"{p[:9]:>9}" for p in phases))
+    out = ["phase attribution (p50 ms in phase) per "
+           "(kernel, bucket, tenant); client p99 ms:",
+           hdr, "-" * len(hdr)]
+    for key, g in agg.items():
+        cells = []
+        for p in phases:
+            ph = g["phases"].get(p)
+            cells.append(_ms(ph["p50_s"]) if ph else
+                         " " * 8 + "-")
+        out.append(
+            f"{key:<40} {g['n']:>4} {_ms(g['client_p99_s'], 10)} "
+            + " ".join(cells)
+            + (f"  {g['gaps']} gap(s)" if g["gaps"] else "")
+        )
+    return out
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    paths: list = []
+    want_request = None
+    slowest = 3
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--request":
+                want_request = next(it)
+            elif a == "--slowest":
+                slowest = int(next(it))
+            elif a.startswith("--"):
+                print(__doc__, file=sys.stderr)
+                print(f"trace_report: unknown argument {a!r}",
+                      file=sys.stderr)
+                return 2
+            else:
+                paths.append(a)
+    except (StopIteration, ValueError):
+        print(f"trace_report: {a} needs a value", file=sys.stderr)
+        return 2
+    if not paths:
+        found = sorted(
+            glob.glob(os.path.join(_REPO, "docs", "logs",
+                                   "health_*.jsonl")),
+            key=os.path.basename,
+        )
+        if not found:
+            print("trace_report: no docs/logs/health_*.jsonl found",
+                  file=sys.stderr)
+            return 1
+        paths = [found[-1]]
+
+    events, bad = _journal.load_events(paths)
+    tls = reqtrace.assemble(events)
+    untraced = reqtrace.untraced_serve_requests(events)
+    print("trace_report: "
+          + ", ".join(os.path.relpath(p) for p in paths))
+    traced = [t for t in tls.values() if t["segments"]]
+    gaps = sum(len(t["gaps"]) for t in tls.values())
+    print(
+        f"{len(tls)} request timeline(s) assembled, {len(traced)} "
+        f"with span evidence, {gaps} gap(s)"
+        + (f", {bad} unparseable line(s)" if bad else "")
+    )
+    if untraced:
+        # degrade LOUDLY: these served requests exist but cannot join
+        print(
+            f"NOTE: {untraced} serve_request event(s) carry no "
+            "request_id (old server or pre-tracing client) - served "
+            "but not assembled into timelines"
+        )
+    if not tls:
+        print("no request timelines in this journal - run a traced "
+              "loadgen --serve burst (TPK_TRACE=1) to bank some")
+        return 0
+
+    print()
+    for line in aggregate_table(reqtrace.aggregate(tls)):
+        print(line)
+
+    if want_request is not None:
+        t = tls.get(want_request)
+        if t is None:
+            print(f"\ntrace_report: request {want_request!r} not in "
+                  "this journal; known ids e.g. "
+                  f"{sorted(tls)[:5]}", file=sys.stderr)
+            return 2
+        chosen = [t]
+    else:
+        chosen = sorted(
+            (t for t in tls.values()
+             if t["client_wall_s"] is not None),
+            key=lambda t: -(t["client_wall_s"] or 0.0),
+        )[:max(0, slowest)]
+    for t in chosen:
+        print()
+        for line in waterfall(t):
+            print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,386 @@
+"""Per-request timeline assembly over the multi-process serve
+journals (docs/OBSERVABILITY.md §request tracing).
+
+The serving path is now a fleet: a p99 breach's tail can hide in
+router admission, a spill hop, the batch coalescing window, a bucket
+lock, pad staging, a cold compile, or the kernel itself — and every
+prior observability layer (spans, SLO histograms, copy accounting)
+is per-process, so none of them can say WHICH. This module joins the
+journals the fleet's processes already write — the client's
+``serve_client_request`` walls, the router's ``serve_route``/
+``serve_spill`` placements, the workers' ``serve_request`` records
+and request-tagged ``span`` events — on the client-minted
+``request_id`` into one causal timeline per request, then decomposes
+each into phases and a critical path, so a latency investigation is
+a journal query instead of a reproduction.
+
+Assembly rules:
+
+- **Clock anchoring** — event ``t`` stamps come from each process's
+  own wall clock; durations (``wall_s``) are monotonic-clock spans
+  and therefore skew-immune. Cross-process ORDER is causal (client ⊃
+  router ⊃ worker), never derived from comparing raw ``t`` across
+  pids; per-process display offsets (``rel0``/``rel1``) anchor each
+  segment to its OWN process's ``serve_start`` stamp (or the pid's
+  first segment when the journal predates the daemon's start event),
+  so a skewed worker clock shifts its lane, not the decomposition.
+- **Gaps are explicit** — a ``serve_request_requeued`` marker means
+  an abandoned worker attempt whose spans may never close: the
+  timeline carries a loud ``abandoned-worker`` gap entry, never a
+  silently shorter phase sum. A client-confirmed request with no
+  ``serve_request`` record at all gets a ``missing-server-record``
+  gap (the worker died between dispatch and journal).
+- **Degrade loudly, never crash** — a pre-request_id journal (old
+  server, tracing off) assembles to zero timelines;
+  :func:`untraced_serve_requests` counts what could not be joined so
+  every consumer (``tools/trace_report.py``, ``obs_report``,
+  ``loadgen``'s budget stamp) can say so out loud.
+
+Phase decomposition per request (seconds, exclusive):
+``queue_wait`` (admission→worker start, coalescing window included),
+``lock_wait`` (bucket-lock acquisition), ``pad`` (staging),
+``dispatch`` (the ``serve/<kernel>`` span minus its aot/integrity
+children), ``compile`` (``aot/lower`` + ``aot/compile`` children),
+``integrity`` (canary checks), ``unaccounted`` (client wall minus
+every accounted phase — wire framing, router relay, client-side
+work). ``accounted / client_wall`` is both verdict surfaces in one
+number: under :func:`coverage_min` it flags ``trace_coverage``
+(non-gating — the timeline explains too little of the wall); over
+``1 + SUM_TOL`` on a CLEAN request (no requeue, no spill, no
+rejection, no tenant throttle — an abandoned attempt's
+late-unwinding span may legitimately overrun its client wall, and a
+throttled request's wall includes backoff sleeps no span covers) it
+is ``trace_inconsistent``
+and GATES like the PR-12 copy budget (``trend.analyze_trace_budget``
+over the ``serve_trace_budget`` events ``loadgen --serve`` stamps).
+
+Stdlib-only, like ``trend.py``: report tools must run on a
+journal-only host.
+"""
+
+from __future__ import annotations
+
+import os
+
+# accounted phases may not exceed the client-observed wall beyond
+# this fraction on a clean request: durations nest physically, so an
+# overrun means double-counted or mis-joined segments (the documented
+# tolerance absorbs sub-ms rounding of the journal's stamps)
+SUM_TOL = 0.10
+
+DEFAULT_COVERAGE_MIN = 0.5
+
+# report ordering for the phase tables (unaccounted always last)
+PHASES = ("queue_wait", "lock_wait", "pad", "dispatch", "compile",
+          "integrity", "unaccounted")
+
+
+def coverage_min() -> float:
+    """``TPK_TRACE_COVERAGE_MIN`` (default 0.5), fail-loud parse in
+    [0, 1]: the documented fraction of the client-observed wall the
+    accounted phases must cover before a timeline stops flagging
+    ``trace_coverage`` (non-gating)."""
+    raw = os.environ.get("TPK_TRACE_COVERAGE_MIN")
+    if raw is None or not raw.strip():
+        return DEFAULT_COVERAGE_MIN
+    try:
+        val = float(raw)
+    except ValueError:
+        val = -1.0
+    if not 0.0 <= val <= 1.0:
+        raise ValueError(
+            f"TPK_TRACE_COVERAGE_MIN={raw!r}: expected a float in "
+            "[0, 1]"
+        )
+    return val
+
+
+def phase_of(name: str) -> str | None:
+    """Span path → timeline phase (docs/OBSERVABILITY.md §request
+    tracing). aot/integrity children classify by their own area
+    wherever they nest; anything else under ``serve/`` or
+    ``dispatch/`` is dispatch work."""
+    if "aot/" in name:
+        return "compile"
+    if "integrity/" in name:
+        return "integrity"
+    if name.startswith("serve/wait/queue"):
+        return "queue_wait"
+    if name.startswith("serve/wait/lock"):
+        return "lock_wait"
+    if name.startswith("serve/pad"):
+        return "pad"
+    if name.startswith(("serve/", "dispatch/")):
+        return "dispatch"
+    return None
+
+
+def untraced_serve_requests(events) -> int:
+    """``serve_request`` events carrying NO request_id — a
+    pre-tracing server or client in the mix. Counted so every
+    consumer degrades loudly instead of silently assembling a partial
+    story."""
+    return sum(
+        1 for e in events
+        if e.get("kind") == "serve_request"
+        and e.get("request_id") is None
+    )
+
+
+def _new_timeline(rid) -> dict:
+    return {
+        "request_id": rid, "kernel": None, "bucket": None,
+        "tenant": None, "worker_id": None,
+        "client": None, "server": [], "route": [], "spills": [],
+        "rejections": 0, "throttles": 0, "requeued": False,
+        "segments": [], "gaps": [],
+    }
+
+
+def assemble(events) -> dict:
+    """``{request_id: timeline}`` over journal events (any mix of
+    processes/files). Tolerant by design: unknown kinds are skipped,
+    malformed stamps contribute what they can, and nothing here ever
+    raises on journal content — a truncated journal is exactly when a
+    postmortem needs whatever assembles."""
+    anchors: dict = {}   # pid -> its own serve_start wall-clock t
+    tls: dict = {}
+
+    def tl(rid):
+        t = tls.get(rid)
+        if t is None:
+            t = tls[rid] = _new_timeline(rid)
+        return t
+
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "serve_start":
+            pid = ev.get("pid")
+            if pid is not None and pid not in anchors:
+                anchors[pid] = ev.get("t")
+            continue
+        rid = ev.get("request_id")
+        if rid is None:
+            continue
+        rid = str(rid)
+        if kind == "serve_client_request":
+            t = tl(rid)
+            t["client"] = ev
+            t["kernel"] = t["kernel"] or ev.get("kernel")
+            if ev.get("tenant") is not None:
+                t["tenant"] = ev.get("tenant")
+        elif kind == "serve_request":
+            t = tl(rid)
+            t["server"].append(ev)
+        elif kind == "serve_route":
+            t = tl(rid)
+            t["route"].append(ev)
+            t["kernel"] = t["kernel"] or ev.get("kernel")
+            t["bucket"] = t["bucket"] or ev.get("bucket")
+        elif kind == "serve_spill":
+            tl(rid)["spills"].append(ev)
+        elif kind == "serve_rejected":
+            tl(rid)["rejections"] += 1
+        elif kind == "serve_tenant_throttled":
+            # a throttled-then-retried request's wall includes the
+            # backoff sleeps no span covers: it must not feed the
+            # consistency/coverage gate as "clean"
+            tl(rid)["throttles"] += 1
+        elif kind == "serve_request_requeued":
+            t = tl(rid)
+            t["requeued"] = True
+            t["gaps"].append({
+                "kind": "abandoned-worker", "pid": ev.get("pid"),
+                "t": ev.get("t"),
+                "detail": (f"worker abandoned after "
+                           f"{ev.get('timeout_s')}s; the attempt's "
+                           "spans may never close"),
+            })
+        elif kind == "span":
+            wall = ev.get("wall_s")
+            wall = wall if isinstance(wall, (int, float)) else 0.0
+            te = ev.get("t")
+            te = te if isinstance(te, (int, float)) else 0.0
+            name = str(ev.get("name") or "?")
+            tl(rid)["segments"].append({
+                "name": name, "phase": phase_of(name),
+                "pid": ev.get("pid"), "wall_s": wall,
+                "t0": te - wall, "t1": te,
+                "depth": ev.get("depth") or 1,
+                "ok": ev.get("ok", True),
+            })
+
+    for t in tls.values():
+        _finalize(t, anchors)
+    return tls
+
+
+def _finalize(t: dict, anchors: dict):
+    segs = t["segments"]
+    segs.sort(key=lambda s: (str(s["pid"]), s["t0"]))
+    # per-process anchoring: each segment's display offset is
+    # relative to ITS OWN process's serve_start (fallback: the pid's
+    # first segment) — cross-process clock skew moves a lane's
+    # anchor, never the phase arithmetic (durations only)
+    first_by_pid: dict = {}
+    for s in segs:
+        first_by_pid.setdefault(s["pid"], s["t0"])
+    for s in segs:
+        anchor = anchors.get(s["pid"])
+        if anchor is None:
+            anchor = first_by_pid[s["pid"]]
+        s["rel0"] = round(max(0.0, s["t0"] - anchor), 6)
+        s["rel1"] = round(max(0.0, s["t1"] - anchor), 6)
+
+    # the request of record among (possibly several — a wedged home
+    # attempt plus its spill sibling) server records: prefer the ok
+    # answer, else the latest
+    final = None
+    for ev in sorted(t["server"], key=lambda e: e.get("t") or 0.0):
+        if final is None:
+            final = ev
+        elif bool(ev.get("ok")) or not final.get("ok"):
+            # an ok answer beats any failure; among equals the
+            # latest wins (the spill sibling supersedes the home)
+            final = ev
+    t["final"] = final
+    if final is not None:
+        t["kernel"] = t["kernel"] or final.get("kernel")
+        t["bucket"] = final.get("bucket") or t["bucket"]
+        t["tenant"] = (final.get("tenant")
+                       if final.get("tenant") is not None
+                       else t["tenant"])
+        t["worker_id"] = final.get("worker_id")
+    client = t["client"]
+    if (final is None and client is not None and client.get("ok")
+            and t["rejections"] == 0):
+        t["gaps"].append({
+            "kind": "missing-server-record", "pid": None, "t": None,
+            "detail": ("client saw a completed request but no worker "
+                       "journaled it (worker died or journals "
+                       "elsewhere)"),
+        })
+
+    phases = {ph: 0.0 for ph in PHASES if ph != "unaccounted"}
+    top_dispatch = 0.0
+    for s in segs:
+        ph = s["phase"]
+        if ph in ("queue_wait", "lock_wait", "pad",
+                  "compile", "integrity"):
+            phases[ph] += s["wall_s"]
+        elif ph == "dispatch" and s["depth"] == 1:
+            # depth-1 serve/<kernel> (or in-process dispatch/<kernel>)
+            # spans only: their nested dispatch/aot children are
+            # interior and must not double-count
+            top_dispatch += s["wall_s"]
+    phases["dispatch"] = max(
+        0.0, top_dispatch - phases["compile"] - phases["integrity"]
+    )
+    accounted = (phases["queue_wait"] + phases["lock_wait"]
+                 + phases["pad"] + top_dispatch)
+    t["accounted_s"] = round(accounted, 6)
+    cw = None
+    if client is not None and isinstance(client.get("wall_s"),
+                                         (int, float)):
+        cw = client["wall_s"]
+    t["client_wall_s"] = cw
+    if cw and segs:
+        t["coverage"] = round(accounted / cw, 4)
+        phases["unaccounted"] = max(0.0, cw - accounted)
+    else:
+        t["coverage"] = None
+    t["phases"] = {ph: round(v, 6) for ph, v in phases.items() if v}
+    t["clean"] = bool(
+        final is not None and final.get("ok")
+        and not t["requeued"] and not t["spills"]
+        and t["rejections"] == 0 and t["throttles"] == 0
+        and len(t["server"]) == 1
+    )
+    ranked = sorted(t["phases"].items(), key=lambda kv: -kv[1])
+    t["critical_path"] = ranked
+    t["dominant"] = ranked[0][0] if ranked else None
+
+
+def _pct(vals, q):
+    if not vals:
+        return None
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+
+def aggregate(timelines) -> dict:
+    """Phase-attribution percentiles per (kernel, bucket, tenant)
+    over assembled timelines — the table behind ``trace_report`` and
+    ``obs_report``'s request-phase section. Keys are
+    ``kernel|bucket|tenant``; per key: request count, client-wall
+    p50/p99 and per-phase p50/p99/mean seconds."""
+    groups: dict = {}
+    for t in timelines.values():
+        key = (t["kernel"] or "?", t["bucket"] or "-",
+               t["tenant"] or "-")
+        g = groups.setdefault(key, {"n": 0, "client": [],
+                                    "phases": {}, "gaps": 0})
+        g["n"] += 1
+        g["gaps"] += len(t["gaps"])
+        if t["client_wall_s"] is not None:
+            g["client"].append(t["client_wall_s"])
+        for ph, v in t.get("phases", {}).items():
+            g["phases"].setdefault(ph, []).append(v)
+    out = {}
+    for (kernel, bucket, tenant), g in sorted(groups.items()):
+        out[f"{kernel}|{bucket}|{tenant}"] = {
+            "kernel": kernel, "bucket": bucket, "tenant": tenant,
+            "n": g["n"], "gaps": g["gaps"],
+            "client_p50_s": _pct(g["client"], 0.5),
+            "client_p99_s": _pct(g["client"], 0.99),
+            "phases": {
+                ph: {
+                    "n": len(vals),
+                    "p50_s": _pct(vals, 0.5),
+                    "p99_s": _pct(vals, 0.99),
+                    "mean_s": round(sum(vals) / len(vals), 6),
+                }
+                for ph, vals in sorted(g["phases"].items())
+            },
+        }
+    return out
+
+
+def run_budget(events, request_ids=None) -> dict | None:
+    """One run's trace-budget summary — the payload ``loadgen
+    --serve`` stamps as a ``serve_trace_budget`` event (the
+    ``serve_copy_budget`` pattern) for ``trend.analyze_trace_budget``
+    to judge. ``request_ids`` restricts to the ids the run minted so
+    a shared journal's other traffic cannot pollute the verdict.
+    Returns None when nothing assembled (journal off, no serve
+    traffic)."""
+    tls = assemble(events)
+    if request_ids is not None:
+        wanted = {str(r) for r in request_ids}
+        tls = {r: t for r, t in tls.items() if r in wanted}
+    if not tls:
+        return None
+    traced = [t for t in tls.values() if t["segments"]]
+    cov = [t["coverage"] for t in traced if t["coverage"] is not None]
+    clean = [t["coverage"] for t in traced
+             if t["clean"] and t["coverage"] is not None]
+    out = {
+        "requests": (len(request_ids) if request_ids is not None
+                     else len(tls)),
+        "assembled": len(tls),
+        "traced": len(traced),
+        "clean": len(clean),
+        "gaps": sum(len(t["gaps"]) for t in tls.values()),
+        "untraced_serve_requests": untraced_serve_requests(events),
+        "coverage_floor": coverage_min(),
+        "sum_tol": SUM_TOL,
+    }
+    if cov:
+        out["coverage_mean"] = round(sum(cov) / len(cov), 4)
+        out["coverage_low"] = round(min(cov), 4)
+    if clean:
+        # the gating surface: only CLEAN requests — an abandoned
+        # attempt's late-unwinding span can legitimately overrun the
+        # client wall that stopped waiting for it
+        out["sum_ratio_max"] = round(max(clean), 4)
+    return out

@@ -29,6 +29,18 @@ as ``measure/sgemm/slope/compile``. The span stack is PER-THREAD
 but the serve daemon's worker threads (docs/SERVING.md) each trace
 their own ``serve/<kernel>`` requests concurrently, and a shared
 stack would interleave their paths into nonsense.
+
+Request trace-context (docs/OBSERVABILITY.md §request tracing): the
+serving path carries a client-minted ``request_id`` end to end, and
+``with request_ctx(rid):`` binds it as the calling thread's AMBIENT
+request — every span the thread emits while the context is open
+(the serve worker's wait/pad/dispatch spans AND their nested
+aot/integrity children, none of which know about requests) carries
+``request_id`` with zero per-callsite changes. ``emit_span`` is the
+passive-wait twin of :func:`span`: a phase measured from timestamps
+(queue wait, lock wait) rather than a with-block still lands as one
+``span`` event, so ``obs/reqtrace.py`` assembles timelines from one
+event shape.
 """
 
 from __future__ import annotations
@@ -79,6 +91,43 @@ def current_path() -> str | None:
     return "/".join(s) if s else None
 
 
+def current_request() -> str | None:
+    """The calling thread's ambient request id, or None."""
+    return getattr(_TLS, "request", None)
+
+
+class _RequestCtx:
+    """Binds (and restores on exit) the per-thread ambient request id.
+    Always active — unlike spans it is two attribute writes, and the
+    journal tagging on ``serve_request``/``serve_route`` events is
+    unconditional anyway; only SPAN emission stays gated on
+    ``TPK_TRACE``."""
+
+    __slots__ = ("rid", "prev")
+
+    def __init__(self, rid):
+        self.rid = rid
+
+    def __enter__(self):
+        self.prev = getattr(_TLS, "request", None)
+        _TLS.request = self.rid
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.request = self.prev
+        return False
+
+
+def request_ctx(request_id):
+    """Context manager binding ``request_id`` as the calling thread's
+    ambient request (docs/OBSERVABILITY.md §request tracing): every
+    span emitted inside it — including nested aot/integrity children
+    that know nothing about requests — carries ``request_id`` on its
+    event. ``None`` is a valid binding (an untraced old client's
+    request): spans then stay untagged."""
+    return _RequestCtx(request_id)
+
+
 class _NoopSpan:
     """Shared do-nothing context manager for the disabled path — no
     allocation, no clock read, no stack touch per ``span()`` call."""
@@ -101,7 +150,7 @@ _NOOP = _NoopSpan()
 # allowed to raise a duplicate-kwarg TypeError out of __exit__ or to
 # clobber the journal's timestamp/pid stamps
 _RESERVED = ("kind", "ts", "t", "pid", "git_head",
-             "name", "wall_s", "depth", "ok")
+             "name", "wall_s", "depth", "ok", "request_id")
 
 
 class _Span:
@@ -137,6 +186,9 @@ class _Span:
             depth=self.depth,
             ok=exc_type is None,
         )
+        rid = getattr(_TLS, "request", None)
+        if rid is not None:
+            payload["request_id"] = rid
         journal.emit("span", **payload)
         return False
 
@@ -161,6 +213,34 @@ def aggregate_spans(events) -> dict:
             if wall > a["max_s"]:
                 a["max_s"] = wall
     return agg
+
+
+def emit_span(name: str, wall_s: float, /, **fields):
+    """Emit one PRE-MEASURED span event: a passive wait whose wall
+    was derived from timestamps (a request's queue wait, a bucket-lock
+    wait) rather than wrapped in a with-block — the serve path's
+    phases land in the journal with the same event shape live spans
+    use, so ``reqtrace``/``aggregate_spans`` need no second schema.
+    Joins the calling thread's open span path and carries its ambient
+    request id, like a live span; with TPK_TRACE unset this is one
+    global check and nothing else runs."""
+    if not _ENABLED:
+        return
+    s = _stack()
+    payload = {
+        ("param_" + k if k in _RESERVED else k): v
+        for k, v in fields.items()
+    }
+    payload.update(
+        name="/".join([*s, name]) if s else name,
+        wall_s=round(wall_s, 6),
+        depth=len(s) + 1,
+        ok=True,
+    )
+    rid = getattr(_TLS, "request", None)
+    if rid is not None:
+        payload["request_id"] = rid
+    journal.emit("span", **payload)
 
 
 def span(name: str, /, **fields):

@@ -392,6 +392,77 @@ def analyze_copy_budget(events) -> dict:
     return verdicts
 
 
+def analyze_trace_budget(events) -> dict:
+    """Request-tracing verdicts over the ``serve_trace_budget``
+    events ``loadgen --serve`` stamps (docs/OBSERVABILITY.md §request
+    tracing) — the ``analyze_copy_budget`` pattern: only the latest
+    event per socket is judged.
+
+    - ``trace_inconsistent`` (GATES like a copy/bench regression): a
+      clean request's accounted phases summed past the
+      client-observed wall beyond the documented tolerance
+      (``reqtrace.SUM_TOL``) — durations nest physically, so an
+      overrun means the timeline assembly (or the span evidence
+      under it) is lying, and every conclusion drawn from it would
+      be too.
+    - ``trace_coverage`` (non-gating, the ``below_roofline``
+      pattern): timelines assembled but their accounted phases
+      explain less than the documented fraction
+      (``TPK_TRACE_COVERAGE_MIN``) of the client wall — the tail
+      lives somewhere the spans don't reach yet.
+    - ``ok`` otherwise (including runs with nothing traced: a
+      journal-off daemon is a coverage hole for the REPORT to shout
+      about, not a trend finding)."""
+    from tpukernels.obs import reqtrace
+
+    latest = {}
+    for e in events:
+        if e.get("kind") == "serve_trace_budget":
+            latest[str(e.get("socket"))] = e
+    verdicts = {}
+    for sock, e in sorted(latest.items()):
+        traced = e.get("traced") or 0
+        tol = e.get("sum_tol")
+        tol = tol if _is_measurement(tol) else reqtrace.SUM_TOL
+        floor = e.get("coverage_floor")
+        floor = (floor if _is_measurement(floor)
+                 else reqtrace.DEFAULT_COVERAGE_MIN)
+        srm = e.get("sum_ratio_max")
+        cov = e.get("coverage_mean")
+        name = f"trace[{os.path.basename(sock)}]"
+        flags = []
+        verdict = "ok"
+        if traced and _is_measurement(srm) and srm > 1.0 + tol:
+            verdict = "trace_inconsistent"
+            flags.append(
+                f"TRACE INCONSISTENT: accounted phases sum to "
+                f"{srm}x of the client-observed wall on a clean "
+                f"request (tolerance {tol:.0%}) over {traced} traced "
+                "request(s) - the timeline assembly cannot be "
+                "trusted"
+            )
+        elif traced and _is_measurement(cov) and cov < floor:
+            verdict = "trace_coverage"
+            flags.append(
+                f"TRACE COVERAGE: accounted phases explain only "
+                f"{cov:.0%} of the client-observed wall (floor "
+                f"{floor:.0%}, TPK_TRACE_COVERAGE_MIN; non-gating) "
+                f"over {traced} traced request(s)"
+            )
+        verdicts[name] = {
+            "verdict": verdict,
+            "requests": e.get("requests"),
+            "traced": traced,
+            "gaps": e.get("gaps"),
+            "untraced_serve_requests":
+                e.get("untraced_serve_requests"),
+            "coverage_mean": cov if _is_measurement(cov) else None,
+            "sum_ratio_max": srm if _is_measurement(srm) else None,
+            "flags": flags,
+        }
+    return verdicts
+
+
 def analyze_repo(root, eps=CEILING_EPS) -> dict:
     """One-call path for tools: series + baseline + verdicts."""
     return analyze(load_series(root), load_baseline(root), eps=eps)

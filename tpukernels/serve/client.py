@@ -72,6 +72,15 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
     recovering daemon in lockstep (the thundering-herd fix — seeded,
     so a loadgen run's schedule stays byte-reproducible). ``None``
     keeps the raw hint."""
+    # one LOGICAL request, one causal id: backpressure retries of the
+    # same request must not mint fresh request_ids, or the timeline
+    # assembler would see N unrelated one-hop requests instead of one
+    # request that waited out admission control
+    rid = getattr(cli, "next_request_id", None)
+    if rid is None:
+        mint = getattr(cli, "mint_request_id", None)
+        if mint is not None:
+            rid = cli.next_request_id = mint()
     tries = 0
     while True:
         try:
@@ -84,6 +93,8 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
             if jitter is not None:
                 wait *= 0.5 + jitter.random()
             time.sleep(wait)
+            if rid is not None:
+                cli.next_request_id = rid
 
 
 class ServeClient:
@@ -122,6 +133,16 @@ class ServeClient:
         self.inline_payloads = 0
         self.staged_payloads = 0
         self.bytes_copied = 0
+        # request tracing (docs/OBSERVABILITY.md §request tracing):
+        # every dispatch header carries a CLIENT-MINTED request_id —
+        # set next_request_id to choose it (loadgen seeds them
+        # deterministically), else one is minted per dispatch. Old
+        # servers ignore the field (the shm-lane negotiation pattern:
+        # request_trace in the pong says the server tags its journal).
+        self.next_request_id = None
+        self.last_request_id = None
+        self.request_trace = None   # from the pong; None = unknown
+        self._trace_seq = 0
 
     # ---------------------------------------------------------- #
     # transport                                                  #
@@ -192,7 +213,14 @@ class ServeClient:
         lanes = header.get("lanes")
         self._lanes = ([str(x) for x in lanes]
                        if isinstance(lanes, list) else ["inline"])
+        self.request_trace = bool(header.get("request_trace"))
         return header
+
+    def mint_request_id(self) -> str:
+        """One fresh causal request id (pid-scoped, monotonic): the
+        default when the caller never set ``next_request_id``."""
+        self._trace_seq += 1
+        return f"c{os.getpid():x}-{self._trace_seq}"
 
     def dispatch(self, kernel: str, *args, **statics):
         """One kernel request: numpy operands (host scalars as 0-d
@@ -207,8 +235,14 @@ class ServeClient:
                 self.ping()  # negotiate once per connection
             use_shm = "shm" in (self._lanes or ())
         self._rid += 1
+        rid_trace = self.next_request_id
+        self.next_request_id = None
+        if rid_trace is None:
+            rid_trace = self.mint_request_id()
+        self.last_request_id = str(rid_trace)
         req = {"v": protocol.VERSION, "op": "dispatch",
                "id": self._rid, "kernel": kernel, "statics": statics,
+               "request_id": self.last_request_id,
                "args": specs}
         if self.tenant is not None:
             req["tenant"] = self.tenant

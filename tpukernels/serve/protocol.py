@@ -11,10 +11,20 @@ never ride through a JSON string.
 The same framing serves both directions. Requests:
 
     {"v": 1, "op": "dispatch", "id": 7, "kernel": "scan",
-     "statics": {}, "args": [{"shape": [4093], "dtype": "int32"}]}
+     "statics": {}, "request_id": "c3f2a-12",
+     "args": [{"shape": [4093], "dtype": "int32"}]}
     + one payload buffer per ``args`` entry
 
     {"v": 1, "op": "ping"}        # liveness / stats, no payload
+
+``request_id`` is the CLIENT-MINTED causal trace id
+(docs/OBSERVABILITY.md §request tracing): the router relays it
+untouched and tags its routing evidence with it, the server tags its
+``serve_request``/span evidence, and ``obs/reqtrace.py`` joins the
+multi-process journals on it. It is negotiated like the shm lane —
+the pong advertises ``request_trace`` when the server tags its
+journal — and, like any unknown header field, is simply ignored by
+old servers, so a tracing client never needs a compatibility switch.
 
 Responses:
 

@@ -219,6 +219,7 @@ class Router:
         # carry what the workers can do (docs/SERVING.md §wire format)
         self._lanes_cache = None
         self._shm_min_cache = None
+        self._req_trace_cache = None   # workers' request_trace pong
         self._bytes_copied = 0               # relayed inline payload B
         self._t0 = time.time()
         # fail-fast on a misconfigured bucket table, like the worker:
@@ -344,6 +345,11 @@ class Router:
                 # answered = clients stay inline, the safe default)
                 "lanes": self._lanes_cache or ["inline"],
                 "shm_min_bytes": self._shm_min_cache,
+                # relayed like lanes/shm_min_bytes: the fleet is
+                # traced when its workers tag their journals (None
+                # passes through until one answered — "unknown" must
+                # not masquerade as an untraced fleet)
+                "request_trace": self._req_trace_cache,
                 "bytes_copied": self._bytes_copied,
                 "uptime_s": round(time.time() - self._t0, 3),
                 # loadgen --serve stamps its verdicts with these —
@@ -402,6 +408,9 @@ class Router:
                         if isinstance(lanes, list) else ["inline"]
                     )
                     self._shm_min_cache = header.get("shm_min_bytes")
+                    self._req_trace_cache = bool(
+                        header.get("request_trace")
+                    )
             if header.get("device_kind") or header.get("jax"):
                 with self._lock:
                     self._meta = {
@@ -528,6 +537,12 @@ class Router:
 
     def _route(self, conn: _Conn, header: dict, payloads):
         rid = header.get("id")
+        # the client-minted causal id rides the relayed header
+        # untouched; the router only TAGS its own routing evidence
+        # with it so cross-process timelines join (docs/OBSERVABILITY
+        # .md §request tracing)
+        req_id = header.get("request_id")
+        req_id = str(req_id) if req_id is not None else None
 
         def reply(h, p=()):
             try:
@@ -577,6 +592,7 @@ class Router:
             journal.emit(
                 "serve_tenant_throttled", tenant=tenant,
                 priority=priority, kernel=kernel, request=rid,
+                request_id=req_id,
                 retry_after_s=retry,
             )
             reply({"v": protocol.VERSION, "id": rid, "ok": False,
@@ -630,7 +646,8 @@ class Router:
             obs_metrics.inc("serve.spills")
             journal.emit(
                 "serve_spill", kernel=kernel, bucket=bucket,
-                request=rid, from_worker=idx, to_worker=sibling,
+                request=rid, request_id=req_id,
+                from_worker=idx, to_worker=sibling,
                 reason=reason, tenant=tenant,
             )
             spilled_from, idx = idx, sibling
@@ -648,6 +665,7 @@ class Router:
         )
         journal.emit(
             "serve_route", kernel=kernel, bucket=bucket, request=rid,
+            request_id=req_id,
             worker=idx, tenant=tenant, priority=priority,
             spilled_from=spilled_from,
             ok=bool(resp.get("ok")),

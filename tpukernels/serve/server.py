@@ -54,7 +54,11 @@ Observability rides the existing stack: a ``serve/<kernel>`` span per
 request, ``serve.*`` counters/histograms, and the
 ``serve_start``/``serve_request``/``serve_rejected``/
 ``serve_request_requeued``/``serve_stop`` journal kinds
-(docs/OBSERVABILITY.md). The daemon prints NOTHING to stdout on the
+(docs/OBSERVABILITY.md). Requests carry a client-minted
+``request_id`` (§request tracing): the worker thread binds it as its
+ambient trace context, so the wait/lock/pad/dispatch spans — and
+their nested aot/integrity children — plus every journal record of
+the request are causally joinable across the whole fleet. The daemon prints NOTHING to stdout on the
 clean path (notes go to stderr, evidence to the journal) — the
 byte-identical proof the fault/trace/AOT layers established, applied
 to a server.
@@ -154,12 +158,21 @@ class _Request:
     __slots__ = ("serial", "rid", "kernel", "statics", "arrays",
                  "spec", "pad_frac", "bucket", "conn", "t_enq",
                  "t_start", "requeues", "patience", "done", "lock",
-                 "worker_ident", "tenant", "shm_ok")
+                 "worker_ident", "tenant", "shm_ok", "request_id",
+                 "shapes", "dtypes")
 
     def __init__(self, serial, rid, kernel, statics, arrays, spec,
-                 pad_frac, bucket, conn, tenant=None, shm_ok=False):
+                 pad_frac, bucket, conn, tenant=None, shm_ok=False,
+                 request_id=None):
         self.serial = serial  # server-side key: client ids can collide
         self.rid = rid
+        # the client-minted causal id (docs/OBSERVABILITY.md §request
+        # tracing); None for pre-tracing clients. The requested (pre-
+        # pad) shapes/dtypes ride to the serve_request shape-mix
+        # record — the bucket-table optimizer's input (ROADMAP 5).
+        self.request_id = request_id
+        self.shapes = [list(a.shape) for a in arrays]
+        self.dtypes = [a.dtype.name for a in arrays]
         self.kernel = kernel
         self.statics = statics
         self.arrays = arrays
@@ -444,6 +457,11 @@ class Server:
             # so an old server (no "lanes" key) is spoken to inline
             "lanes": self._lanes(),
             "shm_min_bytes": self._shm_min if self._shm else None,
+            # request-tracing advertisement (the lane-negotiation
+            # pattern): this server tags its journal evidence with the
+            # client-minted request_id; old servers lack the key and
+            # simply ignore the header field
+            "request_trace": True,
             # the zero-copy + continuous-batching evidence operators
             # read off `serve_ctl status` without opening the journal
             "bytes_copied": self._bytes_copied,
@@ -519,10 +537,13 @@ class Server:
         with self._lock:
             self._next_rid += 1
             serial = self._next_rid
+        req_id = header.get("request_id")
         req = _Request(serial, rid if rid is not None else serial,
                        kernel, statics, arrays, spec, pad_frac,
                        bucket, conn, tenant=header.get("tenant"),
-                       shm_ok=bool(header.get("shm_ok")))
+                       shm_ok=bool(header.get("shm_ok")),
+                       request_id=(str(req_id) if req_id is not None
+                                   else None))
         try:
             self._q.put_nowait(req)
         except _queue_mod.Full:
@@ -536,6 +557,7 @@ class Server:
         retry = round(max(0.05, (depth + 1) * self._service_ewma), 3)
         journal.emit(
             "serve_rejected", kernel=req.kernel, request=req.rid,
+            request_id=req.request_id,
             depth=depth, queue_max=self.queue_max, retry_after_s=retry,
         )
         try:
@@ -695,6 +717,15 @@ class Server:
                     self._pad_pool.pop(bucket, None)
 
     def _execute(self, req: _Request, batch_size: int):
+        # ambient trace context for the whole attempt: every span the
+        # worker thread emits below — the wait/pad phases here AND the
+        # aot/integrity children nested under dispatch, which know
+        # nothing about requests — carries req.request_id
+        # (docs/OBSERVABILITY.md §request tracing)
+        with trace.request_ctx(req.request_id):
+            self._execute_attempt(req, batch_size)
+
+    def _execute_attempt(self, req: _Request, batch_size: int):
         import numpy as np
 
         from tpukernels import registry
@@ -709,6 +740,13 @@ class Server:
             self._inflight[req.serial] = req
         queue_wait = t_start - req.t_enq
         obs_metrics.observe("serve.queue_wait_s", queue_wait)
+        # the admission-to-worker-start wait (batch coalescing window
+        # included) as a pre-measured span: the request's first
+        # timeline phase (docs/OBSERVABILITY.md §request tracing)
+        trace.emit_span("serve/wait/queue", queue_wait,
+                        kernel=req.kernel, bucket=req.bucket,
+                        batch_size=batch_size,
+                        window_ms=self._last_window_ms)
         if req.spec is not None and req.requeues == 0:
             # once per request, not per attempt: a retry would count
             # the same padding waste twice
@@ -722,12 +760,18 @@ class Server:
             # only be reused while this thread owns the bucket (and by
             # the time the lock releases, jnp.asarray + the completed
             # dispatch are done with the staging buffers)
+            l0 = time.perf_counter()
             cell = self._acquire_bucket(req.bucket)
+            trace.emit_span("serve/wait/lock",
+                            time.perf_counter() - l0,
+                            bucket=req.bucket)
             if req.spec is not None:
                 with self._lock:
                     pool = self._pad_pool.setdefault(req.bucket, {})
-                args, meta = bucketing.pad_args(req.kernel, req.spec,
-                                                req.arrays, pool=pool)
+                with trace.span("serve/pad", kernel=req.kernel,
+                                bucket=req.bucket):
+                    args, meta = bucketing.pad_args(
+                        req.kernel, req.spec, req.arrays, pool=pool)
                 # padding is a genuinely extra staging copy — counted,
                 # unlike the one producer-to-consumer payload move
                 self._count_copied(req.kernel,
@@ -826,11 +870,20 @@ class Server:
             header = {"v": protocol.VERSION, "id": req.rid, "ok": False,
                       "kind": kind, "error": error}
             payloads = ()
+        if req.request_id is not None:
+            obs_metrics.inc("serve.requests_traced")
         journal.emit(
             "serve_request", kernel=req.kernel, request=req.rid,
+            request_id=req.request_id,
+            worker_id=os.environ.get("TPK_SERVE_WORKER_ID"),
             tenant=req.tenant,
             bucket=req.bucket, pad_frac=round(req.pad_frac, 6),
             bucketed=req.spec is not None,
+            # the per-request shape-mix record (requested, PRE-pad
+            # shapes/dtypes): the exact input ROADMAP item 5's
+            # bucket-table optimizer mines, aggregated by
+            # obs_report's shapes-seen table
+            shapes=req.shapes, dtypes=req.dtypes,
             wall_s=round(wall, 6),
             queue_wait_s=round(queue_wait, 6)
             if queue_wait is not None else None,
@@ -978,7 +1031,8 @@ class Server:
             obs_metrics.inc("serve.requeued")
             journal.emit(
                 "serve_request_requeued", kernel=req.kernel,
-                request=req.rid, bucket=req.bucket,
+                request=req.rid, request_id=req.request_id,
+                bucket=req.bucket,
                 timeout_s=self.request_timeout_s,
             )
             # forced: a request the service already accepted must not
