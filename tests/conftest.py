@@ -276,7 +276,16 @@ os.environ.pop("TPK_SERVE_BATCH_ADAPT", None)
 # An exported coverage floor would flip the request-tracing verdict
 # tests (docs/OBSERVABILITY.md §request tracing) — they pin their own.
 os.environ.pop("TPK_TRACE_COVERAGE_MIN", None)
+# Self-healing knobs (docs/SERVING.md §self-healing) are scrubbed for
+# the same reason: an operator's exported probe interval / backoff /
+# crash threshold would silently retime every fleet-health chaos test
+# — they pin their own values.
+os.environ.pop("TPK_FLEET_PROBE_S", None)
+os.environ.pop("TPK_FLEET_RESTART_MAX", None)
+os.environ.pop("TPK_FLEET_RESTART_BACKOFF_S", None)
 if "TPK_SERVE_DIR" not in os.environ:
+    import glob as _serve_glob
+    import signal as _serve_signal
     import tempfile
 
     _serve_dir = os.path.join(
@@ -284,6 +293,42 @@ if "TPK_SERVE_DIR" not in os.environ:
     )
     os.makedirs(_serve_dir, exist_ok=True)
     os.environ["TPK_SERVE_DIR"] = _serve_dir
+
+    # A previous run killed mid-chaos can leak LIVE daemons: the
+    # router's health manager respawns workers detached, and a hard
+    # test abort leaves them (and the respawning router) running
+    # against this reused per-user dir. Liveness is the pidfile
+    # flock; a held flock here can only be a leak — reap it before
+    # the stale-file cleanup so this suite's fleets start clean.
+    def _reap_stale_daemon(pidfile):
+        import fcntl
+
+        try:
+            f = open(pidfile)
+        except OSError:
+            return
+        with f:
+            content = f.readline().strip()
+            try:
+                fcntl.flock(f.fileno(),
+                            fcntl.LOCK_EX | fcntl.LOCK_NB)
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+                return  # not held: just a stale file
+            except OSError:
+                pass
+        if content.isdigit():
+            try:
+                os.kill(int(content), _serve_signal.SIGKILL)
+            except OSError:
+                pass
+
+    for _pidfile in (
+        [os.path.join(_serve_dir, "serve.pid"),
+         os.path.join(_serve_dir, "fleet", "router.pid")]
+        + _serve_glob.glob(os.path.join(_serve_dir, "fleet",
+                                        "worker*", "serve.pid"))
+    ):
+        _reap_stale_daemon(_pidfile)
     for _f in ("serve.sock", "serve.pid",
                os.path.join("fleet", "fleet.json"),
                os.path.join("fleet", "front.sock"),

@@ -1,0 +1,561 @@
+"""CPU chaos suite for the self-healing serving fleet
+(docs/SERVING.md §self-healing; ISSUE 14).
+
+The acceptance headline, all on CPU over Unix sockets: a `kill -9`'d
+worker mid-burst (the new ``kill_worker`` fault key, env-narrowed by
+``TPK_SERVE_WORKER_ID``) is detected within a probe interval, its shm
+leftovers swept, its in-flight request REPLAYED on the ring sibling
+(zero dropped accepted requests, the replay reassembling in
+``reqtrace`` with an explicit dead-worker gap), and the worker is
+respawned and back in the ring before the seeded loadgen run ends —
+with ``obs_report --check`` rc 0. Plus: crash-loop → loud quarantine
+instead of flapping, both-ring-members-down → priority-ordered
+shedding with honest retry hints, the client-side stale-socket
+reconnect across a daemon restart, and the pure units (pidfile
+probes, targeted shm sweep, retry-hint arithmetic, ``kill_worker``
+match rules, the reqtrace gap).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from test_distributed import _scrubbed_env
+from test_fleet import _ctl, _fleet
+from test_serve import _events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# scan's 8192 exact-fit bucket (direct ServeClient dispatches below);
+# its md5 ring placement is the routing oracle (test_fleet pins the
+# ring math itself)
+SCAN_BUCKET_ID = "scan|8192|-"
+
+
+def _record_bucket_id(kernel="scan"):
+    """The bucket id a ``loadgen --shapes record`` request rides —
+    computed from the LIVE avatar table, never assumed: the record
+    shape is whatever ``aot.BENCH_CONFIGS`` registers, and the kill
+    plan must target that bucket's actual ring home."""
+    from tpukernels.serve import bucketing
+
+    spec = bucketing.bucket_configs()[kernel]
+    arrays = [
+        np.zeros(shape, dtype=np.dtype(name))
+        for name, shape in bucketing._spec_args(spec)
+    ]
+    statics = dict(spec.get("statics") or {})
+    bspec, _frac = bucketing.bucket_for(kernel, arrays, statics)
+    return bucketing.bucket_id(kernel, bspec, statics, arrays)
+
+FAST_HEALTH = {
+    "TPK_FLEET_PROBE_S": "0.3",
+    "TPK_FLEET_RESTART_BACKOFF_S": "0.2",
+}
+
+
+def _wait_events(journal, pred, timeout=90.0, msg="event"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        events = _events(journal)
+        hits = [e for e in events if pred(e)]
+        if hits:
+            return events, hits
+        time.sleep(0.3)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------- #
+# pure units                                                       #
+# ---------------------------------------------------------------- #
+
+def test_probe_and_sweep_units(tmp_path):
+    from tpukernels.serve import health, protocol
+
+    # a worker that never existed is dead, not slow
+    assert health.probe_worker(str(tmp_path / "no.sock"), 0.2) == (
+        "dead", None,
+    )
+    assert health.pidfile_state(str(tmp_path / "no.pid")) == (
+        False, None,
+    )
+    # an unheld pidfile with a recorded pid: dead, pid preserved
+    pf = tmp_path / "serve.pid"
+    pf.write_text("12345\n")
+    assert health.pidfile_state(str(pf)) == (False, 12345)
+
+    # targeted shm sweep: a DEAD creator's segment is reclaimed with
+    # its byte count; a live creator's segment is left alone
+    child = subprocess.run([sys.executable, "-c", "import os;"
+                            "print(os.getpid())"],
+                           capture_output=True, text=True)
+    dead_pid = int(child.stdout.strip())
+    dead_name = f"tpkserve-{dead_pid}-0-deadbeef"
+    live_name = f"tpkserve-{os.getpid()}-0-deadbeef"
+    for name in (dead_name, live_name):
+        with open(os.path.join(protocol.SHM_DIR, name), "wb") as f:
+            f.write(b"\0" * 24)
+    try:
+        assert protocol.sweep_segments_for_pid(dead_pid) == (1, 24)
+        assert not os.path.exists(
+            os.path.join(protocol.SHM_DIR, dead_name)
+        )
+        assert protocol.sweep_segments_for_pid(os.getpid()) == (0, 0)
+        assert os.path.exists(
+            os.path.join(protocol.SHM_DIR, live_name)
+        )
+        # junk pids are refused, not trusted
+        assert protocol.sweep_segments_for_pid("9") == (0, 0)
+        assert protocol.sweep_segments_for_pid(-4) == (0, 0)
+    finally:
+        protocol.unlink_shm(live_name)
+        protocol.unlink_shm(dead_name)
+
+
+def test_retry_hint_and_knob_parse(tmp_path, monkeypatch):
+    from tpukernels.serve import health
+
+    hm = health.HealthManager(
+        [str(tmp_path / "w0" / "serve.sock"),
+         str(tmp_path / "w1" / "serve.sock")],
+        repo=REPO, probe_s=0.5, restart_max=2, backoff_s=0.2,
+    )
+    # all up: the hint is one probe interval's patience
+    assert hm.retry_hint() == 0.5
+    # a down worker's hint is its backoff remainder + a probe
+    hm.workers[0].state = "down"
+    hm.workers[0].next_attempt = time.perf_counter() + 2.0
+    hint = hm.retry_hint({0})
+    assert 2.0 < hint <= 3.0
+    # quarantined workers are not coming back: the cap
+    hm.workers[1].state = "quarantined"
+    assert hm.retry_hint({1}) == health.MAX_DEGRADED_HINT_S
+    # the soonest candidate wins across a set
+    assert hm.retry_hint({0, 1}) == hint
+    # fail-loud knob parses (the daemon knob contract)
+    monkeypatch.setenv("TPK_FLEET_PROBE_S", "banana")
+    with pytest.raises(ValueError, match="TPK_FLEET_PROBE_S"):
+        health.HealthManager(["x"], repo=REPO)
+    monkeypatch.setenv("TPK_FLEET_PROBE_S", "0.5")
+    monkeypatch.setenv("TPK_FLEET_RESTART_MAX", "0")
+    with pytest.raises(ValueError, match="TPK_FLEET_RESTART_MAX"):
+        health.HealthManager(["x"], repo=REPO)
+
+
+def test_reset_probes_before_reringing_and_disabled_mode(tmp_path):
+    """`undrain`'s health reset must not put a corpse back in the
+    ring: a still-dead worker stays down and is scheduled for an
+    immediate respawn; with the manager DISABLED
+    (TPK_FLEET_PROBE_S=0) the operator's word is restored verbatim
+    and transport losses never declare deaths (nothing could revive
+    them)."""
+    from tpukernels.serve import health
+
+    class _RouterStub:
+        def __init__(self):
+            self.calls = []
+
+        def set_worker_down(self, idx, down, quarantined=False):
+            self.calls.append((idx, down))
+
+        def worker_draining(self, idx):
+            return False
+
+    sock = str(tmp_path / "w0" / "serve.sock")
+    r = _RouterStub()
+    hm = health.HealthManager([sock], repo=REPO, router=r,
+                              probe_s=0.5, restart_max=2,
+                              backoff_s=0.2)
+    w = hm.workers[0]
+    w.state = "quarantined"
+    w.crashes = 5
+    w.smoke_fails = 3
+    hm.reset(0)  # no pidfile anywhere: the worker is a corpse
+    assert w.state == "down"
+    assert (w.crashes, w.smoke_fails) == (0, 0)
+    assert r.calls[-1] == (0, True), "a corpse must stay out of the ring"
+    # disabled manager: reset trusts the operator (old contract) ...
+    hm0 = health.HealthManager([sock], repo=REPO, router=r,
+                               probe_s=0, restart_max=2,
+                               backoff_s=0.2)
+    hm0.workers[0].state = "quarantined"
+    hm0.reset(0)
+    assert hm0.workers[0].state == "up"
+    assert r.calls[-1] == (0, False)
+    # ... and transport losses never declare deaths it cannot heal
+    assert hm0.note_transport_loss(0) is False
+    assert hm0.workers[0].state == "up"
+
+
+def test_kill_worker_fault_match_rules(tmp_path, monkeypatch):
+    """The kill_worker spec's NON-firing paths are provable
+    in-process (the firing path would SIGKILL pytest — the fleet e2e
+    below proves it for real): wrong kernel, wrong env, wrong call
+    number, and a consumed once_file all leave the process alive."""
+    from tpukernels.resilience import faults
+
+    once = tmp_path / "fired"
+    once.write_text("1\n")
+    monkeypatch.setenv("TPK_FAULT_PLAN", json.dumps({
+        "kill_worker": {"kernel": "scan", "on_call": 2,
+                        "once_file": str(once),
+                        "env": {"TPK_SERVE_WORKER_ID": "0"}},
+    }))
+    monkeypatch.setenv("TPK_SERVE_WORKER_ID", "0")
+    faults.reload_plan()
+    try:
+        faults.dispatch_fault("sgemm")   # kernel mismatch
+        faults.dispatch_fault("scan")    # call 1 != on_call 2
+        faults.dispatch_fault("scan")    # call 2, but once_file exists
+        monkeypatch.setenv("TPK_SERVE_WORKER_ID", "1")
+        faults.reload_plan()
+        faults.dispatch_fault("scan")    # env mismatch
+        faults.dispatch_fault("scan")
+    finally:
+        monkeypatch.delenv("TPK_FAULT_PLAN", raising=False)
+        faults.reload_plan()
+
+
+def test_reqtrace_dead_worker_gap_unit():
+    from tpukernels.obs import reqtrace
+
+    events = [
+        {"kind": "serve_client_request", "request_id": "r1",
+         "kernel": "scan", "wall_s": 0.5, "ok": True, "t": 100.0},
+        {"kind": "serve_spill", "request_id": "r1", "kernel": "scan",
+         "from_worker": 0, "to_worker": 1, "reason": "transport",
+         "t": 100.1},
+        {"kind": "serve_request_replayed", "request_id": "r1",
+         "kernel": "scan", "from_worker": 0, "to_worker": 1,
+         "t": 100.1, "pid": 7},
+        {"kind": "serve_request", "request_id": "r1",
+         "kernel": "scan", "ok": True, "worker_id": "1",
+         "wall_s": 0.01, "t": 100.4},
+    ]
+    tl = reqtrace.assemble(events)["r1"]
+    assert tl["replayed"] is True
+    assert tl["clean"] is False, "a replayed request must never gate"
+    gaps = {g["kind"] for g in tl["gaps"]}
+    assert "dead-worker" in gaps
+    assert "missing-server-record" not in gaps  # the sibling answered
+    assert tl["final"]["worker_id"] == "1"
+
+
+# ---------------------------------------------------------------- #
+# the chaos e2e suite                                              #
+# ---------------------------------------------------------------- #
+
+def test_kill_worker_mid_burst_self_heals(tmp_path):
+    """THE acceptance headline: kill -9 the scan bucket's home worker
+    mid-burst (kill_worker fault, once_file so the respawned
+    incarnation runs clean) — the seeded loadgen run drops ZERO
+    requests (the in-flight one is replayed on the sibling with
+    serve_request_replayed evidence + a reqtrace dead-worker gap),
+    the dead worker's death is journaled with its swept shm
+    accounting, it is respawned + smoke-gated back into the ring
+    before run end, the degradation level round-trips
+    degraded -> ok, and obs_report --check stays rc 0."""
+    from tpukernels.obs import reqtrace
+    from tpukernels.serve import router
+
+    primary, sibling = router.ring_order(_record_bucket_id(), 2)[:2]
+    once = tmp_path / "killed.once"
+    plan = json.dumps({"kill_worker": {
+        "kernel": "scan", "on_call": 3, "once_file": str(once),
+        "env": {"TPK_SERVE_WORKER_ID": str(primary)},
+    }})
+    slo_dir = tmp_path / "slo"
+    slo_dir.mkdir()
+    with _fleet(tmp_path, n=2, env_extra=dict(FAST_HEALTH, **{
+        "TPK_FAULT_PLAN": plan,
+        "TPK_TRACE": "1",
+    })) as (front, journal, env):
+        lg_env = dict(env)
+        lg_env["TPK_SLO_DIR"] = str(slo_dir)
+        # the injected outage puts one cold spill compile in the tail
+        # on purpose; this test judges the healing, not the p99 —
+        # widen the targets the honest way (the known-slow-host knob)
+        lg_env["TPK_SLO_SCALE"] = "100"
+        lg = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+             "--serve", front, "--kernel", "scan", "--shapes",
+             "record", "--arrivals", "poisson", "--seed", "11",
+             "--requests", "50", "--rate", "2", "--tenant", "chaos"],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+            env=lg_env,
+        )
+        assert lg.returncode == 0, lg.stdout + lg.stderr
+        assert "dropped" not in lg.stderr, lg.stderr
+        # the fleet converged back to 2 live ring members
+        r = _ctl(env, "health", "--wait", "60")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "CONVERGED" in r.stdout
+        r = _ctl(env, "status")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "restarts=1" in r.stdout
+    assert once.exists(), "the kill fault never fired"
+
+    events = _events(journal)
+    # zero dropped accepted requests: every client-observed request ok
+    client_reqs = [e for e in events
+                   if e.get("kind") == "serve_client_request"]
+    assert len(client_reqs) == 51  # 50 scheduled + 1 warm
+    assert all(e.get("ok") for e in client_reqs)
+    # the death was detected, attributed and swept
+    dead = [e for e in events if e.get("kind") == "worker_dead"]
+    assert len(dead) == 1
+    assert dead[0]["worker"] == primary
+    assert dead[0]["via"] in ("transport", "probe")
+    assert dead[0]["crashes"] == 1
+    assert "swept_segments" in dead[0] and "swept_bytes" in dead[0]
+    # the in-flight request was replayed ONCE onto the ring sibling
+    replays = [e for e in events
+               if e.get("kind") == "serve_request_replayed"]
+    assert len(replays) == 1
+    assert replays[0]["from_worker"] == primary
+    assert replays[0]["to_worker"] == sibling
+    rid = replays[0]["request_id"]
+    assert rid is not None
+    # ... and the sibling's serve_request carries the replay count
+    replayed_srv = [e for e in events
+                    if e.get("kind") == "serve_request"
+                    and e.get("request_id") == rid]
+    assert any(e.get("replayed") == 1 and e.get("ok")
+               for e in replayed_srv)
+    # the replay reassembles with an EXPLICIT dead-worker gap
+    tl = reqtrace.assemble(events)[rid]
+    assert tl["clean"] is False
+    assert any(g["kind"] == "dead-worker" for g in tl["gaps"])
+    assert tl["final"]["ok"]
+    # respawn + smoke-gated rejoin happened DURING the run
+    resp = [e for e in events if e.get("kind") == "worker_respawned"]
+    assert len(resp) == 1 and resp[0]["worker"] == primary
+    assert resp[0]["down_s"] is not None
+    # traffic returned to the healed home before run end
+    t_rejoin = resp[0]["t"]
+    post = [e for e in events if e.get("kind") == "serve_route"
+            and e.get("t", 0) > t_rejoin]
+    assert any(e["worker"] == primary for e in post), (
+        "no routed request landed on the healed worker after rejoin"
+    )
+    # degradation level round-tripped degraded -> ok
+    levels = [e["level"] for e in events
+              if e.get("kind") == "fleet_degraded"]
+    assert levels == ["degraded", "ok"]
+    # the rejoin smoke is visible, request-id'd evidence
+    assert any(e.get("kind") == "serve_request"
+               and str(e.get("request_id") or "").startswith(
+                   "fleet-smoke-")
+               for e in events)
+    # the gating surface is unchanged: no trace_inconsistent /
+    # copy_regression / breach from the replay path
+    chk_env = _scrubbed_env(None)
+    chk_env["TPK_SLO_DIR"] = str(slo_dir)
+    chk_env["TPK_SLO_SCALE"] = "100"
+    chk = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--check", "--journal", journal],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=chk_env,
+    )
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+
+
+def test_crash_loop_quarantines_loudly(tmp_path):
+    """Every incarnation of the home worker dies on its first scan
+    dispatch (kill_worker WITHOUT once_file — the rejoin smoke is a
+    scan, so each respawn dies at its gate): after
+    TPK_FLEET_RESTART_MAX confirmed crashes the worker is
+    QUARANTINED — left out of the ring loudly instead of flapping —
+    while the sibling keeps serving, batch included (shedding needs
+    home AND sibling out)."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import router
+
+    primary, sibling = router.ring_order(SCAN_BUCKET_ID, 2)[:2]
+    plan = json.dumps({"kill_worker": {
+        "kernel": "scan",
+        "env": {"TPK_SERVE_WORKER_ID": str(primary)},
+    }})
+    with _fleet(tmp_path, n=2, env_extra=dict(FAST_HEALTH, **{
+        "TPK_FAULT_PLAN": plan,
+        "TPK_FLEET_RESTART_MAX": "2",
+    })) as (front, journal, env):
+        x = np.arange(8192, dtype=np.int32)
+        want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+        with serve_client.ServeClient(front, timeout_s=180) as c:
+            # the home dies holding this request; the replay answers
+            np.testing.assert_array_equal(c.dispatch("scan", x), want)
+        # crash 1 (the kill) + crash 2 (the respawn dies on its own
+        # rejoin smoke) -> threshold 2 -> quarantine, no flapping
+        events, _ = _wait_events(
+            journal,
+            lambda e: e.get("kind") == "worker_quarantined",
+            timeout=120, msg="worker_quarantined",
+        )
+        deaths = [e for e in events if e.get("kind") == "worker_dead"]
+        assert len(deaths) >= 2
+        assert all(e["worker"] == primary for e in deaths)
+        assert any(e["via"] == "join" for e in deaths), (
+            "the smoke-gate death must be attributed to the join"
+        )
+        quar = [e for e in events
+                if e.get("kind") == "worker_quarantined"]
+        assert len(quar) == 1
+        assert quar[0]["worker"] == primary
+        assert quar[0]["threshold"] == 2
+        # no rejoin ever happened: the gate held
+        assert not any(e.get("kind") == "worker_respawned"
+                       for e in events)
+        # the ring still serves, interactive AND batch (home+sibling
+        # not BOTH out), from the sibling
+        with serve_client.ServeClient(front, timeout_s=180) as c:
+            np.testing.assert_array_equal(c.dispatch("scan", x), want)
+        with serve_client.ServeClient(front, timeout_s=180,
+                                      priority="batch") as c:
+            np.testing.assert_array_equal(c.dispatch("scan", x), want)
+        events = _events(journal)
+        routes = [e for e in events if e.get("kind") == "serve_route"]
+        assert all(e["worker"] == sibling for e in routes[-2:])
+        # quarantine is visible on the operator surfaces
+        r = _ctl(env, "status")
+        assert "QUARANTINED" in r.stdout, r.stdout + r.stderr
+        r = _ctl(env, "health", "--wait", "1")
+        assert r.returncode == 1
+        assert "NOT converged" in r.stdout
+        # no further respawn attempts accumulate after the breaker
+        n_deaths = len([e for e in _events(journal)
+                        if e.get("kind") == "worker_dead"])
+        time.sleep(2.0)
+        assert len([e for e in _events(journal)
+                    if e.get("kind") == "worker_dead"]) == n_deaths
+
+
+def test_both_ring_members_down_sheds_by_priority(tmp_path):
+    """Degradation levels: with scan's home AND sibling both dead
+    (respawn backoff pinned high so they stay down), the fleet goes
+    CRITICAL — batch requests shed FIRST with an honest
+    retry_after_s, interactive requests keep riding the last ring
+    member — and with every worker dead, interactive sheds too
+    instead of timing out."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import router
+
+    ring = router.ring_order(SCAN_BUCKET_ID, 3)
+    home, sib, last = ring[0], ring[1], ring[2]
+    with _fleet(tmp_path, n=3, env_extra={
+        "TPK_FLEET_PROBE_S": "0.3",
+        # down workers must STAY down for the length of the test
+        "TPK_FLEET_RESTART_BACKOFF_S": "120",
+    }) as (front, journal, env):
+        serve_dir = env["TPK_SERVE_DIR"]
+
+        def _kill(idx):
+            pidfile = os.path.join(serve_dir, "fleet", f"worker{idx}",
+                                   "serve.pid")
+            with open(pidfile) as f:
+                os.kill(int(f.readline().strip()), signal.SIGKILL)
+
+        _kill(home)
+        _kill(sib)
+        events, crit = _wait_events(
+            journal,
+            lambda e: (e.get("kind") == "fleet_degraded"
+                       and e.get("level") == "critical"),
+            timeout=30, msg="fleet_degraded critical",
+        )
+        assert sorted(crit[-1]["down"]) == sorted([home, sib])
+        x = np.arange(8192, dtype=np.int32)
+        want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+        # batch sheds FIRST: home+sibling both out
+        with serve_client.ServeClient(front, timeout_s=60,
+                                      priority="batch",
+                                      tenant="bg") as c:
+            with pytest.raises(serve_client.ServeRejected) as exc:
+                c.dispatch("scan", x)
+        assert 0 < exc.value.retry_after_s <= 30.0
+        # interactive still rides the last ring member
+        with serve_client.ServeClient(front, timeout_s=180) as c:
+            np.testing.assert_array_equal(c.dispatch("scan", x), want)
+        events = _events(journal)
+        routes = [e for e in events if e.get("kind") == "serve_route"
+                  and e.get("ok")]
+        assert routes and routes[-1]["worker"] == last
+        sheds = [e for e in events if e.get("kind") == "serve_rejected"
+                 and e.get("reason") == "fleet_degraded"]
+        assert len(sheds) == 1
+        assert sheds[0]["priority"] == "batch"
+        assert sheds[0]["request_id"] is not None
+        # nothing left alive: interactive sheds too, with the hint —
+        # an honest answer instead of a client timeout
+        _kill(last)
+        _wait_events(
+            journal,
+            lambda e: (e.get("kind") == "worker_dead"
+                       and e.get("worker") == last),
+            timeout=30, msg=f"worker_dead for worker {last}",
+        )
+        with serve_client.ServeClient(front, timeout_s=60) as c:
+            with pytest.raises(serve_client.ServeRejected) as exc:
+                c.dispatch("scan", x)
+        assert 0 < exc.value.retry_after_s <= 30.0
+        r = _ctl(env, "health", "--wait", "1")
+        assert r.returncode == 1, r.stdout + r.stderr
+
+
+def test_client_reconnects_across_daemon_restart(tmp_path):
+    """The stale-socket satellite: a client holding a connection to a
+    daemon that was since RESTARTED on the same socket absorbs the
+    ECONNRESET/EPIPE/mid-frame-EOF transparently — ONE reconnect,
+    SAME request_id — while a daemon that is actually gone still
+    surfaces as the transport error it is."""
+    from tpukernels.serve import client as serve_client
+
+    d = tmp_path / "solo"
+    d.mkdir()
+    journal = str(d / "health.jsonl")
+    env = _scrubbed_env(None)
+    env["TPK_SERVE_DIR"] = str(d)
+    env["TPK_HEALTH_JOURNAL"] = journal
+    sock = str(d / "serve.sock")
+    r = _ctl(env, "start", "--wait", "90")
+    assert r.returncode == 0, r.stdout + r.stderr
+    try:
+        x = (np.arange(64) % 7).astype(np.int32)
+        want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+        cli = serve_client.ServeClient(sock, timeout_s=120)
+        out = serve_client.dispatch_with_backpressure(
+            cli, "scan", (x,), {}
+        )
+        np.testing.assert_array_equal(out, want)
+        # restart the daemon under the held connection
+        assert _ctl(env, "stop", "--wait", "30").returncode == 0
+        r = _ctl(env, "start", "--wait", "90")
+        assert r.returncode == 0, r.stdout + r.stderr
+        cli.next_request_id = "reconnect-rid"
+        out = serve_client.dispatch_with_backpressure(
+            cli, "scan", (x,), {}
+        )
+        np.testing.assert_array_equal(out, want)
+        assert cli.last_request_id == "reconnect-rid"
+        # one logical request, one delivery: the retry reused the id
+        # and only the SECOND daemon ever saw it
+        served = [e for e in _events(journal)
+                  if e.get("kind") == "serve_request"
+                  and e.get("request_id") == "reconnect-rid"]
+        assert len(served) == 1 and served[0]["ok"]
+        # a daemon that is actually gone is still a hard error
+        assert _ctl(env, "stop", "--wait", "30").returncode == 0
+        with pytest.raises(OSError):
+            serve_client.dispatch_with_backpressure(
+                cli, "scan", (x,), {}
+            )
+        cli.close()
+    finally:
+        _ctl(env, "stop", "--wait", "30")

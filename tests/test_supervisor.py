@@ -773,3 +773,12 @@ def test_production_plan_order_reproduces_next_md(tmp_path,
                           "fleet_probe", "c_scan_timing", "profile"]
     assert order[-2:] == ["san_asan", "san_ubsan"]
     assert len(order) == len(cli.PRODUCTION_QUEUE)
+    # fleet_probe rehearses the full self-healing cycle mid-burst
+    # (docs/SERVING.md §self-healing) at the SAME cost/value — the
+    # kill -> detect -> respawn -> rejoin phase and its convergence
+    # gate are part of the step body, and its rc part of the verdict
+    fleet_spec = next(s for s in cli.PRODUCTION_QUEUE
+                      if s.name == "fleet_probe")
+    assert "kill -9" in fleet_spec.shell
+    assert "health --wait" in fleet_spec.shell
+    assert "rc_heal" in fleet_spec.shell

@@ -339,6 +339,42 @@ def _fmt(ev):
                 + (f", {ev.get('untraced_serve_requests')} served "
                    "request(s) WITHOUT request_id"
                    if ev.get("untraced_serve_requests") else ""))
+    if kind == "worker_dead":
+        return (f"{ts} [pid {pid}] fleet worker {ev.get('worker')} "
+                f"DEAD ({ev.get('via')}, crash {ev.get('crashes')}"
+                + (f", pid {ev.get('worker_pid')}"
+                   if ev.get("worker_pid") else "")
+                + f") - respawn in {ev.get('backoff_s')}s"
+                + (f"; swept {ev.get('swept_segments')} shm "
+                   f"segment(s) / {ev.get('swept_bytes')}B"
+                   if ev.get("swept_segments") else ""))
+    if kind == "worker_respawned":
+        return (f"{ts} [pid {pid}] fleet worker {ev.get('worker')} "
+                f"RESPAWNED and rejoined the ring (pid "
+                f"{ev.get('worker_pid')}, restart "
+                f"{ev.get('restarts')}, down {ev.get('down_s')}s)")
+    if kind == "worker_quarantined":
+        return (f"{ts} [pid {pid}] fleet worker {ev.get('worker')} "
+                f"QUARANTINED after {ev.get('crashes')} crash(es) "
+                f"(threshold {ev.get('threshold')}) - left out of "
+                "the ring; `serve_ctl undrain` resets")
+    if kind == "serve_request_replayed":
+        return (f"{ts} [pid {pid}] REPLAYED {ev.get('kernel')} "
+                f"request {ev.get('request_id') or ev.get('request')}"
+                f" off dead worker {ev.get('from_worker')} -> "
+                f"{ev.get('to_worker')}")
+    if kind == "fleet_degraded":
+        lvl = str(ev.get("level", "?")).upper()
+        if ev.get("level") == "ok":
+            return (f"{ts} [pid {pid}] fleet degradation CLEARED - "
+                    "all workers restored to the ring")
+        return (f"{ts} [pid {pid}] fleet {lvl}: workers "
+                f"{ev.get('down')} out of the ring"
+                + (f" (quarantined {ev.get('quarantined')})"
+                   if ev.get("quarantined") else "")
+                + (f" - shedding with retry hint "
+                   f"{ev.get('retry_after_s')}s"
+                   if ev.get("level") == "critical" else ""))
     if kind == "serve_lane_negotiated":
         return (f"{ts} [pid {pid}] serve shm payload lane ENGAGED "
                 f"({ev.get('kernel')} request {ev.get('request')})")
@@ -649,7 +685,13 @@ def summarize(events, bad=0) -> str:
         f"{counts.get('serve_rejected', 0)} serve rejection(s), "
         f"{counts.get('serve_request_requeued', 0)} serve requeue(s), "
         f"{counts.get('serve_spill', 0)} fleet spill(s), "
-        f"{counts.get('serve_tenant_throttled', 0)} tenant throttle(s)"
+        f"{counts.get('serve_tenant_throttled', 0)} tenant throttle(s), "
+        f"{counts.get('worker_dead', 0)} worker death(s), "
+        f"{counts.get('worker_respawned', 0)} worker restart(s), "
+        f"{counts.get('worker_quarantined', 0)} quarantined worker(s), "
+        f"{counts.get('serve_request_replayed', 0)} replayed "
+        "request(s), "
+        f"{counts.get('fleet_degraded', 0)} degradation change(s)"
     )
     return "\n".join(out)
 

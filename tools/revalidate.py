@@ -161,12 +161,18 @@ fi
     #       beside a steady one — the fairness scenario the router's
     #       token buckets exist for) driven through the front socket,
     #       one worker drained AND restored mid-burst (the rolling-
-    #       restart rehearsal: zero accepted requests may drop), then
-    #       a clean stop whatever the loadgen rcs so a failed burst
-    #       cannot leak a fleet into the next window. The fleet runs
-    #       under TPK_TRACE=1 and the steady client is traced too
-    #       (seeded), so the burst ALSO banks cross-process request
-    #       timelines — router spill + drain hops included — at no
+    #       restart rehearsal: zero accepted requests may drop), one
+    #       worker KILLED -9 mid-burst and self-healed (§self-healing:
+    #       the health manager must detect the death, sweep, respawn
+    #       and rejoin while traffic keeps flowing — `serve_ctl
+    #       health --wait` is the convergence gate and its rc part of
+    #       the verdict; no new chip-minutes, the healing overlaps
+    #       the same burst), then a clean stop whatever the loadgen
+    #       rcs so a failed burst cannot leak a fleet into the next
+    #       window. The fleet runs under TPK_TRACE=1 and the steady
+    #       client is traced too (seeded), so the burst ALSO banks
+    #       cross-process request timelines — router spill + drain
+    #       hops AND the dead-worker replay gap included — at no
     #       extra chip cost (docs/OBSERVABILITY.md §request tracing).
     #       Non-gating (obs_check picks a confirmed per-tenant breach
     #       OR trace_inconsistent up as rc 1 WARN); never stamped;
@@ -180,11 +186,11 @@ fleet_probe_body() {
       --wait 60 || return $?
   front=$(python -c "from tpukernels.serve import fleet
 print(fleet.front_socket_path())")
-  timeout -k 10 100 python tools/loadgen.py --serve "$front" \\
+  timeout -k 10 120 python tools/loadgen.py --serve "$front" \\
       --mix all --arrivals bursty --duration 60 --rate 10 \\
       --requests 0 --shapes record --tenant hot &
   lg_hot=$!
-  timeout -k 10 100 env TPK_TRACE=1 python tools/loadgen.py \\
+  timeout -k 10 120 env TPK_TRACE=1 python tools/loadgen.py \\
       --serve "$front" \\
       --mix all --arrivals poisson --duration 60 --rate 2 \\
       --requests 0 --shapes record --tenant steady --seed 3 &
@@ -192,13 +198,22 @@ print(fleet.front_socket_path())")
   sleep 20
   python tools/serve_ctl.py drain 0 --wait 30; rc_drain=$?
   python tools/serve_ctl.py undrain 0 --wait 30; rc_undrain=$?
+  # kill -> detect -> respawn -> rejoin, mid-burst: worker 1's pid
+  # from its flocked pidfile, then wait for the health manager's
+  # convergence (docs/SERVING.md §self-healing)
+  w1pid=$(head -1 "$(python -c "from tpukernels.serve import fleet
+print(fleet.worker_dir(1))")/serve.pid")
+  kill -9 "$w1pid"
+  python tools/serve_ctl.py health --wait 90; rc_heal=$?
   wait $lg_hot; rc_hot=$?
   wait $lg_steady; rc_steady=$?
   python tools/serve_ctl.py stop-fleet
-  # the drain/undrain rcs are part of the verdict: a probe that never
-  # actually rehearsed the rolling restart must not report success
+  # the drain/undrain/heal rcs are part of the verdict: a probe that
+  # never actually rehearsed the rolling restart (or whose kill was
+  # never self-healed) must not report success
   [ $rc_hot -eq 0 ] && [ $rc_steady -eq 0 ] && \
-    [ $rc_drain -eq 0 ] && [ $rc_undrain -eq 0 ]
+    [ $rc_drain -eq 0 ] && [ $rc_undrain -eq 0 ] && \
+    [ $rc_heal -eq 0 ]
 }
 if fleet_probe_body >"$fleet_log" 2>&1; then
   tail -1 "$fleet_log"

@@ -9,6 +9,7 @@ Usage:
     python tools/serve_ctl.py stop-fleet [--wait S]
     python tools/serve_ctl.py drain I [--wait S]
     python tools/serve_ctl.py undrain I [--wait S]
+    python tools/serve_ctl.py health [--wait S]
 
 Single daemon: ``start`` spawns ``python -m tpukernels.serve``
 detached and waits for a protocol ping; ``stop`` SIGTERMs the pid
@@ -30,11 +31,21 @@ the router's transport retry). ``undrain I`` restarts the worker if
 needed and restores it to the ring — together the supervisor-managed
 rolling restart. ``stop-fleet`` stops router then workers.
 ``status`` detects a fleet (live router pidfile) and prints the
-router's routing totals plus one line per worker.
+router's routing totals plus one line per worker — including each
+worker's liveness state, restart count and quarantine flag from the
+router's self-healing manager (docs/SERVING.md §self-healing).
 
-Exit codes: 0 — done (``status``: up); 1 — failed (``status``:
-down); 2 — usage error; 3 — ``start``/``start-fleet`` refused
-because a live daemon/router already holds the pidfile.
+``health`` is the health manager's standalone face: it polls the
+fleet (router rows when the router answers, direct pidfile-flock +
+ping probes per worker otherwise) until every ring member is live or
+``--wait`` expires — the converged-fleet gate chaos probes and the
+supervisor's ``fleet_probe`` kill-and-recover phase wait on.
+
+Exit codes: 0 — done (``status``: up; ``health``: all workers
+live); 1 — failed (``status``: down; ``health``: a worker is
+dead/quarantined past the wait); 2 — usage error; 3 —
+``start``/``start-fleet`` refused because a live daemon/router
+already holds the pidfile.
 """
 
 from __future__ import annotations
@@ -51,29 +62,17 @@ sys.path.insert(0, _REPO)
 from tpukernels import _cachedir  # noqa: E402
 from tpukernels.serve import client as serve_client  # noqa: E402
 from tpukernels.serve import fleet as serve_fleet  # noqa: E402
+from tpukernels.serve import health as serve_health  # noqa: E402
 from tpukernels.serve import protocol as serve_protocol  # noqa: E402
 
 
 def _pidfile_state(path=None):
-    """(held, pid_or_None): held = a live process flocks the pidfile
-    (the revalidate_lib convention — test the lock, never trust the
-    pid alone)."""
-    import fcntl
-
-    path = path or _cachedir.serve_pidfile_path()
-    try:
-        f = open(path)
-    except OSError:
-        return False, None
-    with f:
-        content = f.readline().strip()
-        pid = int(content) if content.isdigit() else None
-        try:
-            fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
-            fcntl.flock(f.fileno(), fcntl.LOCK_UN)
-        except OSError:
-            return True, pid
-    return False, pid
+    """(held, pid_or_None) — the one flock-test helper, shared with
+    the fleet health manager (serve/health.py owns the copy: liveness
+    is the flock, the recorded pid is the diagnosis)."""
+    return serve_health.pidfile_state(
+        path or _cachedir.serve_pidfile_path()
+    )
 
 
 def _ping(socket_path):
@@ -409,29 +408,95 @@ def _fleet_status() -> int:
           f"lanes={','.join(stats.get('lanes') or ['inline'])} "
           f"device={stats.get('device_kind')} "
           f"uptime={stats.get('uptime_s')}s")
+    level = stats.get("level")
+    if level and level != "ok":
+        print(f"serve_ctl: fleet {str(level).upper()} - shedding "
+              "rules active (docs/SERVING.md §self-healing)")
     rows = stats.get("workers") or []
     rc = 0
     for i, row in enumerate(rows):
         wstats = _ping(row.get("socket"))
         state = ("DRAINING" if row.get("draining")
-                 else "cooling" if row.get("cooling") else "up")
+                 else "QUARANTINED" if row.get("quarantined")
+                 else "cooling" if row.get("cooling")
+                 else row.get("state") or "up")
+        # the self-healing columns: liveness state / restart count /
+        # quarantine, straight from the router's health manager
+        heal = ""
+        if row.get("restarts"):
+            heal = f" restarts={row.get('restarts')}"
         if wstats is None:
             print(f"  worker{i}: DOWN ({state}; "
-                  f"routed={row.get('routed')})")
+                  f"routed={row.get('routed')}{heal})")
             if not row.get("draining"):
                 rc = 1
             continue
         print(f"  worker{i}: {state} pid {wstats.get('pid')} "
               f"routed={row.get('routed')} "
-              f"inflight_router={row.get('inflight')} - "
+              f"inflight_router={row.get('inflight')}{heal} - "
               + _stats_line(wstats))
+    return rc
+
+
+def health(wait_s: float) -> int:
+    """Standalone fleet-health face: poll until every ring member is
+    live (router rows preferred; direct pidfile+ping probes when the
+    router itself is down) or the wait expires. The convergence gate
+    chaos probes wait on after a kill."""
+    cfg = serve_fleet.load_config()
+    if not cfg:
+        print("serve_ctl: no fleet.json - is a fleet running?",
+              file=sys.stderr)
+        return 1
+    front = cfg["front"]
+    deadline = time.monotonic() + wait_s
+    rows = None
+    while True:
+        stats = _ping(front)
+        rows = (stats or {}).get("workers")
+        if rows is None:
+            # router down: probe the workers directly (the read-only
+            # half of the health manager, shared helper)
+            rows = [
+                {"socket": s,
+                 "state": serve_health.probe_worker(s)[0]}
+                for s in cfg.get("workers") or []
+            ]
+        live = [r for r in rows
+                if (r.get("state") or "up") == "up"
+                or r.get("draining")]
+        if len(live) == len(rows) and rows:
+            break
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.3)
+    rc = 0
+    for i, row in enumerate(rows or []):
+        state = row.get("state") or "up"
+        if row.get("draining"):
+            state = "draining"
+        if row.get("quarantined"):
+            state = "quarantined"
+        line = f"  worker{i}: {state}"
+        if row.get("restarts"):
+            line += f" restarts={row.get('restarts')}"
+        print(line)
+        if state not in ("up", "draining"):
+            rc = 1
+    if not rows:
+        print("serve_ctl: fleet has no workers to probe",
+              file=sys.stderr)
+        rc = 1
+    print("serve_ctl: fleet " + ("CONVERGED - all workers live"
+                                 if rc == 0 else
+                                 "NOT converged within the wait"))
     return rc
 
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     verbs = ("start", "stop", "status", "start-fleet", "stop-fleet",
-             "drain", "undrain")
+             "drain", "undrain", "health")
     if not argv or argv[0] not in verbs:
         print(__doc__, file=sys.stderr)
         return 2
@@ -478,6 +543,8 @@ def main(argv=None):
         return drain(count, wait_s)
     if cmd == "undrain":
         return undrain(count, wait_s)
+    if cmd == "health":
+        return health(wait_s)
     return status(socket_path)
 
 

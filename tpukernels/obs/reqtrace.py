@@ -27,9 +27,14 @@ Assembly rules:
 - **Gaps are explicit** — a ``serve_request_requeued`` marker means
   an abandoned worker attempt whose spans may never close: the
   timeline carries a loud ``abandoned-worker`` gap entry, never a
-  silently shorter phase sum. A client-confirmed request with no
-  ``serve_request`` record at all gets a ``missing-server-record``
-  gap (the worker died between dispatch and journal).
+  silently shorter phase sum. A ``serve_request_replayed`` marker
+  (the router re-routed an accepted request off a DEAD worker —
+  docs/SERVING.md §self-healing) adds a ``dead-worker`` gap: the
+  home attempt's spans and ``serve_request`` record died with the
+  process, so the sibling's timeline is the whole surviving story
+  and says so. A client-confirmed request with no ``serve_request``
+  record at all gets a ``missing-server-record`` gap (the worker
+  died between dispatch and journal).
 - **Degrade loudly, never crash** — a pre-request_id journal (old
   server, tracing off) assembles to zero timelines;
   :func:`untraced_serve_requests` counts what could not be joined so
@@ -133,6 +138,7 @@ def _new_timeline(rid) -> dict:
         "tenant": None, "worker_id": None,
         "client": None, "server": [], "route": [], "spills": [],
         "rejections": 0, "throttles": 0, "requeued": False,
+        "replayed": False,
         "segments": [], "gaps": [],
     }
 
@@ -186,6 +192,17 @@ def assemble(events) -> dict:
             # backoff sleeps no span covers: it must not feed the
             # consistency/coverage gate as "clean"
             tl(rid)["throttles"] += 1
+        elif kind == "serve_request_replayed":
+            t = tl(rid)
+            t["replayed"] = True
+            t["gaps"].append({
+                "kind": "dead-worker", "pid": ev.get("pid"),
+                "t": ev.get("t"),
+                "detail": (f"worker {ev.get('from_worker')} died "
+                           "holding this request; replayed on worker "
+                           f"{ev.get('to_worker')} — the home "
+                           "attempt's evidence died with it"),
+            })
         elif kind == "serve_request_requeued":
             t = tl(rid)
             t["requeued"] = True
@@ -293,6 +310,7 @@ def _finalize(t: dict, anchors: dict):
     t["clean"] = bool(
         final is not None and final.get("ok")
         and not t["requeued"] and not t["spills"]
+        and not t["replayed"]
         and t["rejections"] == 0 and t["throttles"] == 0
         and len(t["server"]) == 1
     )

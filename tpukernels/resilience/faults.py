@@ -56,6 +56,20 @@ contract and examples):
   (docs/SERVING.md §watchdog). ``times`` defaults to 1 (0 = every
   matching call); ``kernel`` omitted matches any; a bare string is
   ``{"kernel": ...}`` sugar; the same ``"env"`` clause narrows.
+- ``"kill_worker": {"kernel": "scan", "on_call": 3}`` — the process
+  SIGKILLs ITSELF on its ``on_call``-th matching ``registry.dispatch``
+  (default 1): the serve fleet's dead-worker chaos proof — unlike
+  ``wedge_dispatch`` (thread wedged, process alive, flock held) this
+  is true process death, the pidfile flock releases and the health
+  manager must detect, sweep, respawn and rejoin
+  (docs/SERVING.md §self-healing). ``kernel`` omitted matches any; a
+  bare string is ``{"kernel": ...}`` sugar; the same ``"env"`` clause
+  narrows to ONE fleet worker via its ``TPK_SERVE_WORKER_ID``. An
+  optional ``"once_file": path`` makes the kill one-shot ACROSS
+  respawns (the file is created before dying; later incarnations see
+  it and run clean) — without it every incarnation dies on its
+  ``on_call``-th dispatch, which is exactly the crash-loop →
+  quarantine proof.
 - ``"corrupt_output": {"kernel": "sgemm", "site": "registry"}`` /
   ``"nan_output": {...}`` — the output-integrity guard
   (resilience/integrity.py) corrupts the guarded result it is about
@@ -115,6 +129,7 @@ _PROBE_IDX = 0       # probe attempts consumed (per process)
 _CURRENT_METRIC = None  # set by bench's --one/--prewarm child entry
 _DISPATCH_CALLS: dict = {}  # kernel -> dispatches seen (slow_dispatch)
 _WEDGE_CALLS: dict = {}     # kernel -> dispatches seen (wedge_dispatch)
+_KILL_CALLS: dict = {}      # kernel -> dispatches seen (kill_worker)
 
 
 def active() -> bool:
@@ -130,6 +145,7 @@ def reload_plan():
     _CURRENT_METRIC = None
     _DISPATCH_CALLS.clear()
     _WEDGE_CALLS.clear()
+    _KILL_CALLS.clear()
     return _PLAN
 
 
@@ -261,6 +277,33 @@ def dispatch_fault(kernel: str):
     ``times`` budget, runs clean."""
     if _PLAN is None:
         return
+    kspec = _PLAN.get("kill_worker")
+    if kspec:
+        if isinstance(kspec, str):
+            kspec = {"kernel": kspec}
+        want = kspec.get("kernel")
+        want_env = kspec.get("env")
+        if (want is None or want == kernel) and not (
+            want_env and any(
+                os.environ.get(k) != v for k, v in want_env.items()
+            )
+        ):
+            n = _KILL_CALLS[kernel] = _KILL_CALLS.get(kernel, 0) + 1
+            once = kspec.get("once_file")
+            if n == int(kspec.get("on_call", 1)) and not (
+                    once and os.path.exists(once)):
+                if once:
+                    # mark BEFORE dying: the one-shot contract must
+                    # hold even though nothing after the kill runs
+                    with open(once, "w") as f:
+                        f.write(f"{os.getpid()}\n")
+                journal.emit(
+                    "fault_injected", site="dispatch", kernel=kernel,
+                    fault="kill_worker", call=n,
+                )
+                print(f"# fault: SIGKILL self mid-{kernel} dispatch "
+                      f"(call {n})", file=sys.stderr, flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
     wspec = _PLAN.get("wedge_dispatch")
     if wspec:
         if isinstance(wspec, str):

@@ -33,6 +33,15 @@ from tpukernels import _cachedir
 from tpukernels.obs import metrics as obs_metrics
 from tpukernels.serve import protocol
 
+# transport failures a RESPAWNED daemon explains: the old connection
+# died with the old process (reset / broken pipe / mid-frame EOF) but
+# the socket path is live again — dispatch_with_backpressure retries
+# these ONCE through a fresh connection (docs/SERVING.md
+# §self-healing). A refused reconnect (daemon actually down)
+# propagates as the hard error it is.
+_RECONNECTABLE = (ConnectionResetError, BrokenPipeError,
+                  protocol.ProtocolError)
+
 
 class ServeError(Exception):
     """The daemon answered, and the answer is a dispatch failure."""
@@ -71,7 +80,18 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
     together does not sleep the same hint and re-stampede a
     recovering daemon in lockstep (the thundering-herd fix — seeded,
     so a loadgen run's schedule stays byte-reproducible). ``None``
-    keeps the raw hint."""
+    keeps the raw hint.
+
+    One stale-connection transport failure is also absorbed: a
+    client that held a connection to a daemon which was since
+    RESTARTED on the same socket (the health manager's respawn, a
+    rolling restart) sees ECONNRESET/EPIPE/mid-frame EOF on its next
+    dispatch — that is retried exactly once through a fresh
+    connection, with the SAME request_id (the PR-13 one-id
+    discipline: it is still one logical request). Kernels are pure,
+    so the replay is safe even if the old daemon executed before
+    dying. A second transport failure — the daemon is actually gone —
+    propagates untouched."""
     # one LOGICAL request, one causal id: backpressure retries of the
     # same request must not mint fresh request_ids, or the timeline
     # assembler would see N unrelated one-hop requests instead of one
@@ -82,6 +102,7 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
         if mint is not None:
             rid = cli.next_request_id = mint()
     tries = 0
+    reconnected = False
     while True:
         try:
             return cli.dispatch(kernel, *args, **statics)
@@ -93,6 +114,16 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
             if jitter is not None:
                 wait *= 0.5 + jitter.random()
             time.sleep(wait)
+            if rid is not None:
+                cli.next_request_id = rid
+        except _RECONNECTABLE:
+            # dispatch() already closed the poisoned socket; the next
+            # call reconnects to the (respawned) daemon on the same
+            # path. Once only — a daemon that is truly gone must
+            # surface as the transport error it is.
+            if reconnected:
+                raise
+            reconnected = True
             if rid is not None:
                 cli.next_request_id = rid
 

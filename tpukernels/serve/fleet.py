@@ -114,13 +114,17 @@ def _child_env(extra=None) -> dict:
     return env
 
 
-def spawn_worker(i: int, repo: str):
+def spawn_worker(i: int, repo: str, d=None):
     """Spawn worker ``i`` detached (own session, stderr appended to
     its daemon log), on its own socket/dir. Returns (proc,
-    socket_path)."""
-    d = worker_dir(i)
+    socket_path). ``d`` overrides the worker dir — the fleet health
+    manager respawns a dead worker at the EXACT dir/socket the router
+    already points at (docs/SERVING.md §self-healing), not wherever
+    the current env would resolve ``worker_dir(i)``."""
+    if d is None:
+        d = worker_dir(i)
     os.makedirs(d, exist_ok=True)
-    sock = worker_socket_path(i)
+    sock = os.path.join(d, "serve.sock")
     log = open(os.path.join(d, "serve_daemon.log"), "a")
     try:
         proc = subprocess.Popen(

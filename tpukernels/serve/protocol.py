@@ -17,6 +17,19 @@ The same framing serves both directions. Requests:
 
     {"v": 1, "op": "ping"}        # liveness / stats, no payload
 
+A dispatch header may also carry ``replay`` (int, set by the fleet
+router, never by clients): the count of prior delivery attempts this
+request already survived — the router re-forwards an accepted request
+whose worker DIED mid-flight to the bucket's ring sibling
+(``serve_request_replayed``, docs/SERVING.md §self-healing). The
+field is the replay-idempotency contract made explicit: the dead
+worker MAY already have executed the request, and re-execution is
+safe because every served kernel is a pure function of its operands —
+the worker records the count on its ``serve_request`` evidence
+(``replayed``), the ``request_id`` stays the same across the hops, so
+every journal consumer that dedupes by id counts the request once.
+Old servers ignore the unknown field, like any other.
+
 ``request_id`` is the CLIENT-MINTED causal trace id
 (docs/OBSERVABILITY.md §request tracing): the router relays it
 untouched and tags its routing evidence with it, the server tags its
@@ -286,6 +299,43 @@ def unlink_shm(name) -> bool:
         return True
     except OSError:
         return False
+
+
+def sweep_segments_for_pid(pid) -> tuple:
+    """Targeted leak-on-crash cleanup: unlink every ``tpkserve-<pid>-*``
+    segment of ONE dead creator, returning ``(count, bytes)`` so the
+    caller's evidence (the fleet health manager's ``worker_dead``
+    event) can carry the reclaimed byte count. The creator must
+    actually be dead — a live (or recycled) pid is left alone; the
+    generic start-time :func:`sweep_stale_segments` remains the
+    backstop."""
+    if not isinstance(pid, int) or pid <= 0:
+        return 0, 0
+    try:
+        os.kill(pid, 0)
+        return 0, 0             # alive (or recycled): not ours to sweep
+    except ProcessLookupError:
+        pass
+    except OSError:
+        return 0, 0             # EPERM: alive under another uid
+    try:
+        names = os.listdir(SHM_DIR)
+    except OSError:
+        return 0, 0
+    removed, nbytes = 0, 0
+    for name in names:
+        m = _SHM_NAME_RE.match(name)
+        if not m or int(m.group(1)) != pid:
+            continue
+        path = os.path.join(SHM_DIR, name)
+        try:
+            size = os.stat(path).st_size
+            os.unlink(path)
+        except OSError:
+            continue
+        removed += 1
+        nbytes += size
+    return removed, nbytes
 
 
 def sweep_stale_segments() -> int:

@@ -45,6 +45,21 @@ The routing disciplines, each CPU-chaos-proven (tests/test_fleet.py):
   generalized across processes). ``undrain`` restores it. Zero
   accepted requests drop across a drain + supervisor-managed restart
   (test-asserted).
+- **Self-healing** (docs/SERVING.md §self-healing) — the router
+  process runs the fleet health manager (``serve/health.py``):
+  periodic pidfile-flock + ping probes declare a crashed worker
+  ``worker_dead`` within one probe interval (``TPK_FLEET_PROBE_S``),
+  sweep its leaked ``/dev/shm`` segments immediately, and respawn it
+  on its original socket with exponential backoff and a crash-loop
+  quarantine (``TPK_FLEET_RESTART_MAX``); ring rejoin waits for a
+  clean ping + prewarm smoke. An accepted request whose worker died
+  mid-flight is re-routed ONCE to the ring sibling as a REPLAY
+  (``serve_request_replayed``; the ``replay`` header documents the
+  idempotency contract), so zero accepted requests drop across
+  process death. When a bucket's home and sibling are both out, the
+  router sheds by priority class — batch first, with an honest
+  ``retry_after_s`` derived from the respawn backoff — and journals
+  ``fleet_degraded`` level changes instead of timing clients out.
 - **Per-tenant fairness** — admission at the router runs a token
   bucket per ``tenant`` (header field; ``TPK_ROUTE_TENANT_RATE``
   tokens/s up to ``TPK_ROUTE_TENANT_BURST``, 0 = quotas off). A
@@ -203,6 +218,14 @@ class Router:
         self._listener = None
         self._lock = threading.Lock()
         self._draining: set = set()          # worker indices
+        # self-healing state (docs/SERVING.md §self-healing): workers
+        # the health manager declared dead/quarantined leave the ring
+        # until their respawn passes the rejoin gate; the degradation
+        # level derives from the down set and is journaled on change
+        self._down: set = set()              # dead / not-yet-rejoined
+        self._quarantined: set = set()       # crash-looped, operator-gated
+        self._health = None                  # HealthManager, if attached
+        self._level = "ok"                   # ok | degraded | critical
         self._cooldown: dict = {}            # idx -> until (perf_counter)
         self._inflight = [0] * len(self.workers)
         self._routed_to = [0] * len(self.workers)
@@ -289,6 +312,95 @@ class Router:
             )
 
     # -------------------------------------------------------------- #
+    # self-healing hooks (serve/health.py)                           #
+    # -------------------------------------------------------------- #
+
+    def attach_health(self, hm):
+        self._health = hm
+
+    def worker_draining(self, idx: int) -> bool:
+        with self._lock:
+            return idx in self._draining
+
+    def set_worker_down(self, idx: int, down: bool,
+                        quarantined: bool = False):
+        """Health-manager hook: a worker left (or rejoined) the ring.
+        The idle connection pool is flushed BOTH ways — a dead
+        worker's pooled sockets are corpses, and a respawned worker
+        on the same socket path must never be spoken to through a
+        connection to its predecessor."""
+        with self._lock:
+            if down:
+                self._down.add(idx)
+                if quarantined:
+                    self._quarantined.add(idx)
+            else:
+                self._down.discard(idx)
+                self._quarantined.discard(idx)
+                self._cooldown.pop(idx, None)
+        self._pools[idx].close_all()
+        self._recompute_level()
+
+    def _recompute_level(self):
+        """Degradation level from the down set, journaled on CHANGE
+        (``fleet_degraded``): ``degraded`` = at least one worker out
+        but every bucket still has its home or sibling; ``critical``
+        = some ring-adjacent pair is fully out, i.e. some buckets'
+        home AND sibling are both gone and the router is shedding
+        their load by priority class."""
+        n = len(self.workers)
+        with self._lock:
+            down = set(self._down)
+            quarantined = sorted(self._quarantined)
+            if not down:
+                level = "ok"
+            elif len(down) >= n or any(
+                    (i + 1) % n in down for i in down):
+                level = "critical"
+            else:
+                level = "degraded"
+            changed, self._level = level != self._level, level
+        if not changed:
+            return
+        hint = (self._health.retry_hint(down) if self._health
+                and down else 0.0)
+        journal.emit(
+            "fleet_degraded", level=level, down=sorted(down),
+            quarantined=quarantined, n_workers=n,
+            retry_after_s=hint,
+        )
+        print(f"# route: fleet {level.upper()}"
+              + (f" - workers {sorted(down)} out of the ring"
+                 f" (retry hint {hint}s)" if down else
+                 " - all workers restored"), file=sys.stderr)
+
+    def _shed(self, conn_reply, rid, req_id, kernel, bucket, tenant,
+              priority, down):
+        """Degradation shedding: answer the client honestly NOW —
+        ``retry_after_s`` derived from the respawn backoff — instead
+        of timing it out against workers that cannot answer
+        (docs/SERVING.md §self-healing)."""
+        retry = (self._health.retry_hint(down) if self._health
+                 else max(0.1, DEFAULT_COOLDOWN_S / 10))
+        with self._lock:
+            self._rejected += 1
+        obs_metrics.inc("serve.rejected")
+        journal.emit(
+            "serve_rejected", kernel=kernel, request=rid,
+            request_id=req_id, reason="fleet_degraded",
+            bucket=bucket, tenant=tenant, priority=priority,
+            down=sorted(down), retry_after_s=retry,
+        )
+        conn_reply({
+            "v": protocol.VERSION, "id": rid, "ok": False,
+            "kind": "overloaded", "degraded": True,
+            "retry_after_s": retry,
+            "error": (f"fleet degraded: workers {sorted(down)} down; "
+                      f"{priority} {kernel} shed - retry after "
+                      f"{retry}s"),
+        })
+
+    # -------------------------------------------------------------- #
     # front side                                                     #
     # -------------------------------------------------------------- #
 
@@ -321,6 +433,10 @@ class Router:
 
     def _stats(self) -> dict:
         meta = self._worker_meta()
+        health = self._health
+        hrows = ([health.row(i) for i in range(len(self.workers))]
+                 if health is not None else
+                 [{} for _ in self.workers])
         now = time.perf_counter()
         with self._lock:
             rows = [
@@ -328,15 +444,24 @@ class Router:
                     "socket": w,
                     "draining": i in self._draining,
                     "cooling": self._cooldown.get(i, 0.0) > now,
+                    "down": i in self._down,
                     "inflight": self._inflight[i],
                     "routed": self._routed_to[i],
+                    # liveness / restart-count / quarantine columns
+                    # (docs/SERVING.md §self-healing; None without a
+                    # health manager — a bare `--worker` router)
+                    "state": hrows[i].get("state"),
+                    "restarts": hrows[i].get("restarts"),
+                    "quarantined": bool(hrows[i].get("quarantined")),
                 }
                 for i, w in enumerate(self.workers)
             ]
+            level = self._level
             return {
                 "op": "pong", "ok": True, "v": protocol.VERSION,
                 "role": "router", "pid": os.getpid(),
                 "workers": rows, "n_workers": len(self.workers),
+                "level": level,
                 "routed": self._routed, "spilled": self._spilled,
                 "throttled": self._throttled,
                 "rejected": self._rejected,
@@ -435,6 +560,11 @@ class Router:
                 self._draining.discard(idx)
                 self._cooldown.pop(idx, None)
             inflight = self._inflight[idx]
+        if op == "undrain" and self._health is not None:
+            # the operator restored this worker on purpose: forget its
+            # crash window and quarantine — the next probe pass
+            # re-verifies it (and respawns it if it is actually dead)
+            self._health.reset(idx)
         # flush the worker's idle connection pool both ways: drained
         # workers get stopped (their pooled sockets go stale), and an
         # undrained worker is usually a FRESH process on the same
@@ -487,18 +617,28 @@ class Router:
 
     def _order(self, bucket: str) -> list:
         """[primary, spill_sibling, ...] for one bucket: the md5 ring
-        with draining workers removed and cooling (recently wedged)
-        workers deferred to last resort. Falls back to the raw ring
-        when everything is draining/cooling — routing SOMEWHERE
-        loudly beats rejecting everything silently."""
+        with DOWN (dead/quarantined — docs/SERVING.md §self-healing)
+        and draining workers removed and cooling (recently wedged)
+        workers deferred to last resort. Falls back to the raw
+        draining/cooling members when nothing is warm — routing
+        SOMEWHERE loudly beats rejecting everything silently — but
+        never to a down worker: the connection cannot succeed, and
+        the shed path owes the client an honest answer instead. An
+        EMPTY return means every ring member is down: the caller
+        sheds."""
         ring = ring_order(bucket, len(self.workers))
         now = time.perf_counter()
         with self._lock:
             draining = set(self._draining)
+            down = set(self._down)
             cooling = {i for i, t in self._cooldown.items() if t > now}
-        alive = [i for i in ring if i not in draining]
+        alive = [i for i in ring if i not in draining
+                 and i not in down]
         warm = [i for i in alive if i not in cooling]
-        return (warm + [i for i in alive if i in cooling]) or ring
+        ordered = warm + [i for i in alive if i in cooling]
+        if ordered:
+            return ordered
+        return [i for i in ring if i not in down]
 
     def _forward(self, idx: int, header: dict, payloads):
         """One upstream round trip; raises OSError/ProtocolError on
@@ -602,10 +742,26 @@ class Router:
                              f"({priority}); retry after {retry}s")})
             return
         order = self._order(bucket)
+        with self._lock:
+            down = set(self._down)
+        # graceful degradation (docs/SERVING.md §self-healing): with
+        # the bucket's home AND sibling both out, batch load sheds
+        # FIRST (an honest retry_after_s derived from the respawn
+        # backoff) while interactive traffic keeps riding whatever
+        # ring members remain; nothing alive at all sheds everything
+        # — a client told when to come back beats a client timing out
+        home_pair = set(ring_order(bucket, len(self.workers))[:2])
+        if not order or (priority == "batch" and down
+                         and home_pair <= down):
+            self._shed(reply, rid, req_id, kernel, bucket, tenant,
+                       priority, down or home_pair)
+            return
         idx = order[0]
         spilled_from = None
         reason = None
+        dead = False
         for hop in range(2):
+            dead = False
             try:
                 resp, out_payloads = self._forward(idx, header,
                                                    payloads)
@@ -613,6 +769,13 @@ class Router:
                 resp, out_payloads = None, ()
                 reason = "transport"
                 err = e
+                # dead-vs-transient discrimination at the moment of
+                # failure: a free pidfile flock is a death
+                # certificate, and declaring it NOW (sweep, respawn
+                # scheduling, ring removal) is what turns in-flight
+                # loss into a replay instead of a client error
+                dead = (self._health.note_transport_loss(idx)
+                        if self._health is not None else False)
             else:
                 if resp.get("ok"):
                     reason = None
@@ -632,8 +795,16 @@ class Router:
                 break
             sibling = next((j for j in order if j != idx), None)
             if hop == 1 or sibling is None:
-                # no (further) sibling: surface the failure honestly
                 if resp is None:
+                    if dead:
+                        # the last candidate DIED under this request:
+                        # answer like the shed path — the worker is
+                        # being respawned, and "come back in Ns" is
+                        # the honest reply, not a hard error
+                        self._shed(reply, rid, req_id, kernel, bucket,
+                                   tenant, priority, {idx})
+                        return
+                    # no (further) sibling: surface the failure honestly
                     resp = {"v": protocol.VERSION, "id": rid,
                             "ok": False, "kind": "error",
                             "error": (f"worker {idx} unreachable: "
@@ -650,6 +821,25 @@ class Router:
                 from_worker=idx, to_worker=sibling,
                 reason=reason, tenant=tenant,
             )
+            if dead:
+                # in-flight recovery (docs/SERVING.md §self-healing):
+                # the home worker DIED holding this accepted request —
+                # re-route it ONCE to the ring sibling, stamped as a
+                # replay. The `replay` header is the idempotency
+                # contract (protocol.py): the dead worker may already
+                # have executed it, kernels are pure, the request_id
+                # stays the same, so every consumer counts it once.
+                journal.emit(
+                    "serve_request_replayed", kernel=kernel,
+                    bucket=bucket, request=rid, request_id=req_id,
+                    from_worker=idx, to_worker=sibling, tenant=tenant,
+                )
+                header = dict(header)
+                try:
+                    prior = int(header.get("replay") or 0)
+                except (TypeError, ValueError):
+                    prior = 0
+                header["replay"] = prior + 1
             spilled_from, idx = idx, sibling
         with self._lock:
             self._routed += 1
@@ -726,16 +916,29 @@ def main(argv=None):
         return 3
 
     from tpukernels.obs import scaling as obs_scaling
+    from tpukernels.serve import health as serve_health
 
     # env-derived stamp only: the router is jax-free by design and
     # must never initialize a backend (the workers stamp probed
     # inventories of their own)
     obs_scaling.emit_inventory("serve_router")
+    # the self-healing loop rides in this process (docs/SERVING.md
+    # §self-healing): worker pidfiles live beside their sockets, and
+    # respawns reuse the exact dir/socket the ring already points at.
+    # TPK_FLEET_PROBE_S=0 disables detection + respawn.
+    try:
+        hm = serve_health.HealthManager(workers, repo=os.getcwd(),
+                                        router=router)
+    except ValueError as e:
+        print(f"route: {e}", file=sys.stderr)
+        return 2
+    router.attach_health(hm)
+    hm.start()
     signal.signal(signal.SIGTERM, router.stop)
     signal.signal(signal.SIGINT, router.stop)
     print(f"# route: listening on {socket_path} "
-          f"(pid {os.getpid()}, {len(workers)} worker(s))",
-          file=sys.stderr)
+          f"(pid {os.getpid()}, {len(workers)} worker(s), health "
+          f"probe {hm.probe_s}s)", file=sys.stderr)
     try:
         router.serve_forever()
     except OSError as e:
@@ -743,6 +946,7 @@ def main(argv=None):
               file=sys.stderr)
         return 1
     finally:
+        hm.stop()
         try:
             pidfile.close()
             os.unlink(serve_fleet.router_pidfile_path())

@@ -159,11 +159,11 @@ class _Request:
                  "spec", "pad_frac", "bucket", "conn", "t_enq",
                  "t_start", "requeues", "patience", "done", "lock",
                  "worker_ident", "tenant", "shm_ok", "request_id",
-                 "shapes", "dtypes")
+                 "shapes", "dtypes", "replayed")
 
     def __init__(self, serial, rid, kernel, statics, arrays, spec,
                  pad_frac, bucket, conn, tenant=None, shm_ok=False,
-                 request_id=None):
+                 request_id=None, replayed=None):
         self.serial = serial  # server-side key: client ids can collide
         self.rid = rid
         # the client-minted causal id (docs/OBSERVABILITY.md §request
@@ -181,6 +181,11 @@ class _Request:
         self.bucket = bucket
         self.conn = conn
         self.tenant = tenant
+        # the router's replay-idempotency count (protocol.py): >0
+        # means a dead sibling may already have executed this request
+        # — safe (kernels are pure), recorded on the serve_request
+        # evidence so postmortems see the delivery history
+        self.replayed = replayed
         self.shm_ok = shm_ok       # client negotiated the shm lane
         self.t_enq = time.perf_counter()
         self.t_start = None
@@ -538,12 +543,17 @@ class Server:
             self._next_rid += 1
             serial = self._next_rid
         req_id = header.get("request_id")
+        replay = header.get("replay")
         req = _Request(serial, rid if rid is not None else serial,
                        kernel, statics, arrays, spec, pad_frac,
                        bucket, conn, tenant=header.get("tenant"),
                        shm_ok=bool(header.get("shm_ok")),
                        request_id=(str(req_id) if req_id is not None
-                                   else None))
+                                   else None),
+                       replayed=(int(replay)
+                                 if isinstance(replay, int)
+                                 and not isinstance(replay, bool)
+                                 and replay > 0 else None))
         try:
             self._q.put_nowait(req)
         except _queue_mod.Full:
@@ -888,6 +898,7 @@ class Server:
             queue_wait_s=round(queue_wait, 6)
             if queue_wait is not None else None,
             batch_size=batch_size, requeues=req.requeues,
+            replayed=req.replayed,
             ok=error is None, error=error,
         )
         try:
