@@ -283,6 +283,11 @@ os.environ.pop("TPK_TRACE_COVERAGE_MIN", None)
 os.environ.pop("TPK_FLEET_PROBE_S", None)
 os.environ.pop("TPK_FLEET_RESTART_MAX", None)
 os.environ.pop("TPK_FLEET_RESTART_BACKOFF_S", None)
+# Guardian + durable-admission knobs (docs/SERVING.md §guardian):
+# same story for the router-crash recovery tests.
+os.environ.pop("TPK_ROUTER_RESTART_MAX", None)
+os.environ.pop("TPK_ROUTER_RESTART_BACKOFF_S", None)
+os.environ.pop("TPK_CLIENT_RECONNECT_S", None)
 if "TPK_SERVE_DIR" not in os.environ:
     import glob as _serve_glob
     import signal as _serve_signal
@@ -322,8 +327,11 @@ if "TPK_SERVE_DIR" not in os.environ:
             except OSError:
                 pass
 
+    # the guardian FIRST: reaped any later it would respawn the
+    # router between the router's reap and its own
     for _pidfile in (
-        [os.path.join(_serve_dir, "serve.pid"),
+        [os.path.join(_serve_dir, "fleet", "guardian.pid"),
+         os.path.join(_serve_dir, "serve.pid"),
          os.path.join(_serve_dir, "fleet", "router.pid")]
         + _serve_glob.glob(os.path.join(_serve_dir, "fleet",
                                         "worker*", "serve.pid"))
@@ -332,7 +340,9 @@ if "TPK_SERVE_DIR" not in os.environ:
     for _f in ("serve.sock", "serve.pid",
                os.path.join("fleet", "fleet.json"),
                os.path.join("fleet", "front.sock"),
-               os.path.join("fleet", "router.pid")):
+               os.path.join("fleet", "router.pid"),
+               os.path.join("fleet", "guardian.pid"),
+               os.path.join("fleet", "router.wal")):
         try:
             os.unlink(os.path.join(_serve_dir, _f))
         except OSError:

@@ -1,0 +1,572 @@
+"""CPU suite for router crash recovery: the guardian, the admission
+WAL, crash-consistent artifacts and the fsck sweep (docs/SERVING.md
+§guardian; docs/RESILIENCE.md §failure domains; ISSUE 16).
+
+The acceptance headline, all on CPU over Unix sockets: `kill -9` the
+ROUTER mid-burst — the guardian declares it dead within a probe
+interval (flock-free pidfile), sweeps its shm, respawns it on the
+original front socket, the new router replays its admission WAL, and
+the clients' `TPK_CLIENT_RECONNECT_S` budget rides out the refused
+window — zero failed requests end to end. Plus: the `kill_router`
+fault's worst-instant kill (WAL entry durable, forward not sent) with
+exactly-once worker delivery, the torn-artifact loud-rejection
+contract per persisted family, `serve_ctl fsck`, and the pure units
+(WAL append/ack/compaction/torn tail, guardian state machine + knob
+parses, the client reconnect budget).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_fleet import _ctl, _fleet
+from test_serve import _events
+from test_fleet_health import _wait_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _events_or_empty(journal_path):
+    try:
+        return _events(journal_path)
+    except OSError:
+        return []
+
+# compressed windows + inline lane (WAL-replayable payloads) + a
+# reconnect budget generously wider than the respawn window so the
+# headline's zero-drop claim never races the scheduler
+GUARDIAN_ENV = {
+    "TPK_FLEET_PROBE_S": "0.3",
+    "TPK_FLEET_RESTART_BACKOFF_S": "0.2",
+    "TPK_ROUTER_RESTART_BACKOFF_S": "0.2",
+    "TPK_SERVE_SHM": "0",
+    "TPK_CLIENT_RECONNECT_S": "60",
+}
+
+
+# ---------------------------------------------------------------- #
+# pure units: the WAL                                              #
+# ---------------------------------------------------------------- #
+
+def test_wal_append_ack_torn_tail_and_close(tmp_path):
+    from tpukernels.serve import wal as serve_wal
+
+    path = str(tmp_path / "router.wal")
+    assert serve_wal.read_pending(path) == {}
+
+    w = serve_wal.Wal(path)
+    w.append("k1", {"h": {"kernel": "scan"}, "n": 1})
+    w.append("k2", {"h": {"kernel": "scan"}, "n": 2})
+    assert w.depth() == 2
+    assert list(serve_wal.read_pending(path)) == ["k1", "k2"]
+    w.ack("k1")
+    assert serve_wal.read_pending(path) == {
+        "k2": {"h": {"kernel": "scan"}, "n": 2}
+    }
+
+    # a torn TAIL line is normal crash residue: skipped, never fatal,
+    # and the durable prefix still reads back intact
+    with open(path, "ab") as f:
+        f.write(b'{"op": "req", "key": "k3", "e": {"half')
+    assert list(serve_wal.read_pending(path)) == ["k2"]
+
+    # recover-then-append: a new (respawned-router) instance sees
+    # exactly the durable pending set
+    w2 = serve_wal.Wal(path)
+    assert w2.take_pending() == {"k2": {"h": {"kernel": "scan"}, "n": 2}}
+    # take_pending is a snapshot: a second crash mid-replay would
+    # re-replay the remainder — only the ack settles the entry
+    assert w2.depth() == 1
+    w2.ack("k2")
+    # close with nothing pending unlinks — clean shutdown leaves no
+    # stale WAL for the next start to "replay"
+    w2.close()
+    assert not os.path.exists(path)
+
+
+def test_wal_compaction_stays_bounded(tmp_path):
+    from tpukernels.serve import wal as serve_wal
+
+    path = str(tmp_path / "router.wal")
+    w = serve_wal.Wal(path)
+    for i in range(300):
+        w.append(f"k{i}", {"n": i})
+        w.ack(f"k{i}")
+    w.append("tail", {"n": -1})
+    # steady-state file is O(inflight), not O(traffic): after 600+
+    # ops with one pending entry, compaction must have rewritten it
+    with open(path, "rb") as f:
+        lines = [ln for ln in f.read().split(b"\n") if ln.strip()]
+    assert len(lines) < 2 * serve_wal.COMPACT_SLACK + 4
+    assert list(serve_wal.read_pending(path)) == ["tail"]
+    # pending survives close (there is still something to replay)
+    w.close()
+    assert os.path.exists(path)
+
+
+# ---------------------------------------------------------------- #
+# pure units: the guardian state machine                           #
+# ---------------------------------------------------------------- #
+
+def test_guardian_knob_parse_fail_loud(monkeypatch):
+    from tpukernels.serve import guardian
+
+    monkeypatch.setenv("TPK_ROUTER_RESTART_MAX", "banana")
+    with pytest.raises(ValueError, match="TPK_ROUTER_RESTART_MAX"):
+        guardian.Guardian(repo=REPO)
+    monkeypatch.setenv("TPK_ROUTER_RESTART_MAX", "0")
+    with pytest.raises(ValueError, match="TPK_ROUTER_RESTART_MAX"):
+        guardian.Guardian(repo=REPO)
+    monkeypatch.delenv("TPK_ROUTER_RESTART_MAX")
+    monkeypatch.setenv("TPK_ROUTER_RESTART_BACKOFF_S", "nope")
+    with pytest.raises(ValueError,
+                       match="TPK_ROUTER_RESTART_BACKOFF_S"):
+        guardian.Guardian(repo=REPO)
+    monkeypatch.delenv("TPK_ROUTER_RESTART_BACKOFF_S")
+    g = guardian.Guardian(repo=REPO)
+    assert g.restart_max == guardian.DEFAULT_RESTART_MAX
+    assert g.backoff_s == guardian.DEFAULT_BACKOFF_S
+
+
+def test_guardian_detects_flock_and_quarantines(tmp_path, monkeypatch):
+    """Detection + crash-loop bookkeeping without any real router
+    process: WE hold (and release) the router pidfile flock."""
+    from tpukernels.serve import fleet, guardian
+    from tpukernels.serve import server as serve_server
+
+    monkeypatch.setenv("TPK_SERVE_DIR", str(tmp_path))
+    journal_path = str(tmp_path / "j.jsonl")
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", journal_path)
+
+    g = guardian.Guardian(repo=REPO, probe_s=0.1, restart_max=2,
+                          backoff_s=0.05)
+    # startup grace: no flock yet, but the router may still be binding
+    g.probe_pass()
+    assert g.state == "up" and g.crashes == 0
+
+    # a HELD flock is life: pid observed, streak grows
+    os.makedirs(os.path.dirname(fleet.router_pidfile_path()),
+                exist_ok=True)
+    pf = serve_server._hold_pidfile(fleet.router_pidfile_path())
+    g.probe_pass()
+    assert (g.seen_alive, g.pid) == (True, os.getpid())
+
+    # releasing it is a death certificate: crash 1, backoff scheduled
+    pf.close()
+    g.probe_pass()
+    assert g.state == "down"
+    assert g.crashes == 1
+    assert g.next_attempt > time.perf_counter() - 0.01
+    # second confirmed crash at restart_max=2: quarantined, loudly
+    g._declare_dead(None, via="probe")
+    assert g.state == "quarantined"
+    g.probe_pass()  # inert — never respawns out of quarantine
+    assert g.state == "quarantined"
+    events = _events(journal_path)
+    dead = [e for e in events if e["kind"] == "router_dead"]
+    assert [e["crashes"] for e in dead] == [1, 2]
+    assert dead[0]["via"] == "probe"
+    q = [e for e in events if e["kind"] == "router_quarantined"]
+    assert len(q) == 1 and q[0]["threshold"] == 2
+
+    # a stable window (STABLE_PROBES clean passes) forgives history
+    from tpukernels.serve import health
+
+    g2 = guardian.Guardian(repo=REPO, probe_s=0.1, restart_max=3,
+                           backoff_s=0.05)
+    g2.crashes = 2
+    pf2 = serve_server._hold_pidfile(fleet.router_pidfile_path())
+    try:
+        for _ in range(health.STABLE_PROBES):
+            g2.probe_pass()
+        assert g2.crashes == 0
+    finally:
+        pf2.close()
+        os.unlink(fleet.router_pidfile_path())
+
+
+# ---------------------------------------------------------------- #
+# pure units: the client reconnect budget                          #
+# ---------------------------------------------------------------- #
+
+def test_client_reconnect_budget(tmp_path, monkeypatch):
+    import random
+
+    from tpukernels.serve import client as serve_client
+
+    monkeypatch.setenv("TPK_CLIENT_RECONNECT_S", "oops")
+    with pytest.raises(ValueError, match="TPK_CLIENT_RECONNECT_S"):
+        serve_client._reconnect_budget_s()
+    monkeypatch.setenv("TPK_CLIENT_RECONNECT_S", "-1")
+    with pytest.raises(ValueError, match="TPK_CLIENT_RECONNECT_S"):
+        serve_client._reconnect_budget_s()
+
+    class _Refusing:
+        next_request_id = None
+
+        def __init__(self):
+            self.rids = []
+
+        def dispatch(self, kernel, *a, **s):
+            self.rids.append(self.next_request_id)
+            raise ConnectionRefusedError("gone")
+
+    # inside the budget: retried on the jittered cadence with the
+    # SAME request_id (the WAL-replay stash recognizes the retry),
+    # then the transport error surfaces — no silent hang
+    monkeypatch.setenv("TPK_CLIENT_RECONNECT_S", "0.6")
+    cli = _Refusing()
+    cli.next_request_id = "one-id"
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionRefusedError):
+        serve_client.dispatch_with_backpressure(
+            cli, "scan", (np.zeros(4, np.int32),), {},
+            jitter=random.Random(7))
+    elapsed = time.monotonic() - t0
+    assert 0.4 <= elapsed < 5.0
+    assert len(cli.rids) >= 2
+    assert set(cli.rids) == {"one-id"}
+
+    # budget 0 restores the old one-shot contract: a refused connect
+    # is the immediate hard error it always was
+    monkeypatch.setenv("TPK_CLIENT_RECONNECT_S", "0")
+    cli0 = _Refusing()
+    with pytest.raises(ConnectionRefusedError):
+        serve_client.dispatch_with_backpressure(
+            cli0, "scan", (np.zeros(4, np.int32),), {})
+    assert len(cli0.rids) == 1
+
+    # the real transport: socket GONE entirely (no daemon was ever
+    # here) errors within the budget, preserving the error type
+    monkeypatch.setenv("TPK_CLIENT_RECONNECT_S", "0.4")
+    with serve_client.ServeClient(str(tmp_path / "no.sock"),
+                                  timeout_s=5) as real:
+        t0 = time.monotonic()
+        with pytest.raises((FileNotFoundError, ConnectionRefusedError)):
+            serve_client.dispatch_with_backpressure(
+                real, "scan", (np.zeros(4, np.int32),), {})
+        assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------- #
+# crash-consistent artifacts: atomic writes + loud torn rejection  #
+# ---------------------------------------------------------------- #
+
+def test_atomic_write_and_torn_write_fault(tmp_path, monkeypatch):
+    from tpukernels.resilience import atomic, faults
+
+    path = str(tmp_path / "state.json")
+    atomic.dump_json(path, {"v": 1})
+    assert json.load(open(path)) == {"v": 1}
+
+    # an injected mid-write crash (mode=raise) leaves the DESTINATION
+    # untouched — old bytes, not torn bytes — and strands only a tmp
+    monkeypatch.setenv("TPK_FAULT_PLAN", json.dumps(
+        {"torn_write": {"path_substr": "state.json"}}))
+    faults.reload_plan()
+    try:
+        with pytest.raises(OSError, match="torn_write"):
+            atomic.dump_json(path, {"v": 2})
+    finally:
+        monkeypatch.delenv("TPK_FAULT_PLAN")
+        faults.reload_plan()
+    assert json.load(open(path)) == {"v": 1}
+    stranded = [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    assert stranded, "the torn tmp is the evidence a real crash leaves"
+    # the plan key only fires on matching destinations
+    atomic.dump_json(str(tmp_path / "other.json"), {"ok": True})
+
+
+def _assert_torn_rejected(capsys, journal_path, reader, path):
+    """Write torn bytes in place, run the family's reader, assert the
+    loud-rejection contract: empty/absent result, once-per-path
+    stderr note, one ``artifact_rejected`` journal event."""
+    from tpukernels import _cachedir
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write('{"half": [1, 2')  # a pre-atomic writer's crash
+    _cachedir._TORN_NOTED.discard(path)
+    before = len([e for e in _events_or_empty(journal_path)
+                  if e["kind"] == "artifact_rejected"])
+    reader()
+    err = capsys.readouterr().err
+    assert "torn artifact rejected" in err, path
+    reader()  # once per path per process, not log spam
+    assert "torn artifact rejected" not in capsys.readouterr().err
+    rejected = [e for e in _events_or_empty(journal_path)
+                if e["kind"] == "artifact_rejected"]
+    assert len(rejected) == before + 1
+    assert rejected[-1]["path"] == path
+    os.unlink(path)
+
+
+def test_torn_artifacts_reject_loudly_per_family(tmp_path, capsys,
+                                                 monkeypatch):
+    journal_path = str(tmp_path / "j.jsonl")
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", journal_path)
+
+    # tuning cache (tuning.json): reads as cold, never as garbage
+    from tpukernels.tuning import cache
+
+    monkeypatch.setenv("TPK_TUNING_CACHE_DIR", str(tmp_path / "t"))
+    _assert_torn_rejected(
+        capsys, journal_path,
+        lambda: cache._load(cache.path()) == {}, cache.path())
+
+    # AOT manifest (aot.json): same reader discipline, no jax import
+    from tpukernels import _cachedir
+
+    monkeypatch.setenv("TPK_AOT_CACHE_DIR", str(tmp_path / "a"))
+    memo = {}
+    _assert_torn_rejected(
+        capsys, journal_path,
+        lambda: _cachedir.read_json_memoized(
+            _cachedir.aot_manifest_path(), memo) == {},
+        _cachedir.aot_manifest_path())
+
+    # fleet config of record (fleet.json): torn reads as "no fleet",
+    # loudly — the guardian retries instead of inventing a topology
+    from tpukernels.serve import fleet
+
+    monkeypatch.setenv("TPK_SERVE_DIR", str(tmp_path / "s"))
+    _assert_torn_rejected(
+        capsys, journal_path,
+        lambda: fleet.load_config() is None, fleet.config_path())
+
+
+# ---------------------------------------------------------------- #
+# serve_ctl fsck                                                   #
+# ---------------------------------------------------------------- #
+
+def test_fsck_reaps_crash_residue(tmp_path):
+    from test_distributed import _scrubbed_env
+
+    from tpukernels.serve import protocol
+
+    env = _scrubbed_env(None)
+    journal_path = str(tmp_path / "j.jsonl")
+    env["TPK_SERVE_DIR"] = str(tmp_path)
+    env["TPK_HEALTH_JOURNAL"] = journal_path
+
+    fdir = tmp_path / "fleet"
+    fdir.mkdir()
+    # a crashed router's stale (flock-free) pidfile
+    (fdir / "router.pid").write_text("999999\n")
+    # a torn config of record
+    (fdir / "fleet.json").write_text('{"workers": [')
+    # an orphaned shm segment whose creator pid is dead
+    child = subprocess.run(
+        [sys.executable, "-c", "import os; print(os.getpid())"],
+        capture_output=True, text=True)
+    dead = int(child.stdout.strip())
+    orphan = f"tpkserve-{dead}-0-cafef00d"
+    with open(os.path.join(protocol.SHM_DIR, orphan), "wb") as f:
+        f.write(b"\0" * 16)
+
+    try:
+        r = _ctl(env, "fsck")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert not os.path.exists(fdir / "router.pid")
+        assert not os.path.exists(fdir / "fleet.json")
+        assert not os.path.exists(
+            os.path.join(protocol.SHM_DIR, orphan))
+        events = [e for e in _events(journal_path)
+                  if e["kind"] == "fleet_fsck"]
+        assert len(events) == 1
+        assert events[0]["stale_pidfiles"] >= 1
+        assert events[0]["torn_configs"] == 1
+        assert events[0]["swept_segments"] >= 1
+    finally:
+        protocol.unlink_shm(orphan)
+
+    # clean state: fsck is a no-op rc 0 (the daily non-gating step)
+    r = _ctl(env, "fsck")
+    assert r.returncode == 0
+
+
+# ---------------------------------------------------------------- #
+# e2e: the headline — kill -9 the router mid-burst, zero failures  #
+# ---------------------------------------------------------------- #
+
+def _burst(front, tid, n, ok, fail, step_s=0.12):
+    import random
+
+    from tpukernels.serve import client as serve_client
+
+    jit = random.Random(1000 + tid)
+    x = (np.arange(256) % 11).astype(np.int32)
+    want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+    with serve_client.ServeClient(front, timeout_s=120,
+                                  tenant=f"t{tid}") as cli:
+        for k in range(n):
+            try:
+                cli.next_request_id = f"rk-{tid}-{k}"
+                out = serve_client.dispatch_with_backpressure(
+                    cli, "scan", (x,), {}, jitter=jit)
+                assert np.array_equal(out, want), "WRONG RESULT"
+                ok.append((tid, k))
+            except Exception as e:  # noqa: BLE001 - collected, asserted
+                fail.append((tid, k, repr(e)))
+            time.sleep(step_s)
+
+
+def test_router_kill_recovery_zero_drops(tmp_path):
+    from tpukernels.serve import health
+
+    with _fleet(tmp_path, n=2, env_extra=GUARDIAN_ENV,
+                tag="rk") as (front, journal_path, env):
+        r = _ctl(env, "guardian", "--wait", "30")
+        assert r.returncode == 0, r.stdout + r.stderr
+        # double-start refused on the guardian's own flock (rc 3)
+        r = _ctl(env, "guardian")
+        assert r.returncode == 3
+
+        fleet_dir = os.path.join(env["TPK_SERVE_DIR"], "fleet")
+        rpidfile = os.path.join(fleet_dir, "router.pid")
+        held, rpid = health.pidfile_state(rpidfile)
+        assert held
+
+        ok, fail = [], []
+        threads = [
+            threading.Thread(target=_burst,
+                             args=(front, tid, 8, ok, fail))
+            for tid in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # mid-burst
+        os.kill(rpid, signal.SIGKILL)
+        for t in threads:
+            t.join()
+
+        assert not fail, fail
+        assert len(ok) == 24
+
+        _, dead = _wait_events(
+            journal_path,
+            lambda e: e.get("kind") == "router_dead",
+            msg="router_dead")
+        assert dead[0]["router_pid"] == rpid
+        _, resp = _wait_events(
+            journal_path,
+            lambda e: e.get("kind") == "router_respawned",
+            msg="router_respawned")
+        assert resp[0]["down_s"] is not None
+        held2, rpid2 = health.pidfile_state(rpidfile)
+        assert held2 and rpid2 != rpid
+
+        # the fleet converged behind the new router
+        r = _ctl(env, "health", "--wait", "60")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    # stop-fleet (guardian FIRST) left nothing behind to respawn it
+    held, _ = health.pidfile_state(
+        os.path.join(tmp_path, "rk", "fleet", "guardian.pid"))
+    assert not held
+    held, _ = health.pidfile_state(
+        os.path.join(tmp_path, "rk", "fleet", "router.pid"))
+    assert not held
+
+
+def test_kill_router_fault_wal_replay_exactly_once(tmp_path):
+    """The worst-instant crash (`kill_router`: WAL entry durable,
+    forward NOT sent): the respawned router replays the entry, the
+    client's same-id retry is answered from the replay stash, and the
+    worker-side evidence shows EXACTLY one delivery per request_id."""
+    once = str(tmp_path / "kill_router.once")
+    env_extra = dict(GUARDIAN_ENV)
+    env_extra["TPK_FAULT_PLAN"] = json.dumps(
+        {"kill_router": {"on_call": 3, "once_file": once}})
+
+    with _fleet(tmp_path, n=2, env_extra=env_extra,
+                tag="wal") as (front, journal_path, env):
+        r = _ctl(env, "guardian", "--wait", "30")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+        ok, fail = [], []
+        threads = [
+            threading.Thread(target=_burst,
+                             args=(front, tid, 6, ok, fail, 0.1))
+            for tid in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        assert os.path.exists(once), "the fault never fired"
+        assert not fail, fail
+        assert len(ok) == 12
+
+        events, _ = _wait_events(
+            journal_path,
+            lambda e: e.get("kind") == "router_respawned",
+            msg="router_respawned")
+        fired = [e for e in events if e.get("kind") == "fault_injected"
+                 and e.get("fault") == "kill_router"]
+        assert fired and fired[0]["site"] == "route"
+        # the WAL-replayed request is journaled as via="wal" — either
+        # delivered to a worker or skipped LOUDLY with a reason
+        replays = [e for e in events
+                   if e.get("kind") == "serve_request_replayed"
+                   and e.get("via") == "wal"]
+        assert replays, "the durable entry must be replayed"
+        for e in replays:
+            assert e.get("request_id", "").startswith("rk-")
+            if not e.get("ok", True):
+                assert e.get("reason")
+        # exactly-once worker delivery per request_id, replay included
+        per = {}
+        for e in events:
+            if (e.get("kind") == "serve_request"
+                    and str(e.get("request_id", "")).startswith("rk-")):
+                per[e["request_id"]] = per.get(e["request_id"], 0) + 1
+        dups = {k: v for k, v in per.items() if v != 1}
+        assert not dups, dups
+        assert len(per) == 12
+
+        # the outage reassembles in reqtrace as an explicit
+        # dead-router gap on any successfully replayed request
+        delivered = [e for e in replays if e.get("to_worker") is not None]
+        if delivered:
+            from tpukernels.obs import reqtrace
+
+            rid = delivered[0]["request_id"]
+            tls = reqtrace.assemble(
+                [e for e in events if e.get("request_id") == rid])
+            kinds = {g.get("kind") for t in tls.values()
+                     for g in t.get("gaps", [])}
+            assert "dead-router" in kinds
+
+
+# ---------------------------------------------------------------- #
+# the seeded chaos campaign runner (slow: full fleet, many faults) #
+# ---------------------------------------------------------------- #
+
+@pytest.mark.slow
+def test_chaos_campaign_seeded(tmp_path):
+    from test_distributed import _scrubbed_env
+
+    env = _scrubbed_env(None)
+    env["TPK_SERVE_DIR"] = str(tmp_path / "chaos")
+    env["TPK_HEALTH_JOURNAL"] = str(tmp_path / "chaos.jsonl")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--seed", "1", "--events", "3"],
+        capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=570,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    events = [e for e in _events(env["TPK_HEALTH_JOURNAL"])
+              if e.get("kind") == "chaos_event"]
+    assert [e["seq"] for e in events] == [1, 2, 3]
+    assert all(e["seed"] == 1 for e in events)

@@ -773,12 +773,25 @@ def test_production_plan_order_reproduces_next_md(tmp_path,
                           "fleet_probe", "c_scan_timing", "profile"]
     assert order[-2:] == ["san_asan", "san_ubsan"]
     assert len(order) == len(cli.PRODUCTION_QUEUE)
+    # fleet_fsck (value 2 / 1 min = 2.0) is cheap housekeeping: it
+    # slots with the other density-2.0 CPU steps, after the chip work
+    assert order.index("fleet_fsck") > order.index("fleet_probe")
+    assert order.index("fleet_fsck") < order.index("san_asan")
     # fleet_probe rehearses the full self-healing cycle mid-burst
     # (docs/SERVING.md §self-healing) at the SAME cost/value — the
     # kill -> detect -> respawn -> rejoin phase and its convergence
-    # gate are part of the step body, and its rc part of the verdict
+    # gate are part of the step body, and its rc part of the verdict.
+    # Since the guardian, it ALSO kills the router (§guardian): the
+    # rc_heal2 leg proves the front door itself comes back.
     fleet_spec = next(s for s in cli.PRODUCTION_QUEUE
                       if s.name == "fleet_probe")
     assert "kill -9" in fleet_spec.shell
     assert "health --wait" in fleet_spec.shell
     assert "rc_heal" in fleet_spec.shell
+    assert "rc_heal2" in fleet_spec.shell
+    assert "guardian" in fleet_spec.shell
+    assert "router_pidfile_path" in fleet_spec.shell
+    fsck_spec = next(s for s in cli.PRODUCTION_QUEUE
+                     if s.name == "fleet_fsck")
+    assert not fsck_spec.gating
+    assert "fsck" in fsck_spec.shell

@@ -1,0 +1,450 @@
+"""Seeded chaos-campaign runner for the serving fleet
+(docs/RESILIENCE.md §chaos campaigns).
+
+Usage:
+    python tools/chaos.py [--seed N] [--events K] [--workers N]
+
+The single-fault chaos proofs live in the test suite (a kill here, a
+wedge there, each against a fresh fleet). This runner composes them:
+one SEEDED campaign drives a live fleet — router + guardian + N
+workers under continuous client load — through K faults drawn
+deterministically from the full vocabulary, asserting the survival
+invariants after every single event:
+
+- **no accepted-request drops** — every client dispatch either
+  succeeds with a correct result or was honestly shed/throttled and
+  retried to success; a hard failure fails the campaign.
+- **convergence** — the fleet returns to all-members-live
+  (``serve_ctl health``) within the recovery wait after each fault.
+- **journal evidence** — every fault leaves its expected kinds
+  (``router_dead``/``router_respawned`` after a router kill,
+  ``worker_dead``/``worker_respawned`` after a worker kill,
+  ``artifact_rejected`` after a torn artifact, ``fault_injected``
+  for in-process injections), plus one ``chaos_event`` marker per
+  event so the timeline is self-describing.
+- **no leaks** — after teardown: no surviving fleet pids, no
+  ``tpkserve-*`` shm segments, no flocked pidfiles.
+- **observability stays green** — ``obs_report --check`` exits 0
+  over the campaign's artifact root.
+
+Event vocabulary (drawn per-seed): ``kill_router`` (SIGKILL the
+router from its pidfile — the guardian + WAL recovery path),
+``kill_worker`` (SIGKILL a random worker — the health-manager
+respawn path), ``torn_write`` (tear a persisted JSON artifact in
+place, byte-for-byte half a valid payload — the pre-atomic crash
+shape every reader must reject loudly and rebuild), and
+``wedge_dispatch`` (armed at fleet start via ``TPK_FAULT_PLAN`` with
+a ``once_file``, worker 0 wedges one dispatch mid-campaign — the
+watchdog + requeue path; scheduled at most once per campaign).
+
+Same seed, same schedule, same request ids: a failing campaign
+replays exactly. Exit 0 = every invariant held after every event;
+1 = a violation (printed); 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpukernels import _cachedir  # noqa: E402
+from tpukernels.resilience import journal  # noqa: E402
+from tpukernels.serve import client as serve_client  # noqa: E402
+from tpukernels.serve import fleet as serve_fleet  # noqa: E402
+from tpukernels.serve import health as serve_health  # noqa: E402
+from tpukernels.serve import protocol as serve_protocol  # noqa: E402
+
+# wedge_dispatch is armed once at fleet start (fault plans load at
+# import); every other event is an external action this runner takes
+EVENTS = ("kill_router", "kill_worker", "torn_write")
+
+RECOVER_WAIT_S = 120.0
+
+
+class CampaignFailure(Exception):
+    pass
+
+
+def _ctl(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "serve_ctl.py"),
+         *args],
+        cwd=_REPO, capture_output=True, text=True,
+    )
+
+
+def _journal_events():
+    path = journal.path() or journal.default_path()
+    evs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    evs.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return evs
+
+
+def _wait_for(pred, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.25)
+    raise CampaignFailure(f"timed out waiting for {what}")
+
+
+class _Load:
+    """Continuous seeded client load (threads in this process): scan
+    dispatches with correctness checks, riding the full backpressure +
+    reconnect-budget policy. ``failures`` is the campaign's
+    zero-drops invariant."""
+
+    def __init__(self, front: str, seed: int, clients: int = 3):
+        self.front = front
+        self.seed = seed
+        self.clients = clients
+        self.ok = 0
+        self.failures: list = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list = []
+
+    def start(self):
+        for i in range(self.clients):
+            t = threading.Thread(target=self._run, args=(i,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=180)
+
+    def _run(self, tid: int):
+        import numpy as np
+
+        rng = random.Random(self.seed * 1000 + tid)
+        seq = 0
+        with serve_client.ServeClient(
+            self.front, timeout_s=120, tenant=f"chaos{tid}",
+        ) as cli:
+            while not self._stop.is_set():
+                seq += 1
+                n = rng.choice((64, 128, 256))
+                x = (np.arange(n) % 7).astype(np.int32)
+                want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+                cli.next_request_id = f"chaos-{self.seed}-{tid}-{seq}"
+                try:
+                    out = serve_client.dispatch_with_backpressure(
+                        cli, "scan", (x,), {}, jitter=rng)
+                except Exception as e:
+                    with self._lock:
+                        self.failures.append(
+                            (cli.next_request_id
+                             or f"chaos-{self.seed}-{tid}-{seq}",
+                             repr(e)))
+                    return  # one drop already fails the campaign
+                if not np.array_equal(out, want):
+                    with self._lock:
+                        self.failures.append(
+                            (cli.last_request_id, "WRONG RESULT"))
+                    return
+                with self._lock:
+                    self.ok += 1
+                time.sleep(0.05 + rng.random() * 0.1)
+
+
+# ------------------------------------------------------------------ #
+# events                                                             #
+# ------------------------------------------------------------------ #
+
+
+def _kill_from_pidfile(pidfile: str, what: str) -> int:
+    held, pid = serve_health.pidfile_state(pidfile)
+    if not held or pid is None:
+        raise CampaignFailure(
+            f"cannot kill {what}: pidfile {pidfile} not live-flocked")
+    os.kill(pid, signal.SIGKILL)
+    return pid
+
+
+def _do_kill_router(rng, counts):
+    before = sum(1 for e in _journal_events()
+                 if e.get("kind") == "router_respawned")
+    pid = _kill_from_pidfile(serve_fleet.router_pidfile_path(),
+                             "router")
+    _wait_for(
+        lambda: sum(1 for e in _journal_events()
+                    if e.get("kind") == "router_respawned") > before,
+        RECOVER_WAIT_S, "router_respawned after kill_router")
+    return {"killed_pid": pid}
+
+
+def _do_kill_worker(rng, counts):
+    cfg = serve_fleet.load_config() or {}
+    idx = rng.randrange(len(cfg.get("workers") or [1]))
+    before = sum(1 for e in _journal_events()
+                 if e.get("kind") == "worker_respawned"
+                 and e.get("worker") == idx)
+    pid = _kill_from_pidfile(
+        os.path.join(serve_fleet.worker_dir(idx), "serve.pid"),
+        f"worker{idx}")
+    _wait_for(
+        lambda: sum(1 for e in _journal_events()
+                    if e.get("kind") == "worker_respawned"
+                    and e.get("worker") == idx) > before,
+        RECOVER_WAIT_S, f"worker_respawned({idx}) after kill_worker")
+    return {"worker": idx, "killed_pid": pid}
+
+
+def _do_torn_write(rng, counts):
+    """Tear a persisted artifact IN PLACE (the pre-atomic crash
+    shape: half a valid JSON payload, no closing brace) and assert
+    the next reader rejects it loudly instead of trusting it."""
+    path = _cachedir.tuning_cache_path()
+    payload = json.dumps(
+        {"scan": {"torn-probe": {"best": {"knob": 1}}}}, indent=1)
+    with open(path, "w") as f:
+        f.write(payload[: len(payload) // 2])
+    before = sum(1 for e in _journal_events()
+                 if e.get("kind") == "artifact_rejected")
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "from tpukernels.tuning import cache; "
+         "print(sorted(cache._load(cache.path())))"],
+        cwd=_REPO, capture_output=True, text=True,
+    )
+    if probe.returncode != 0:
+        raise CampaignFailure(
+            f"torn-artifact reader crashed: {probe.stderr}")
+    if "torn artifact rejected" not in probe.stderr:
+        raise CampaignFailure(
+            "torn tuning.json read silently (no stderr rejection)")
+    _wait_for(
+        lambda: sum(1 for e in _journal_events()
+                    if e.get("kind") == "artifact_rejected") > before,
+        10.0, "artifact_rejected after torn_write")
+    os.unlink(path)  # rebuildable cache: clean slate, like a reaper
+    return {"path": path}
+
+
+def _wedge_armed(once_file: str):
+    """wedge_dispatch is armed via TPK_FAULT_PLAN at fleet start; the
+    'event' is simply observing that it FIRED (once_file exists) and
+    the watchdog abandoned + requeued around it."""
+    def check(rng, counts):
+        _wait_for(lambda: os.path.exists(once_file),
+                  RECOVER_WAIT_S, "armed wedge_dispatch to fire")
+        _wait_for(
+            lambda: any(e.get("kind") == "serve_request_requeued"
+                        for e in _journal_events()),
+            RECOVER_WAIT_S, "serve_request_requeued after wedge")
+        return {"once_file": once_file}
+    return check
+
+
+# ------------------------------------------------------------------ #
+# invariants                                                         #
+# ------------------------------------------------------------------ #
+
+
+def _assert_converged():
+    r = _ctl("health", "--wait", str(int(RECOVER_WAIT_S)))
+    if r.returncode != 0:
+        raise CampaignFailure(
+            f"fleet did not converge: {r.stdout}{r.stderr}")
+
+
+def _assert_artifacts_readable():
+    """Every persisted artifact either parses or is absent — a torn
+    file SURVIVING an event is an atomic-write regression."""
+    paths = [serve_fleet.config_path(),
+             _cachedir.tuning_cache_path(),
+             _cachedir.aot_manifest_path()]
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            try:
+                json.load(f)
+            except ValueError as e:
+                raise CampaignFailure(
+                    f"artifact {p} is torn after recovery: {e}")
+
+
+def _assert_no_leaks(n_workers: int):
+    leaked = [f for f in os.listdir(serve_protocol.SHM_DIR)
+              if serve_protocol._SHM_NAME_RE.match(f)]
+    if leaked:
+        raise CampaignFailure(f"leaked shm segments: {leaked}")
+    pidfiles = [serve_fleet.guardian_pidfile_path(),
+                serve_fleet.router_pidfile_path()] + [
+        os.path.join(serve_fleet.worker_dir(i), "serve.pid")
+        for i in range(n_workers)]
+    for p in pidfiles:
+        held, pid = serve_health.pidfile_state(p)
+        if held:
+            raise CampaignFailure(
+                f"leaked process: pid {pid} still flocks {p}")
+
+
+# ------------------------------------------------------------------ #
+# the campaign                                                       #
+# ------------------------------------------------------------------ #
+
+
+def run_campaign(seed: int, n_events: int, n_workers: int) -> int:
+    rng = random.Random(seed)
+    schedule = [EVENTS[rng.randrange(len(EVENTS))]
+                for _ in range(n_events)]
+    # at most one armed wedge per campaign: splice it over a
+    # non-router slot when the seed allows (plans load at import, so
+    # it must be decided before the fleet starts)
+    wedge_slot = None
+    for i, ev in enumerate(schedule):
+        if ev != "kill_router":
+            wedge_slot = i
+            break
+    once_file = os.path.join(serve_fleet.fleet_dir(), "wedge.once")
+    if wedge_slot is not None:
+        schedule[wedge_slot] = "wedge_dispatch"
+        os.makedirs(serve_fleet.fleet_dir(), exist_ok=True)
+        os.environ["TPK_FAULT_PLAN"] = json.dumps({
+            "wedge_dispatch": {"kernel": "scan", "times": 1,
+                               "once_file": once_file,
+                               "env": {"TPK_SERVE_WORKER_ID": "0"}},
+        })
+    print(f"# chaos: seed {seed}, schedule: {', '.join(schedule)}",
+          file=sys.stderr)
+
+    # compress the worker watchdog: a wedged request is abandoned at
+    # ~3x this (1.5x grace, doubled once by the slow-verdict
+    # extension — the CPU backend stays live under a thread wedge),
+    # and the production default would outrun RECOVER_WAIT_S
+    os.environ.setdefault("TPK_SERVE_REQUEST_TIMEOUT_S", "10")
+    # the load clients must outlast a router death end-to-end:
+    # detect (flock probe) + backoff + respawn + smoke-gated rejoin
+    # routinely beats the 5 s default reconnect budget
+    os.environ.setdefault("TPK_CLIENT_RECONNECT_S", "60")
+    # the campaign's evidence IS the journal: with routing unset,
+    # emits are no-ops fleet-wide and every wait below starves
+    os.makedirs(serve_fleet.fleet_dir(), exist_ok=True)
+    os.environ.setdefault(
+        "TPK_HEALTH_JOURNAL",
+        os.path.join(serve_fleet.fleet_dir(), "chaos_journal.jsonl"))
+    r = _ctl("start-fleet", str(n_workers), "--wait", "120")
+    if r.returncode != 0:
+        print(f"chaos: start-fleet failed: {r.stdout}{r.stderr}",
+              file=sys.stderr)
+        return 1
+    r = _ctl("guardian", "--wait", "30")
+    if r.returncode != 0:
+        print(f"chaos: guardian failed: {r.stdout}{r.stderr}",
+              file=sys.stderr)
+        _ctl("stop-fleet", "--wait", "60")
+        return 1
+
+    front = (serve_fleet.load_config() or {}).get("front")
+    load = _Load(front, seed)
+    handlers = {"kill_router": _do_kill_router,
+                "kill_worker": _do_kill_worker,
+                "torn_write": _do_torn_write,
+                "wedge_dispatch": _wedge_armed(once_file)}
+    rc = 0
+    try:
+        load.start()
+        time.sleep(1.0)  # traffic flowing before the first fault
+        counts: dict = {}
+        for i, ev in enumerate(schedule):
+            print(f"# chaos: event {i + 1}/{len(schedule)}: {ev}",
+                  file=sys.stderr)
+            detail = handlers[ev](rng, counts)
+            _assert_converged()
+            _assert_artifacts_readable()
+            if load.failures:
+                raise CampaignFailure(
+                    f"client drops after {ev}: {load.failures}")
+            journal.emit("chaos_event", event=ev, seq=i + 1,
+                         of=len(schedule), seed=seed, **detail)
+            time.sleep(0.5 + rng.random())  # settle, seeded
+    except CampaignFailure as e:
+        print(f"chaos: INVARIANT VIOLATED: {e}", file=sys.stderr)
+        rc = 1
+    finally:
+        load.stop()
+        stop = _ctl("stop-fleet", "--wait", "60")
+        if stop.returncode != 0 and rc == 0:
+            print(f"chaos: teardown failed: {stop.stdout}"
+                  f"{stop.stderr}", file=sys.stderr)
+            rc = 1
+
+    if load.failures and rc == 0:
+        print(f"chaos: client drops: {load.failures}", file=sys.stderr)
+        rc = 1
+    try:
+        _assert_no_leaks(n_workers)
+    except CampaignFailure as e:
+        print(f"chaos: {e}", file=sys.stderr)
+        rc = 1
+    check = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools",
+                                      "obs_report.py"), "--check"],
+        cwd=_REPO, capture_output=True, text=True,
+    )
+    if check.returncode != 0:
+        print(f"chaos: obs_report --check failed:\n{check.stdout}"
+              f"{check.stderr}", file=sys.stderr)
+        rc = 1
+    verdict = "SURVIVED" if rc == 0 else "FAILED"
+    print(f"chaos: campaign {verdict} - seed {seed}, "
+          f"{len(schedule)} event(s), {load.ok} request(s) ok, "
+          f"{len(load.failures)} dropped")
+    return rc
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    seed, n_events, n_workers = 0, 6, 2
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--seed":
+                seed = int(next(it))
+            elif a == "--events":
+                n_events = int(next(it))
+            elif a == "--workers":
+                n_workers = int(next(it))
+            elif a in ("-h", "--help"):
+                print(__doc__, file=sys.stderr)
+                return 0
+            else:
+                print(__doc__, file=sys.stderr)
+                print(f"chaos: unknown argument {a!r}",
+                      file=sys.stderr)
+                return 2
+    except (StopIteration, ValueError):
+        print(f"chaos: {a} needs an integer value", file=sys.stderr)
+        return 2
+    if n_events < 1 or n_workers < 2:
+        print("chaos: need --events >= 1 and --workers >= 2 (ring "
+              "failover requires a sibling)", file=sys.stderr)
+        return 2
+    return run_campaign(seed, n_events, n_workers)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -359,10 +359,54 @@ def _fmt(ev):
                 f"(threshold {ev.get('threshold')}) - left out of "
                 "the ring; `serve_ctl undrain` resets")
     if kind == "serve_request_replayed":
+        if ev.get("via") == "wal":
+            if ev.get("ok") is False:
+                return (f"{ts} [pid {pid}] WAL replay SKIPPED "
+                        f"request "
+                        f"{ev.get('request_id') or ev.get('request')}"
+                        f" ({ev.get('reason')}) - the client's "
+                        "reconnect retry owns it")
+            return (f"{ts} [pid {pid}] REPLAYED {ev.get('kernel')} "
+                    f"request "
+                    f"{ev.get('request_id') or ev.get('request')} "
+                    f"from the dead router's WAL -> worker "
+                    f"{ev.get('to_worker')}")
         return (f"{ts} [pid {pid}] REPLAYED {ev.get('kernel')} "
                 f"request {ev.get('request_id') or ev.get('request')}"
                 f" off dead worker {ev.get('from_worker')} -> "
                 f"{ev.get('to_worker')}")
+    if kind == "router_dead":
+        return (f"{ts} [pid {pid}] fleet ROUTER DEAD "
+                f"({ev.get('via')}, crash {ev.get('crashes')}"
+                + (f", pid {ev.get('router_pid')}"
+                   if ev.get("router_pid") else "")
+                + f") - guardian respawns in {ev.get('backoff_s')}s"
+                + (f"; swept {ev.get('swept_segments')} shm "
+                   f"segment(s) / {ev.get('swept_bytes')}B"
+                   if ev.get("swept_segments") else ""))
+    if kind == "router_respawned":
+        return (f"{ts} [pid {pid}] fleet ROUTER RESPAWNED by its "
+                f"guardian (pid {ev.get('router_pid')}, restart "
+                f"{ev.get('restarts')}, down {ev.get('down_s')}s)")
+    if kind == "router_quarantined":
+        return (f"{ts} [pid {pid}] fleet ROUTER QUARANTINED after "
+                f"{ev.get('crashes')} crash(es) (threshold "
+                f"{ev.get('threshold')}) - guardian stopped "
+                "respawning; `serve_ctl start-fleet` resets")
+    if kind == "fleet_fsck":
+        return (f"{ts} [pid {pid}] fsck reaped "
+                f"{ev.get('stale_pidfiles')} stale pidfile(s), "
+                f"{ev.get('swept_segments')} orphaned shm "
+                f"segment(s), {ev.get('torn_configs')} torn "
+                "config(s)")
+    if kind == "chaos_event":
+        return (f"{ts} [pid {pid}] CHAOS event {ev.get('seq')}/"
+                f"{ev.get('of')}: {ev.get('event')} (seed "
+                f"{ev.get('seed')}) - invariants held")
+    if kind == "artifact_rejected":
+        return (f"{ts} [pid {pid}] TORN artifact rejected: "
+                f"{ev.get('path')} ({ev.get('reason')}) - reader "
+                "fell back to empty state")
     if kind == "fleet_degraded":
         lvl = str(ev.get("level", "?")).upper()
         if ev.get("level") == "ok":
@@ -691,7 +735,13 @@ def summarize(events, bad=0) -> str:
         f"{counts.get('worker_quarantined', 0)} quarantined worker(s), "
         f"{counts.get('serve_request_replayed', 0)} replayed "
         "request(s), "
-        f"{counts.get('fleet_degraded', 0)} degradation change(s)"
+        f"{counts.get('fleet_degraded', 0)} degradation change(s), "
+        f"{counts.get('router_dead', 0)} router death(s), "
+        f"{counts.get('router_respawned', 0)} router restart(s), "
+        f"{counts.get('router_quarantined', 0)} router quarantine(s), "
+        f"{counts.get('artifact_rejected', 0)} torn artifact(s), "
+        f"{counts.get('fleet_fsck', 0)} fsck run(s), "
+        f"{counts.get('chaos_event', 0)} chaos event(s)"
     )
     return "\n".join(out)
 

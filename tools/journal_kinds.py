@@ -24,6 +24,14 @@ kinds whose every production emit MUST pass a ``request_id=`` field —
 one untagged callsite is a hole in every future timeline, found only
 during the incident the tracing layer exists to shorten. Enforced
 here (rc 1) and therefore in tier-1 via the same test.
+
+Fault-key lint (docs/RESILIENCE.md §fault injection): every plan key
+``resilience/faults.py`` consumes (its literal ``_PLAN.get("...")``
+lookups) must have a ``| `key` |`` row in docs/RESILIENCE.md's fault
+table — the table is the chaos vocabulary operators and campaign
+runners (``tools/chaos.py``) compose from, so an undocumented key is
+an injection point nobody can discover. Same rc 1 / tier-1
+enforcement.
 """
 
 from __future__ import annotations
@@ -163,6 +171,41 @@ def documented_kinds(doc=_DOC):
         return set()
 
 
+# literal plan-key lookups in the fault module; the few
+# loop-variable lookups iterate over literal tuples whose members are
+# also looked up (or documented) individually
+_FAULT_KEY_RE = re.compile(r"_PLAN\.get\(\s*[\"'](\w+)[\"']")
+
+
+def fault_plan_keys(repo=_REPO):
+    """Plan keys resilience/faults.py consumes (empty when the module
+    is absent — the mini-repo test fixtures)."""
+    path = os.path.join(repo, "tpukernels", "resilience", "faults.py")
+    try:
+        with open(path) as f:
+            return sorted(set(_FAULT_KEY_RE.findall(f.read())))
+    except OSError:
+        return []
+
+
+def undocumented_fault_keys(repo=_REPO):
+    """Fault plan keys with no ``| `key` |`` row in the
+    docs/RESILIENCE.md fault table."""
+    doc = os.path.join(repo, "docs", "RESILIENCE.md")
+    documented = set()
+    try:
+        with open(doc) as f:
+            for line in f:
+                # the row's FIRST cell may name several keys that
+                # share one contract (| `fail_capi` / `wedge_capi` |)
+                m = re.match(r"\|([^|]*)\|", line)
+                if m:
+                    documented.update(re.findall(r"`(\w+)`", m.group(1)))
+    except OSError:
+        pass
+    return [k for k in fault_plan_keys(repo) if k not in documented]
+
+
 def main(argv=None):
     repo = _REPO
     argv = sys.argv[1:] if argv is None else list(argv)
@@ -217,6 +260,15 @@ def main(argv=None):
             "carry the causal id)"
         )
         rc = 1
+    undoc_faults = undocumented_fault_keys(repo)
+    for key in undoc_faults:
+        print(
+            f"journal_kinds: fault plan key {key!r} is consumed by "
+            "resilience/faults.py but has no row in the "
+            "docs/RESILIENCE.md fault table (the chaos vocabulary "
+            "contract)"
+        )
+        rc = 1
     unused = documented - set(kinds)
     for kind in sorted(unused):
         print(
@@ -228,7 +280,8 @@ def main(argv=None):
             f"journal_kinds: OK - {len(kinds)} kinds across "
             f"{sum(len(v) for v in kinds.values())} callsites, all "
             f"documented; {len(traced)} traced kind(s) all carry "
-            "request_id"
+            f"request_id; {len(fault_plan_keys(repo))} fault key(s) "
+            "all documented"
         )
     return rc
 

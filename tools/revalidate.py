@@ -184,6 +184,7 @@ fleet_log="docs/logs/fleet_probe_$(date +%Y-%m-%d_%H%M%S).log"
 fleet_probe_body() {
   env TPK_TRACE=1 python tools/serve_ctl.py start-fleet 2 \\
       --wait 60 || return $?
+  python tools/serve_ctl.py guardian --wait 30 || return $?
   front=$(python -c "from tpukernels.serve import fleet
 print(fleet.front_socket_path())")
   timeout -k 10 120 python tools/loadgen.py --serve "$front" \\
@@ -205,15 +206,30 @@ print(fleet.front_socket_path())")
 print(fleet.worker_dir(1))")/serve.pid")
   kill -9 "$w1pid"
   python tools/serve_ctl.py health --wait 90; rc_heal=$?
+  # router kill -> guardian respawn -> WAL replay, still mid-burst
+  # (docs/SERVING.md §guardian): the LAST single point of failure's
+  # recovery rehearsed under the same traffic; `status` rc 0 (router
+  # pidfile flocked again + front socket answering) is the gate
+  rpid=$(head -1 "$(python -c "from tpukernels.serve import fleet
+print(fleet.router_pidfile_path())")")
+  kill -9 "$rpid"
+  rc_heal2=1
+  for _i in $(seq 90); do
+    if python tools/serve_ctl.py status >/dev/null 2>&1; then
+      rc_heal2=0; break
+    fi
+    sleep 1
+  done
+  python tools/serve_ctl.py health --wait 90 || rc_heal2=1
   wait $lg_hot; rc_hot=$?
   wait $lg_steady; rc_steady=$?
   python tools/serve_ctl.py stop-fleet
   # the drain/undrain/heal rcs are part of the verdict: a probe that
-  # never actually rehearsed the rolling restart (or whose kill was
+  # never actually rehearsed the rolling restart (or whose kills were
   # never self-healed) must not report success
   [ $rc_hot -eq 0 ] && [ $rc_steady -eq 0 ] && \
     [ $rc_drain -eq 0 ] && [ $rc_undrain -eq 0 ] && \
-    [ $rc_heal -eq 0 ]
+    [ $rc_heal -eq 0 ] && [ $rc_heal2 -eq 0 ]
 }
 if fleet_probe_body >"$fleet_log" 2>&1; then
   tail -1 "$fleet_log"
@@ -221,7 +237,7 @@ else
   echo "WARN: fleet probe failed rc=$? (non-gating) - $fleet_log"
   exit 1
 fi
-""", gating=False, stamp="never", timeout_s=300, cost_min=3, value=9,
+""", gating=False, stamp="never", timeout_s=420, cost_min=3, value=9,
       after=("prewarm_all",),
       inputs=("tpukernels/serve", "tools/loadgen.py",
               "tools/serve_ctl.py")),
@@ -382,6 +398,16 @@ fi
       needs_chip=False,
       inputs=("tpukernels/resilience/integrity.py", "tpukernels/kernels",
               "tools/integrity_envelopes.py")),
+    # 3d. crash-residue janitor (docs/RESILIENCE.md §atomic state):
+    #     reap stale pidfiles, orphaned tpkserve-* shm segments and a
+    #     torn fleet.json left by crashed serving processes — counts
+    #     journaled as fleet_fsck. CPU-only, daily, non-gating: a
+    #     janitor, not a health check.
+    S("fleet_fsck", """
+python tools/serve_ctl.py fsck
+""", gating=False, stamp="daily", timeout_s=120, cost_min=1, value=2,
+      needs_chip=False,
+      inputs=("tpukernels/serve", "tools/serve_ctl.py")),
     # 4. sanitizer gates: CPU-only rebuild + full gate, then restore
     #    the normal build; last on purpose (lowest density).
 ]

@@ -10,6 +10,8 @@ Usage:
     python tools/serve_ctl.py drain I [--wait S]
     python tools/serve_ctl.py undrain I [--wait S]
     python tools/serve_ctl.py health [--wait S]
+    python tools/serve_ctl.py guardian [--wait S]
+    python tools/serve_ctl.py fsck
 
 Single daemon: ``start`` spawns ``python -m tpukernels.serve``
 detached and waits for a protocol ping; ``stop`` SIGTERMs the pid
@@ -41,11 +43,26 @@ ping probes per worker otherwise) until every ring member is live or
 ``--wait`` expires — the converged-fleet gate chaos probes and the
 supervisor's ``fleet_probe`` kill-and-recover phase wait on.
 
+``guardian`` (docs/SERVING.md §guardian) spawns the router's
+supervisor detached — the process that closes the fleet's LAST
+single point of failure by respawning a crashed router on its
+original front socket (``tpukernels/serve/guardian.py``) — and waits
+for it to hold its pidfile flock. ``stop-fleet`` stops the guardian
+FIRST: stopped any later it would read the intentional router stop
+as a crash and respawn it mid-teardown.
+
+``fsck`` (docs/RESILIENCE.md §atomic state) reaps what crashes leave
+behind: pidfiles whose flock nothing holds, ``tpkserve-*`` shm
+segments whose creator pid is dead, and a fleet.json that no longer
+parses (torn by a mid-write crash on a pre-atomic writer). Counts
+are journaled as ``fleet_fsck`` and printed; always exits 0 — it is
+a janitor, not a health check (``health`` is the health check).
+
 Exit codes: 0 — done (``status``: up; ``health``: all workers
 live); 1 — failed (``status``: down; ``health``: a worker is
 dead/quarantined past the wait); 2 — usage error; 3 —
-``start``/``start-fleet`` refused because a live daemon/router
-already holds the pidfile.
+``start``/``start-fleet``/``guardian`` refused because a live
+daemon/router/guardian already holds the pidfile.
 """
 
 from __future__ import annotations
@@ -278,8 +295,16 @@ def _abort_fleet(procs):
 
 def stop_fleet(wait_s: float) -> int:
     cfg = serve_fleet.load_config()
-    rc = _stop_pidfile(serve_fleet.router_pidfile_path(), "router",
-                       wait_s)
+    # the guardian FIRST (docs/SERVING.md §guardian): stopped any
+    # later it would read the intentional router stop as a crash and
+    # respawn the router mid-teardown
+    rc = 0
+    gpidfile = serve_fleet.guardian_pidfile_path()
+    if os.path.exists(gpidfile):
+        rc = _stop_pidfile(gpidfile, "guardian", wait_s)
+    rrc = _stop_pidfile(serve_fleet.router_pidfile_path(), "router",
+                        wait_s)
+    rc = rc or rrc
     workers = (cfg or {}).get("workers") or []
     for i, _sock in enumerate(workers):
         wrc = _stop_pidfile(
@@ -493,10 +518,99 @@ def health(wait_s: float) -> int:
     return rc
 
 
+def guardian(wait_s: float) -> int:
+    """Spawn the router's guardian detached and wait for its pidfile
+    flock (docs/SERVING.md §guardian)."""
+    if not serve_fleet.load_config():
+        print("serve_ctl: no fleet.json - start a fleet first",
+              file=sys.stderr)
+        return 1
+    gpidfile = serve_fleet.guardian_pidfile_path()
+    held, pid = _pidfile_state(gpidfile)
+    if held:
+        print(f"serve_ctl: guardian already running (pid {pid})")
+        return 3
+    proc = serve_fleet.spawn_guardian(_REPO)
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            print(f"serve_ctl: guardian exited rc={proc.returncode} "
+                  f"before flocking - see guardian.log under "
+                  f"{serve_fleet.fleet_dir()}", file=sys.stderr)
+            return 1
+        held, _pid = _pidfile_state(gpidfile)
+        if held:
+            print(f"serve_ctl: guardian up (pid {proc.pid}) watching "
+                  f"{serve_fleet.router_pidfile_path()}")
+            return 0
+        time.sleep(0.2)
+    print(f"serve_ctl: guardian did not flock within {wait_s}s - "
+          "killing it", file=sys.stderr)
+    proc.terminate()
+    return 1
+
+
+def fsck() -> int:
+    """Reap crash residue (docs/RESILIENCE.md §atomic state): stale
+    pidfiles, orphaned shm segments, a torn fleet.json. Journaled as
+    ``fleet_fsck``; always 0 — a janitor, not a health check."""
+    from tpukernels.resilience import journal
+
+    stale_pidfiles = 0
+    pidfiles = [serve_fleet.guardian_pidfile_path(),
+                serve_fleet.router_pidfile_path(),
+                _cachedir.serve_pidfile_path()]
+    fleet_d = serve_fleet.fleet_dir()
+    try:
+        for entry in sorted(os.listdir(fleet_d)):
+            if entry.startswith("worker"):
+                pidfiles.append(os.path.join(fleet_d, entry,
+                                             "serve.pid"))
+    except OSError:
+        pass
+    for p in pidfiles:
+        if not os.path.exists(p):
+            continue
+        held, pid = _pidfile_state(p)
+        if held:
+            continue
+        try:
+            os.unlink(p)
+        except OSError:
+            continue
+        stale_pidfiles += 1
+        print(f"serve_ctl: fsck reaped stale pidfile {p}"
+              + (f" (dead pid {pid})" if pid else ""))
+    swept_segments = serve_protocol.sweep_stale_segments()
+    if swept_segments:
+        print(f"serve_ctl: fsck swept {swept_segments} orphaned shm "
+              "segment(s)")
+    torn_configs = 0
+    cfg_path = serve_fleet.config_path()
+    if os.path.exists(cfg_path) and serve_fleet.load_config() is None:
+        # present but unreadable/invalid: a mid-write crash on a
+        # pre-atomic writer tore it — reap it so start-fleet starts
+        # clean instead of every reader re-rejecting it
+        try:
+            os.unlink(cfg_path)
+            torn_configs += 1
+            print(f"serve_ctl: fsck reaped torn {cfg_path}")
+        except OSError:
+            pass
+    journal.emit(
+        "fleet_fsck", stale_pidfiles=stale_pidfiles,
+        swept_segments=swept_segments, torn_configs=torn_configs,
+    )
+    print(f"serve_ctl: fsck done - {stale_pidfiles} stale "
+          f"pidfile(s), {swept_segments} orphaned segment(s), "
+          f"{torn_configs} torn config(s)")
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     verbs = ("start", "stop", "status", "start-fleet", "stop-fleet",
-             "drain", "undrain", "health")
+             "drain", "undrain", "health", "guardian", "fsck")
     if not argv or argv[0] not in verbs:
         print(__doc__, file=sys.stderr)
         return 2
@@ -545,6 +659,10 @@ def main(argv=None):
         return undrain(count, wait_s)
     if cmd == "health":
         return health(wait_s)
+    if cmd == "guardian":
+        return guardian(wait_s)
+    if cmd == "fsck":
+        return fsck()
     return status(socket_path)
 
 

@@ -87,7 +87,12 @@ def read_json_memoized(path: str, memo: dict) -> dict:
     caller's own ``{path: (stat_key, parsed)}`` dict (per-module so
     ``reset()``/test isolation stays local). Returns {} on
     absent/corrupt/non-dict — unreadable state degrades to cold
-    behavior, never raises."""
+    behavior, never raises. Degrading is NOT silent: a file that
+    EXISTS but does not parse (a torn write from a crash predating
+    resilience/atomic.py, a half-copied checkout) is journaled once
+    per process as ``artifact_rejected`` — the rebuild must be
+    reconstructable from the health log, not a mystery cache miss
+    (docs/RESILIENCE.md §atomic state)."""
     import json
 
     try:
@@ -101,12 +106,39 @@ def read_json_memoized(path: str, memo: dict) -> dict:
     try:
         with open(path) as f:
             data = json.load(f)
-    except (OSError, ValueError):
+    except OSError:
+        return {}
+    except ValueError as e:
+        note_torn_artifact(path, str(e))
         data = {}
     if not isinstance(data, dict):
         data = {}
     memo[path] = (stat_key, data)
     return data
+
+
+_TORN_NOTED: set = set()  # paths already journaled this process
+
+
+def note_torn_artifact(path: str, reason: str):
+    """Loud-rejection hook for a persisted artifact that exists but
+    does not parse: stderr note + ``artifact_rejected`` journal event,
+    once per path per process (a hot reader re-hitting the same torn
+    file shows up once, not as log spam). Best-effort — observability
+    must never take down the read it observes."""
+    if path in _TORN_NOTED:
+        return
+    _TORN_NOTED.add(path)
+    try:
+        import sys
+
+        from tpukernels.resilience import journal
+
+        print(f"# torn artifact rejected: {path} ({reason})",
+              file=sys.stderr)
+        journal.emit("artifact_rejected", path=path, reason=reason)
+    except Exception:
+        pass
 
 
 def locked_json_update(path: str, mutate, load=None) -> dict:
@@ -135,15 +167,20 @@ def locked_json_update(path: str, mutate, load=None) -> dict:
             try:
                 with open(path) as f:
                     data = json.load(f)
-            except (OSError, ValueError):
+            except OSError:
+                data = {}
+            except ValueError as e:
+                note_torn_artifact(path, str(e))
                 data = {}
         if not isinstance(data, dict):
             data = {}
         mutate(data)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(data, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)
+        # crash-consistent write step (fsync before AND after the
+        # rename): the flock above owns lost-update protection, this
+        # owns torn-file protection — docs/RESILIENCE.md §atomic state
+        from tpukernels.resilience import atomic
+
+        atomic.dump_json(path, data)
     return data
 
 

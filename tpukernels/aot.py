@@ -305,8 +305,8 @@ def _record(key: str, sources, lower_s: float, compile_s: float):
     """Atomically upsert one manifest entry (flock-serialized
     read-modify-write, same discipline as tuning.cache.put)."""
     import fcntl
-    import json
 
+    from tpukernels.resilience import atomic
     from tpukernels.tuning import cache as tcache
 
     p = manifest_path()
@@ -328,10 +328,8 @@ def _record(key: str, sources, lower_s: float, compile_s: float):
         _MANIFEST_MEMO.pop(p, None)
         data = _load_manifest(p)
         data.setdefault("entries", {})[key] = entry
-        tmp = f"{p}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(data, f, indent=1, sort_keys=True)
-        os.replace(tmp, p)
+        # fsync'd tmp+rename (docs/RESILIENCE.md §atomic state)
+        atomic.dump_json(p, data)
     _MANIFEST_MEMO.pop(p, None)
     return entry
 

@@ -195,14 +195,34 @@ def assemble(events) -> dict:
         elif kind == "serve_request_replayed":
             t = tl(rid)
             t["replayed"] = True
-            t["gaps"].append({
-                "kind": "dead-worker", "pid": ev.get("pid"),
-                "t": ev.get("t"),
-                "detail": (f"worker {ev.get('from_worker')} died "
-                           "holding this request; replayed on worker "
-                           f"{ev.get('to_worker')} — the home "
-                           "attempt's evidence died with it"),
-            })
+            if ev.get("via") == "wal":
+                # the ROUTER died holding this accepted request; a
+                # respawned router replayed it from its WAL
+                # (docs/SERVING.md §guardian)
+                t["gaps"].append({
+                    "kind": "dead-router", "pid": ev.get("pid"),
+                    "t": ev.get("t"),
+                    "detail": ("the router died holding this "
+                               "accepted request; "
+                               + (f"replayed from its WAL on worker "
+                                  f"{ev.get('to_worker')}"
+                                  if ev.get("ok") is not False else
+                                  "its WAL replay skipped it "
+                                  f"({ev.get('reason')}) and the "
+                                  "client retried")
+                               + " — the first attempt's evidence "
+                               "died with the router"),
+                })
+            else:
+                t["gaps"].append({
+                    "kind": "dead-worker", "pid": ev.get("pid"),
+                    "t": ev.get("t"),
+                    "detail": (f"worker {ev.get('from_worker')} died "
+                               "holding this request; replayed on "
+                               f"worker {ev.get('to_worker')} — the "
+                               "home attempt's evidence died with "
+                               "it"),
+                })
         elif kind == "serve_request_requeued":
             t = tl(rid)
             t["requeued"] = True

@@ -1,0 +1,90 @@
+"""Crash-consistent file writes for the persisted JSON artifacts
+(docs/RESILIENCE.md §atomic state).
+
+Every validated artifact the repo persists — ``fleet.json``,
+``tuning.json``, ``aot.json``, ``integrity.json``,
+``integrity_quarantine.json``, ``slo.json``, the revalidate stamps —
+was written tmp + ``os.replace``: atomic against CONCURRENT readers,
+but not against a crash. ``os.replace`` only promises the directory
+entry flips atomically; without an ``fsync`` of the data first, a
+power cut (or a SIGKILL racing the page cache on some filesystems)
+can leave the NEW name pointing at truncated or empty data. A fleet
+that self-heals worker and router death (docs/SERVING.md) cannot
+afford its config of record tearing under the same crash it is busy
+surviving.
+
+:func:`write_text`/:func:`dump_json` close the gap with the full
+sequence — write tmp in the same directory, flush, ``fsync(fd)``,
+``os.replace``, ``fsync(dir)`` — so a reader sees the old bytes or
+the new bytes, never a torn file. The helpers are flock-compatible
+(callers like ``_cachedir.locked_json_update`` keep their own
+``.lock`` file serialization around the read-modify-write; this owns
+only the write step) and stdlib-only, importable from the bottom of
+the dependency stack (``tpukernels/_cachedir.py`` pulls it lazily,
+inside the function, preserving its jax-free import contract).
+
+The ``torn_write`` fault key (``tpukernels/resilience/faults.py``)
+injects the crash this module defends against: a matching write
+leaves a HALF-written tmp file and aborts before the rename — the
+target must still read as the old state. ``tools/chaos.py`` fires it
+against a live artifact; the per-artifact-family tests prove the
+old-or-new contract in-process.
+"""
+
+from __future__ import annotations
+
+import os
+
+# written-but-unrenamed tmp suffix; fsck and humans can recognize and
+# reap leftovers from a crash (or an injected torn_write) mid-write
+TMP_SUFFIX_FMT = ".tmp.{pid}"
+
+
+def _fsync_dir(path: str):
+    """Persist the directory entry itself (the rename) — best effort:
+    some filesystems refuse O_RDONLY dir fsync; the data fsync already
+    happened, so degrading here loses only the rename's durability."""
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_text(path: str, text: str):
+    """Crash-consistent whole-file write: after this returns, ``path``
+    holds ``text``; if the process dies at ANY point inside, ``path``
+    holds whatever it held before. Raises OSError on write trouble."""
+    from tpukernels.resilience import faults  # lazy: no import cycle
+
+    tmp = path + TMP_SUFFIX_FMT.format(pid=os.getpid())
+    data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+    spec = faults.torn_write_fault(path)
+    if spec is not None:
+        faults.apply_torn_write(spec, path, tmp, data)
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    _fsync_dir(path)
+
+
+def dump_json(path: str, obj, indent=1, sort_keys=True,
+              trailing_newline=False):
+    """The artifact writers' shared serialization + crash-consistent
+    write (json is imported lazily — same reason as faults above)."""
+    import json
+
+    text = json.dumps(obj, indent=indent, sort_keys=sort_keys)
+    if trailing_newline:
+        text += "\n"
+    write_text(path, text)

@@ -91,6 +91,28 @@ contract and examples):
   detect → journal → quarantine chaos proof (docs/RESILIENCE.md
   §output integrity).
 
+- ``"kill_router": {"on_call": 2}`` — the fleet ROUTER SIGKILLs
+  itself on its ``on_call``-th accepted dispatch (default 1), AFTER
+  the request's ``router.wal`` entry is durable and BEFORE it is
+  forwarded: the router-death chaos proof — the guardian
+  (``tpukernels/serve/guardian.py``) must detect the freed pidfile
+  flock, sweep, respawn, and the respawned router must replay the
+  journaled request (docs/SERVING.md §guardian). ``once_file`` works
+  as for ``kill_worker`` (one-shot across respawns); the same
+  ``"env"`` clause narrows. The injection point only exists in the
+  router process, so a fleet-wide plan is already router-scoped.
+- ``"torn_write": {"path_substr": "tuning.json", "on_call": 1}`` — a
+  matching ``resilience/atomic.py`` write aborts MID-WRITE: half the
+  payload lands in the tmp file, the rename never happens, and the
+  process either raises (``"mode": "raise"``, the default — the
+  in-process test shape) or SIGKILLs itself (``"mode": "kill"`` — the
+  chaos-campaign crash shape). The target artifact must still read as
+  the OLD state: the crash-consistency proof for every persisted
+  JSON artifact (docs/RESILIENCE.md §atomic state). ``path_substr``
+  omitted matches any atomic write; ``on_call`` counts matching
+  writes per process (default 1); ``once_file`` and ``"env"`` narrow
+  as for ``kill_worker``.
+
 Fault state (probe script position, current metric) is per-process;
 plans reach bench's ``--one`` children through env inheritance. Every
 fired fault emits a ``fault_injected`` journal event so chaos runs are
@@ -130,6 +152,8 @@ _CURRENT_METRIC = None  # set by bench's --one/--prewarm child entry
 _DISPATCH_CALLS: dict = {}  # kernel -> dispatches seen (slow_dispatch)
 _WEDGE_CALLS: dict = {}     # kernel -> dispatches seen (wedge_dispatch)
 _KILL_CALLS: dict = {}      # kernel -> dispatches seen (kill_worker)
+_ROUTE_CALLS = 0            # router admissions seen (kill_router)
+_TORN_CALLS = 0             # matching atomic writes seen (torn_write)
 
 
 def active() -> bool:
@@ -139,13 +163,15 @@ def active() -> bool:
 def reload_plan():
     """Re-read TPK_FAULT_PLAN (tests flip the env mid-process; real
     runs load once at import). Resets per-process fault state."""
-    global _PLAN, _PROBE_IDX, _CURRENT_METRIC
+    global _PLAN, _PROBE_IDX, _CURRENT_METRIC, _ROUTE_CALLS, _TORN_CALLS
     _PLAN = _load_plan()
     _PROBE_IDX = 0
     _CURRENT_METRIC = None
     _DISPATCH_CALLS.clear()
     _WEDGE_CALLS.clear()
     _KILL_CALLS.clear()
+    _ROUTE_CALLS = 0
+    _TORN_CALLS = 0
     return _PLAN
 
 
@@ -317,7 +343,16 @@ def dispatch_fault(kernel: str):
         ):
             n = _WEDGE_CALLS[kernel] = _WEDGE_CALLS.get(kernel, 0) + 1
             times = int(wspec.get("times", 1))
-            if times <= 0 or n <= times:
+            once = wspec.get("once_file")
+            if (times <= 0 or n <= times) and not (
+                    once and os.path.exists(once)):
+                if once:
+                    # mark BEFORE wedging: the thread never returns,
+                    # and a respawned worker (fresh counters) must
+                    # not re-arm — the one-shot contract spans
+                    # processes, same as kill_worker's
+                    with open(once, "w") as f:
+                        f.write(f"{os.getpid()}\n")
                 journal.emit(
                     "fault_injected", site="dispatch", kernel=kernel,
                     fault="wedge_dispatch", call=n,
@@ -346,6 +381,99 @@ def dispatch_fault(kernel: str):
         fault="slow_dispatch", delay_s=delay, call=n,
     )
     time.sleep(delay)
+
+
+def _env_match(spec: dict) -> bool:
+    want_env = spec.get("env")
+    return not (want_env and any(
+        os.environ.get(k) != v for k, v in want_env.items()
+    ))
+
+
+def router_fault():
+    """Injection point for the fleet router's accept path
+    (``router._route``, AFTER the request's ``router.wal`` entry is
+    durable, BEFORE the forward): ``kill_router`` SIGKILLs the router
+    on its ``on_call``-th accepted dispatch — the kill_worker kill
+    pattern (journal + stderr breadcrumb + SIGKILL self), ``once_file``
+    one-shot across respawns included."""
+    global _ROUTE_CALLS
+    if _PLAN is None:
+        return
+    spec = _PLAN.get("kill_router")
+    if not spec:
+        return
+    if not isinstance(spec, dict):
+        spec = {}
+    if not _env_match(spec):
+        return
+    _ROUTE_CALLS += 1
+    n = _ROUTE_CALLS
+    once = spec.get("once_file")
+    if n != int(spec.get("on_call", 1)) or (
+            once and os.path.exists(once)):
+        return
+    if once:
+        # mark BEFORE dying: the one-shot contract must hold even
+        # though nothing after the kill runs
+        with open(once, "w") as f:
+            f.write(f"{os.getpid()}\n")
+    journal.emit("fault_injected", site="route", fault="kill_router",
+                 call=n)
+    print(f"# fault: SIGKILL self mid-route (call {n})",
+          file=sys.stderr, flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def torn_write_fault(path: str):
+    """Decision half of the ``torn_write`` key: the matching spec for
+    this atomic write (``resilience/atomic.py`` applies it via
+    :func:`apply_torn_write`), or None. Split so the decision and its
+    counters live with every other plan key while the mechanics stay
+    with the write they corrupt."""
+    global _TORN_CALLS
+    if _PLAN is None:
+        return None
+    spec = _PLAN.get("torn_write")
+    if not spec:
+        return None
+    if isinstance(spec, str):
+        spec = {"path_substr": spec}
+    sub = spec.get("path_substr")
+    if sub and sub not in path:
+        return None
+    if not _env_match(spec):
+        return None
+    _TORN_CALLS += 1
+    n = _TORN_CALLS
+    once = spec.get("once_file")
+    if n != int(spec.get("on_call", 1)) or (
+            once and os.path.exists(once)):
+        return None
+    return dict(spec, _call=n)
+
+
+def apply_torn_write(spec: dict, path: str, tmp: str, data):
+    """Mechanics half of ``torn_write``: strand HALF the payload in
+    the tmp file (the torn bytes a real crash leaves), then abort
+    before the rename — ``"mode": "raise"`` (default) raises OSError
+    in-process, ``"mode": "kill"`` SIGKILLs self. Either way the
+    target artifact keeps its OLD bytes."""
+    once = spec.get("once_file")
+    if once:
+        with open(once, "w") as f:
+            f.write(f"{os.getpid()}\n")
+    with open(tmp, "wb") as f:
+        f.write(bytes(data)[: max(1, len(data) // 2)])
+        f.flush()
+    n = spec.get("_call")
+    journal.emit("fault_injected", site="atomic_write",
+                 fault="torn_write", path=path, call=n)
+    print(f"# fault: torn write mid-{os.path.basename(path)} "
+          f"(call {n})", file=sys.stderr, flush=True)
+    if spec.get("mode") == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise OSError(f"injected fault: torn_write on {path}")
 
 
 def output_fault(site: str, kernel):

@@ -204,13 +204,16 @@ def _stamp_path(name: str, repo=_REPO) -> str:
 
 def write_stamp(name: str, repo=_REPO):
     """Same format the shell lib writes: the stamp file holds the HEAD
-    sha (empty outside git), scoped to today by filename."""
+    sha (empty outside git), scoped to today by filename. Written
+    crash-consistently (docs/RESILIENCE.md §atomic state): a stamp
+    torn mid-write would read as a sha-less legacy stamp and skip the
+    step wall-clock-only — a silent staleness hole."""
+    from tpukernels.resilience import atomic
+
     p = _stamp_path(name, repo)
     os.makedirs(os.path.dirname(p), exist_ok=True)
     sha = journal.git_head(repo) or ""
-    with open(p, "w") as f:
-        if sha:
-            f.write(sha + "\n")
+    atomic.write_text(p, sha + "\n" if sha else "")
 
 
 def _commits_touching(since_sha: str, head: str, inputs, repo=_REPO):

@@ -42,6 +42,39 @@ from tpukernels.serve import protocol
 _RECONNECTABLE = (ConnectionResetError, BrokenPipeError,
                   protocol.ProtocolError)
 
+# the ROUTER-crash window (docs/SERVING.md §guardian): between a
+# router SIGKILL and its guardian-supervised respawn, connects are
+# REFUSED (the socket file outlives the process) or the path briefly
+# vanishes (the respawn re-binds). dispatch_with_backpressure absorbs
+# this whole window — refused connects AND repeated resets — under the
+# TPK_CLIENT_RECONNECT_S budget, with the same request_id throughout.
+_REFUSED = (ConnectionRefusedError, FileNotFoundError)
+_ABSORBABLE = _RECONNECTABLE + _REFUSED
+
+# seconds between reconnect attempts inside the budget window (scaled
+# 0.5x-1.5x by the caller's seeded jitter, same decorrelation story
+# as the rejection retries)
+_RECONNECT_STEP_S = 0.25
+
+
+def _reconnect_budget_s() -> float:
+    """``TPK_CLIENT_RECONNECT_S`` (docs/KNOBS.md): how long a client
+    keeps re-trying a dead front socket before the transport error
+    surfaces. 0 disables the window (only the single stale-connection
+    retry remains). Fail-loud parse, like every knob."""
+    raw = os.environ.get("TPK_CLIENT_RECONNECT_S")
+    if raw is None or not raw.strip():
+        return 5.0
+    try:
+        val = float(raw)
+    except ValueError:
+        val = -1.0
+    if val < 0.0:
+        raise ValueError(
+            f"TPK_CLIENT_RECONNECT_S={raw!r}: expected a number >= 0"
+        )
+    return val
+
 
 class ServeError(Exception):
     """The daemon answered, and the answer is a dispatch failure."""
@@ -90,8 +123,16 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
     connection, with the SAME request_id (the PR-13 one-id
     discipline: it is still one logical request). Kernels are pure,
     so the replay is safe even if the old daemon executed before
-    dying. A second transport failure — the daemon is actually gone —
-    propagates untouched."""
+    dying.
+
+    Beyond that single free retry, a ``TPK_CLIENT_RECONNECT_S``
+    budget (default 5 s) absorbs the ROUTER-crash window: refused
+    connects and repeated resets are re-tried on a short seeded-jitter
+    cadence — same request_id every attempt, so the respawned
+    router's WAL-replay stash recognizes the retry — until the budget
+    runs out, at which point the transport error surfaces as the hard
+    failure it is (no silent hang). ``TPK_CLIENT_RECONNECT_S=0``
+    disables the window."""
     # one LOGICAL request, one causal id: backpressure retries of the
     # same request must not mint fresh request_ids, or the timeline
     # assembler would see N unrelated one-hop requests instead of one
@@ -103,6 +144,7 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
             rid = cli.next_request_id = mint()
     tries = 0
     reconnected = False
+    deadline = None  # first transport failure starts the budget clock
     while True:
         try:
             return cli.dispatch(kernel, *args, **statics)
@@ -116,14 +158,25 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
             time.sleep(wait)
             if rid is not None:
                 cli.next_request_id = rid
-        except _RECONNECTABLE:
+        except _ABSORBABLE as e:
             # dispatch() already closed the poisoned socket; the next
-            # call reconnects to the (respawned) daemon on the same
-            # path. Once only — a daemon that is truly gone must
-            # surface as the transport error it is.
-            if reconnected:
-                raise
-            reconnected = True
+            # call reconnects on the same path
+            now = time.monotonic()
+            if deadline is None:
+                deadline = now + _reconnect_budget_s()
+            if isinstance(e, _RECONNECTABLE) and not reconnected:
+                # the stale-connection case: one immediate free retry
+                # (the respawned-daemon story above)
+                reconnected = True
+            else:
+                # the router-crash window: pace the reconnects until
+                # the budget is spent, then surface the hard error
+                if now >= deadline:
+                    raise
+                step = _RECONNECT_STEP_S
+                if jitter is not None:
+                    step *= 0.5 + jitter.random()
+                time.sleep(min(step, max(0.0, deadline - now)))
             if rid is not None:
                 cli.next_request_id = rid
 

@@ -67,6 +67,17 @@ def router_pidfile_path(env=None) -> str:
     return os.path.join(fleet_dir(env), "router.pid")
 
 
+def guardian_pidfile_path(env=None) -> str:
+    return os.path.join(fleet_dir(env), "guardian.pid")
+
+
+def wal_path(env=None) -> str:
+    """The router's durable-admission journal (``serve/wal.py``) —
+    beside fleet.json so a respawned router finds its predecessor's
+    replay debt."""
+    return os.path.join(fleet_dir(env), "router.wal")
+
+
 def worker_dir(i: int, env=None) -> str:
     return os.path.join(fleet_dir(env), f"worker{i}")
 
@@ -78,11 +89,16 @@ def worker_socket_path(i: int, env=None) -> str:
 def load_config():
     """The fleet.json config of record, or None when no fleet was
     started here. Tolerant read: a corrupt file reads as no fleet
-    (start-fleet rewrites it)."""
+    (start-fleet rewrites it, ``serve_ctl fsck`` reaps it) — but
+    LOUDLY: the config of record tearing is journaled, not a silent
+    "no fleet" (docs/RESILIENCE.md §atomic state)."""
     try:
         with open(config_path()) as f:
             cfg = json.load(f)
-    except (OSError, ValueError):
+    except OSError:
+        return None
+    except ValueError as e:
+        _cachedir.note_torn_artifact(config_path(), str(e))
         return None
     if not isinstance(cfg, dict) or not cfg.get("workers"):
         return None
@@ -90,6 +106,8 @@ def load_config():
 
 
 def write_config(front: str, workers) -> dict:
+    from tpukernels.resilience import atomic
+
     cfg = {
         "front": front,
         "workers": list(workers),
@@ -98,10 +116,10 @@ def write_config(front: str, workers) -> dict:
     }
     d = fleet_dir()
     os.makedirs(d, exist_ok=True)
-    tmp = config_path() + f".tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(cfg, f, indent=1, sort_keys=True)
-    os.replace(tmp, config_path())
+    # fsync'd tmp+rename: the config of record is what a respawned
+    # router/guardian rebuilds the fleet view from — it must read as
+    # old-or-new across any crash (docs/RESILIENCE.md §atomic state)
+    atomic.dump_json(config_path(), cfg)
     return cfg
 
 
@@ -138,6 +156,25 @@ def spawn_worker(i: int, repo: str, d=None):
     finally:
         log.close()
     return proc, sock
+
+
+def spawn_guardian(repo: str):
+    """Spawn the router's guardian detached (docs/SERVING.md
+    §guardian): it supervises the router pidfile flock and respawns a
+    crashed router on the original front socket. Returns the Popen."""
+    d = fleet_dir()
+    os.makedirs(d, exist_ok=True)
+    log = open(os.path.join(d, "guardian.log"), "a")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpukernels.serve.guardian"],
+            cwd=repo, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=log,
+            env=_child_env(),
+        )
+    finally:
+        log.close()
+    return proc
 
 
 def spawn_router(front: str, worker_sockets, repo: str):

@@ -91,6 +91,7 @@ start-fleet N`` spawn workers + router together
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import os
 import socket
@@ -99,8 +100,9 @@ import threading
 import time
 
 from tpukernels.obs import metrics as obs_metrics
-from tpukernels.resilience import journal
+from tpukernels.resilience import faults, journal
 from tpukernels.serve import bucketing, protocol
+from tpukernels.serve import wal as serve_wal
 
 from tpukernels.serve.server import (  # the daemon's shared fail-loud
     DEFAULT_REQUEST_TIMEOUT_S,         # knob parser — one copy, not
@@ -117,6 +119,14 @@ PRIORITIES = ("interactive", "batch")
 # (need - tokens) / rate hint could tell a client to sleep for
 # minutes — backpressure is a pacing signal, not a ban
 MAX_RETRY_HINT_S = 5.0
+
+# durable-admission bound (docs/SERVING.md §guardian): inline request
+# payloads up to this many bytes ride into router.wal base64'd, so a
+# respawned router can replay the request self-contained. Bigger
+# requests (and shm-lane requests whose client already unlinked the
+# segments) are skipped LOUDLY at replay time — the client's reconnect
+# budget owns their retry.
+WAL_MAX_PAYLOAD_B = 262144
 
 
 def ring_order(bucket: str, n: int) -> list:
@@ -244,6 +254,15 @@ class Router:
         self._shm_min_cache = None
         self._req_trace_cache = None   # workers' request_trace pong
         self._bytes_copied = 0               # relayed inline payload B
+        # durable admission (docs/SERVING.md §guardian): accepted
+        # requests land in router.wal before the forward; a respawned
+        # router replays the unacked ones and STASHES their results so
+        # the client's same-request_id retry is answered from the
+        # stash — one delivery to the worker per request_id
+        self._wal = None                     # serve_wal.Wal, if attached
+        self._wal_seq = 0
+        self._stash: dict = {}  # request_id -> {resp,payloads,worker,t}
+        self._stash_ttl_s = req_t * 8 + 30   # same patience as the pools
         self._t0 = time.time()
         # fail-fast on a misconfigured bucket table, like the worker:
         # the router and its workers MUST shard on the same table
@@ -304,6 +323,14 @@ class Router:
                 pass
             for pool in self._pools:
                 pool.close_all()
+            # unclaimed replay results: free their response segments
+            # now — no client is coming for them through THIS process
+            with self._lock:
+                stash, self._stash = self._stash, {}
+            for hit in stash.values():
+                self._drop_stashed(hit)
+            if self._wal is not None:
+                self._wal.close()
             journal.emit(
                 "serve_stop", role="router", routed=self._routed,
                 spilled=self._spilled, throttled=self._throttled,
@@ -317,6 +344,162 @@ class Router:
 
     def attach_health(self, hm):
         self._health = hm
+
+    # -------------------------------------------------------------- #
+    # durable admission (docs/SERVING.md §guardian)                  #
+    # -------------------------------------------------------------- #
+
+    def attach_wal(self, w):
+        self._wal = w
+
+    def _wal_record(self, header, payloads, kernel, bucket):
+        """Persist one accepted request before its forward; returns
+        the WAL key (None when no WAL is attached). Inline payloads up
+        to WAL_MAX_PAYLOAD_B ride along base64'd so the replay is
+        self-contained; oversize ones record their size and are
+        skipped loudly at replay time."""
+        if self._wal is None:
+            return None
+        with self._lock:
+            self._wal_seq += 1
+            seq = self._wal_seq
+        key = f"{os.getpid()}-{seq}"
+        entry = {"header": dict(header), "kernel": kernel,
+                 "bucket": bucket}
+        total = sum(len(p) for p in payloads)
+        if total <= WAL_MAX_PAYLOAD_B:
+            entry["p64"] = [base64.b64encode(bytes(p)).decode("ascii")
+                            for p in payloads]
+        else:
+            entry["oversize_b"] = total
+        self._wal.append(key, entry)
+        return key
+
+    def replay_wal(self) -> int:
+        """Drain the PREVIOUS incarnation's replay debt — called by
+        ``main()`` after the pidfile is held and BEFORE the front
+        socket opens, so every stashable result is stashed before any
+        reconnecting client's same-request_id retry can arrive (no
+        double-delivery window). Returns the entries processed."""
+        if self._wal is None:
+            return 0
+        pending = self._wal.take_pending()
+        for key, entry in pending.items():
+            try:
+                self._replay_one(key, entry if isinstance(entry, dict)
+                                 else {})
+            except Exception as e:  # one bad entry must not kill start
+                print(f"# route: wal replay {key} failed: {e!r}",
+                      file=sys.stderr)
+                self._wal.ack(key)
+        return len(pending)
+
+    def _replay_one(self, key: str, entry: dict):
+        header = dict(entry.get("header") or {})
+        kernel = entry.get("kernel")
+        bucket = entry.get("bucket")
+        rid = header.get("id")
+        req_id = header.get("request_id")
+        req_id = str(req_id) if req_id is not None else None
+        tenant = header.get("tenant") or "-"
+
+        def skip(reason):
+            journal.emit(
+                "serve_request_replayed", via="wal", ok=False,
+                reason=reason, kernel=kernel, bucket=bucket,
+                request=rid, request_id=req_id, tenant=tenant,
+            )
+            print(f"# route: wal replay skipped "
+                  f"{req_id or key}: {reason}", file=sys.stderr)
+            self._wal.ack(key)
+
+        p64 = entry.get("p64")
+        if p64 is None:
+            return skip("payload-not-journaled")
+        if not kernel or not bucket:
+            return skip("malformed-entry")
+        # shm-lane operands: the client unlinks its request segments
+        # the moment its round trip errors, so they are usually gone
+        # by now — the client's reconnect retry owns those
+        for d in (header.get("_shm") or ()):
+            if isinstance(d, dict):
+                name = str(d.get("name") or "")
+                if not os.path.exists(
+                        os.path.join(protocol.SHM_DIR, name)):
+                    return skip("shm-gone")
+        payloads = [base64.b64decode(s) for s in p64]
+        order = self._order(bucket)
+        if not order:
+            return skip("no-live-worker")
+        try:
+            prior = int(header.get("replay") or 0)
+        except (TypeError, ValueError):
+            prior = 0
+        header["replay"] = prior + 1
+        idx = order[0]
+        journal.emit(
+            "serve_request_replayed", via="wal", kernel=kernel,
+            bucket=bucket, request=rid, request_id=req_id,
+            to_worker=idx, tenant=tenant,
+        )
+        resp, out_payloads = None, ()
+        for hop in range(2):
+            try:
+                resp, out_payloads = self._forward(idx, header,
+                                                   payloads)
+            except (OSError, protocol.ProtocolError):
+                if self._health is not None:
+                    self._health.note_transport_loss(idx)
+                sibling = next((j for j in order if j != idx), None)
+                if hop == 1 or sibling is None:
+                    return skip("workers-unreachable")
+                idx = sibling
+                continue
+            break
+        with self._lock:
+            self._routed += 1
+            self._routed_to[idx] += 1
+        obs_metrics.inc("serve.routed")
+        journal.emit(
+            "serve_route", kernel=kernel, bucket=bucket,
+            request=rid, request_id=req_id, worker=idx,
+            tenant=tenant,
+            priority=header.get("priority") or "interactive",
+            spilled_from=None, ok=bool(resp.get("ok")),
+            wal_replay=True,
+        )
+        if req_id is not None:
+            with self._lock:
+                self._stash[req_id] = {
+                    "resp": resp, "payloads": out_payloads,
+                    "worker": idx, "t": time.perf_counter(),
+                }
+        else:
+            # no request_id = no retry can ever claim it: the work
+            # is done (and journaled); free any response segments
+            self._drop_stashed({"resp": resp})
+        self._wal.ack(key)
+
+    def _take_stash(self, req_id: str):
+        """Claim (and expire) stashed replay results. Expiry mirrors
+        the reply()-to-a-gone-client path: response segments no one
+        will map must not wait for an aged sweep."""
+        now = time.perf_counter()
+        expired = []
+        with self._lock:
+            hit = self._stash.pop(req_id, None)
+            for k in [k for k, v in self._stash.items()
+                      if now - v["t"] > self._stash_ttl_s]:
+                expired.append(self._stash.pop(k))
+        for v in expired:
+            self._drop_stashed(v)
+        return hit
+
+    def _drop_stashed(self, hit):
+        resp = (hit or {}).get("resp") or {}
+        for d in (resp.get("_shm") or ()):
+            if isinstance(d, dict):
+                protocol.unlink_shm(d.get("name"))
 
     def worker_draining(self, idx: int) -> bool:
         with self._lock:
@@ -724,6 +907,31 @@ class Router:
             reply({"v": protocol.VERSION, "id": rid, "ok": False,
                    "kind": "error", "error": f"bad request: {e}"})
             return
+        if req_id is not None and self._stash:
+            # a reconnecting client retrying a request the WAL replay
+            # already executed: answer from the stash — the worker saw
+            # this request_id exactly once (docs/SERVING.md §guardian)
+            hit = self._take_stash(req_id)
+            if hit is not None:
+                resp = dict(hit["resp"])
+                resp["id"] = rid
+                out_payloads = hit["payloads"]
+                with self._lock:
+                    self._routed += 1
+                    self._routed_to[hit["worker"]] += 1
+                obs_metrics.inc("serve.routed")
+                self._count_copied(
+                    kernel, sum(len(p) for p in out_payloads)
+                )
+                journal.emit(
+                    "serve_route", kernel=kernel, bucket=bucket,
+                    request=rid, request_id=req_id,
+                    worker=hit["worker"], tenant=tenant,
+                    priority=priority, spilled_from=None,
+                    ok=bool(resp.get("ok")), wal_stash=True,
+                )
+                reply(resp, out_payloads)
+                return
         admitted, retry = self._admit_tenant(tenant, priority)
         if not admitted:
             with self._lock:
@@ -741,126 +949,138 @@ class Router:
                    "error": (f"tenant {tenant!r} over quota "
                              f"({priority}); retry after {retry}s")})
             return
-        order = self._order(bucket)
-        with self._lock:
-            down = set(self._down)
-        # graceful degradation (docs/SERVING.md §self-healing): with
-        # the bucket's home AND sibling both out, batch load sheds
-        # FIRST (an honest retry_after_s derived from the respawn
-        # backoff) while interactive traffic keeps riding whatever
-        # ring members remain; nothing alive at all sheds everything
-        # — a client told when to come back beats a client timing out
-        home_pair = set(ring_order(bucket, len(self.workers))[:2])
-        if not order or (priority == "batch" and down
-                         and home_pair <= down):
-            self._shed(reply, rid, req_id, kernel, bucket, tenant,
-                       priority, down or home_pair)
-            return
-        idx = order[0]
-        spilled_from = None
-        reason = None
-        dead = False
-        for hop in range(2):
-            dead = False
-            try:
-                resp, out_payloads = self._forward(idx, header,
-                                                   payloads)
-            except (OSError, protocol.ProtocolError) as e:
-                resp, out_payloads = None, ()
-                reason = "transport"
-                err = e
-                # dead-vs-transient discrimination at the moment of
-                # failure: a free pidfile flock is a death
-                # certificate, and declaring it NOW (sweep, respawn
-                # scheduling, ring removal) is what turns in-flight
-                # loss into a replay instead of a client error
-                dead = (self._health.note_transport_loss(idx)
-                        if self._health is not None else False)
-            else:
-                if resp.get("ok"):
-                    reason = None
-                elif resp.get("kind") == "overloaded":
-                    reason = "overloaded"
-                elif resp.get("kind") == "wedged":
-                    reason = "wedged"
-                    with self._lock:
-                        self._cooldown[idx] = (time.perf_counter()
-                                               + self.cooldown_s)
-                    print(f"# route: worker {idx} WEDGED on "
-                          f"{kernel} - cooling "
-                          f"{self.cooldown_s:.0f}s", file=sys.stderr)
-                else:
-                    reason = None  # an honest dispatch error: relay it
-            if reason is None:
-                break
-            sibling = next((j for j in order if j != idx), None)
-            if hop == 1 or sibling is None:
-                if resp is None:
-                    if dead:
-                        # the last candidate DIED under this request:
-                        # answer like the shed path — the worker is
-                        # being respawned, and "come back in Ns" is
-                        # the honest reply, not a hard error
-                        self._shed(reply, rid, req_id, kernel, bucket,
-                                   tenant, priority, {idx})
-                        return
-                    # no (further) sibling: surface the failure honestly
-                    resp = {"v": protocol.VERSION, "id": rid,
-                            "ok": False, "kind": "error",
-                            "error": (f"worker {idx} unreachable: "
-                                      f"{err!r}")}
-                    with self._lock:
-                        self._rejected += 1
-                break
+        # durable admission: the accepted request becomes crash-proof
+        # HERE — fsync'd into router.wal before any forward — and the
+        # kill_router chaos injection point sits exactly between the
+        # append and the forward, so a fired kill proves the replay
+        wal_key = self._wal_record(header, payloads, kernel, bucket)
+        faults.router_fault()
+        try:
+            order = self._order(bucket)
             with self._lock:
-                self._spilled += 1
-            obs_metrics.inc("serve.spills")
-            journal.emit(
-                "serve_spill", kernel=kernel, bucket=bucket,
-                request=rid, request_id=req_id,
-                from_worker=idx, to_worker=sibling,
-                reason=reason, tenant=tenant,
-            )
-            if dead:
-                # in-flight recovery (docs/SERVING.md §self-healing):
-                # the home worker DIED holding this accepted request —
-                # re-route it ONCE to the ring sibling, stamped as a
-                # replay. The `replay` header is the idempotency
-                # contract (protocol.py): the dead worker may already
-                # have executed it, kernels are pure, the request_id
-                # stays the same, so every consumer counts it once.
-                journal.emit(
-                    "serve_request_replayed", kernel=kernel,
-                    bucket=bucket, request=rid, request_id=req_id,
-                    from_worker=idx, to_worker=sibling, tenant=tenant,
-                )
-                header = dict(header)
+                down = set(self._down)
+            # graceful degradation (docs/SERVING.md §self-healing): with
+            # the bucket's home AND sibling both out, batch load sheds
+            # FIRST (an honest retry_after_s derived from the respawn
+            # backoff) while interactive traffic keeps riding whatever
+            # ring members remain; nothing alive at all sheds everything
+            # — a client told when to come back beats a client timing out
+            home_pair = set(ring_order(bucket, len(self.workers))[:2])
+            if not order or (priority == "batch" and down
+                             and home_pair <= down):
+                self._shed(reply, rid, req_id, kernel, bucket, tenant,
+                           priority, down or home_pair)
+                return
+            idx = order[0]
+            spilled_from = None
+            reason = None
+            dead = False
+            for hop in range(2):
+                dead = False
                 try:
-                    prior = int(header.get("replay") or 0)
-                except (TypeError, ValueError):
-                    prior = 0
-                header["replay"] = prior + 1
-            spilled_from, idx = idx, sibling
-        with self._lock:
-            self._routed += 1
-            self._routed_to[idx] += 1
-        obs_metrics.inc("serve.routed")
-        # inline payload bytes this request made the router relay
-        # (request upstream + response downstream); an shm-lane
-        # request contributes 0 — only names crossed this process
-        self._count_copied(
-            kernel,
-            sum(len(p) for p in payloads)
-            + sum(len(p) for p in out_payloads),
-        )
-        journal.emit(
-            "serve_route", kernel=kernel, bucket=bucket, request=rid,
-            request_id=req_id,
-            worker=idx, tenant=tenant, priority=priority,
-            spilled_from=spilled_from,
-            ok=bool(resp.get("ok")),
-        )
-        reply(resp, out_payloads)
+                    resp, out_payloads = self._forward(idx, header,
+                                                       payloads)
+                except (OSError, protocol.ProtocolError) as e:
+                    resp, out_payloads = None, ()
+                    reason = "transport"
+                    err = e
+                    # dead-vs-transient discrimination at the moment of
+                    # failure: a free pidfile flock is a death
+                    # certificate, and declaring it NOW (sweep, respawn
+                    # scheduling, ring removal) is what turns in-flight
+                    # loss into a replay instead of a client error
+                    dead = (self._health.note_transport_loss(idx)
+                            if self._health is not None else False)
+                else:
+                    if resp.get("ok"):
+                        reason = None
+                    elif resp.get("kind") == "overloaded":
+                        reason = "overloaded"
+                    elif resp.get("kind") == "wedged":
+                        reason = "wedged"
+                        with self._lock:
+                            self._cooldown[idx] = (time.perf_counter()
+                                                   + self.cooldown_s)
+                        print(f"# route: worker {idx} WEDGED on "
+                              f"{kernel} - cooling "
+                              f"{self.cooldown_s:.0f}s", file=sys.stderr)
+                    else:
+                        reason = None  # an honest dispatch error: relay it
+                if reason is None:
+                    break
+                sibling = next((j for j in order if j != idx), None)
+                if hop == 1 or sibling is None:
+                    if resp is None:
+                        if dead:
+                            # the last candidate DIED under this request:
+                            # answer like the shed path — the worker is
+                            # being respawned, and "come back in Ns" is
+                            # the honest reply, not a hard error
+                            self._shed(reply, rid, req_id, kernel, bucket,
+                                       tenant, priority, {idx})
+                            return
+                        # no (further) sibling: surface the failure honestly
+                        resp = {"v": protocol.VERSION, "id": rid,
+                                "ok": False, "kind": "error",
+                                "error": (f"worker {idx} unreachable: "
+                                          f"{err!r}")}
+                        with self._lock:
+                            self._rejected += 1
+                    break
+                with self._lock:
+                    self._spilled += 1
+                obs_metrics.inc("serve.spills")
+                journal.emit(
+                    "serve_spill", kernel=kernel, bucket=bucket,
+                    request=rid, request_id=req_id,
+                    from_worker=idx, to_worker=sibling,
+                    reason=reason, tenant=tenant,
+                )
+                if dead:
+                    # in-flight recovery (docs/SERVING.md §self-healing):
+                    # the home worker DIED holding this accepted request —
+                    # re-route it ONCE to the ring sibling, stamped as a
+                    # replay. The `replay` header is the idempotency
+                    # contract (protocol.py): the dead worker may already
+                    # have executed it, kernels are pure, the request_id
+                    # stays the same, so every consumer counts it once.
+                    journal.emit(
+                        "serve_request_replayed", kernel=kernel,
+                        bucket=bucket, request=rid, request_id=req_id,
+                        from_worker=idx, to_worker=sibling, tenant=tenant,
+                    )
+                    header = dict(header)
+                    try:
+                        prior = int(header.get("replay") or 0)
+                    except (TypeError, ValueError):
+                        prior = 0
+                    header["replay"] = prior + 1
+                spilled_from, idx = idx, sibling
+            with self._lock:
+                self._routed += 1
+                self._routed_to[idx] += 1
+            obs_metrics.inc("serve.routed")
+            # inline payload bytes this request made the router relay
+            # (request upstream + response downstream); an shm-lane
+            # request contributes 0 — only names crossed this process
+            self._count_copied(
+                kernel,
+                sum(len(p) for p in payloads)
+                + sum(len(p) for p in out_payloads),
+            )
+            journal.emit(
+                "serve_route", kernel=kernel, bucket=bucket, request=rid,
+                request_id=req_id,
+                worker=idx, tenant=tenant, priority=priority,
+                spilled_from=spilled_from,
+                ok=bool(resp.get("ok")),
+            )
+            reply(resp, out_payloads)
+        finally:
+            # ANY terminal outcome (reply sent, shed, relayed error)
+            # settles the entry; only a crash leaves it for replay
+            if wal_key is not None and self._wal is not None:
+                self._wal.ack(wal_key)
 
 
 # ------------------------------------------------------------------ #
@@ -934,6 +1154,16 @@ def main(argv=None):
         return 2
     router.attach_health(hm)
     hm.start()
+    # durable admission (docs/SERVING.md §guardian): open (recover)
+    # the WAL now the pidfile is held, and drain the previous
+    # incarnation's replay debt BEFORE the front socket opens — every
+    # stashable result is stashed before any reconnecting client's
+    # same-request_id retry can arrive
+    router.attach_wal(serve_wal.Wal(serve_fleet.wal_path()))
+    replayed = router.replay_wal()
+    if replayed:
+        print(f"# route: replayed {replayed} unacknowledged request(s)"
+              f" from {serve_fleet.wal_path()}", file=sys.stderr)
     signal.signal(signal.SIGTERM, router.stop)
     signal.signal(signal.SIGINT, router.stop)
     print(f"# route: listening on {socket_path} "

@@ -225,15 +225,16 @@ def put(
     # snapshot missing the other's promotion — last writer would win
     import fcntl
 
+    from tpukernels.resilience import atomic
+
     with open(f"{p}.lock", "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         _FILE_MEMO.pop(p, None)  # re-read under the lock, not the memo
         data = _load(p)
         data.setdefault("entries", {})[key] = entry
-        tmp = f"{p}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(data, f, indent=1, sort_keys=True)
-        os.replace(tmp, p)
+        # fsync'd tmp+rename (docs/RESILIENCE.md §atomic state): a
+        # crash mid-put must leave the old cache, never a torn one
+        atomic.dump_json(p, data)
     _FILE_MEMO.pop(p, None)
     journal.emit(
         "tuning_cache_put", key=key, params=entry["params"],
