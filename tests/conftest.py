@@ -244,6 +244,31 @@ if "TPK_SCALING_DIR" not in os.environ:
         except OSError:
             pass
 
+# Isolate the traffic-adaptive optimizer's artifacts (docs/SERVING.md
+# §adaptive buckets) the same way: adapt.json candidates and promoted
+# buckets.json tables written by tests must never land beside — or be
+# canaried/promoted from — the repo's real serving config, and a
+# previous suite run's promotion must not steer this one. Tests that
+# assert candidate state point TPK_ADAPT_DIR at their own tmp path.
+# The knobs are scrubbed too: an operator's exported pad target /
+# evidence floor would flip every proposal-threshold test — they pin
+# their own values.
+os.environ.pop("TPK_ADAPT_PAD_TARGET", None)
+os.environ.pop("TPK_ADAPT_MIN_REQUESTS", None)
+if "TPK_ADAPT_DIR" not in os.environ:
+    import tempfile
+
+    _adapt_dir = os.path.join(
+        tempfile.gettempdir(), f"tpk_adapt_test_{os.getuid()}"
+    )
+    os.makedirs(_adapt_dir, exist_ok=True)
+    os.environ["TPK_ADAPT_DIR"] = _adapt_dir
+    for _f in ("adapt.json", "buckets.json"):
+        try:  # a previous suite run's candidate must not steer this one
+            os.unlink(os.path.join(_adapt_dir, _f))
+        except OSError:
+            pass
+
 # Isolate the serve daemon's runtime dir (docs/SERVING.md) the same
 # way: test-spawned daemons bind their Unix socket and flock their
 # pidfile here, and they must never collide with — or be stopped as —

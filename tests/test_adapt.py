@@ -1,0 +1,643 @@
+"""CPU suite for the traffic-adaptive bucket optimizer
+(docs/SERVING.md §adaptive buckets; ROADMAP item 5).
+
+Pure-math units for the proposal model (pad projection mirroring the
+bucketing arithmetic, split/merge selection under the
+waste-saved-per-compile cost model, the PROMOTE_MARGIN + strict-p99
+promotion gate), the fail-loud TPK_ADAPT_* knob parses, the
+journal miners (shape mix, pad histogram, traffic order, canary-side
+measurement), the adapt.json artifact discipline (atomic write, loud
+torn/stale/jax-mismatch rejection), the multi-avatar bucketing +
+reload() pickup seam, loadgen's replay-spec lane validation, and the
+closed loop END TO END on CPU: seeded loadgen drives a skewed shape
+mix at a coarse incumbent table, ``serve_optimize propose`` mines it
+into a split candidate, the canary replays the frozen mix against
+both tables at identical seeds and PROMOTES, and a second serving run
+against the promoted table shows ``serve.bucket_pad_frac`` below
+``TPK_ADAPT_PAD_TARGET`` in ``obs_report`` — while a candidate that
+cannot win is REJECTED with the incumbent table file untouched byte
+for byte.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from test_distributed import _scrubbed_env
+from test_serve import _daemon
+
+from tpukernels.serve import adapt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _events(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    return out
+
+
+def _spec(n, statics=None):
+    """A vector_add-shaped avatar spec at array length ``n``."""
+    return {
+        "args": [["f32", []], ["f32", [n]], ["f32", [n]]],
+        "statics": dict(statics or {}),
+    }
+
+
+def _row(shapes, dtypes=None, count=1, pad_sum=0.0, bucketed=None,
+         kernel="vector_add"):
+    return {
+        "kernel": kernel,
+        "shapes": [tuple(s) for s in shapes],
+        "dtypes": list(dtypes or ["float32"] * len(shapes)),
+        "count": count,
+        "pad_frac_sum": pad_sum,
+        "bucketed": count if bucketed is None else bucketed,
+    }
+
+
+# ---------------------------------------------------------------- #
+# pure projection math                                             #
+# ---------------------------------------------------------------- #
+
+def test_pad_frac_for_mirrors_bucketing_arithmetic():
+    spec = _spec(2048)
+    # 1 - (1 + 256 + 256) / (1 + 2048 + 2048), scalar counted as one
+    pf = adapt.pad_frac_for([(), (256,), (256,)], ["float32"] * 3, spec)
+    assert pf == pytest.approx(1.0 - 513 / 4097)
+    # exact fit is 0.0, not merely small
+    assert adapt.pad_frac_for(
+        [(), (2048,), (2048,)], ["float32"] * 3, spec) == 0.0
+    # pad-up never down: any dim over the avatar is a non-match
+    assert adapt.pad_frac_for(
+        [(), (4096,), (4096,)], ["float32"] * 3, spec) is None
+    # rank mismatch
+    assert adapt.pad_frac_for(
+        [(), (256, 1), (256,)], ["float32"] * 3, spec) is None
+    # dtype mismatch
+    assert adapt.pad_frac_for(
+        [(), (256,), (256,)], ["float32", "int32", "float32"],
+        spec) is None
+    # arg-count mismatch
+    assert adapt.pad_frac_for([(256,)], ["float32"], spec) is None
+
+
+def test_project_request_weighted_mean_native_and_memo_slots():
+    table = {"k": {"args": [["f32", [100]]], "statics": {}}}
+    mix = {"k": [
+        _row([(50,)], count=3, kernel="k"),    # pad 0.5
+        _row([(100,)], count=1, kernel="k"),   # exact
+        _row([(200,)], count=2, kernel="k"),   # over: native
+    ]}
+    out = adapt.project(table, mix, max_pad=0.6)
+    assert out["bucketed"] == 4 and out["native"] == 2
+    assert out["pad_frac"] == pytest.approx((0.5 * 3) / 4)
+    assert out["buckets"] == 1  # one (kernel, avatar) program occupied
+    # the TPK_SERVE_MAX_PAD_FRAC cap sends over-padded traffic native
+    capped = adapt.project(table, mix, max_pad=0.4)
+    assert capped["bucketed"] == 1 and capped["native"] == 5
+    assert capped["pad_frac"] == 0.0
+
+
+def test_propose_splits_hot_shape_and_keeps_carrying_avatar():
+    table = {"k": _spec(1024, statics={"s": 1})}
+    mix = {"k": [
+        _row([(), (128,), (128,)], count=90, kernel="k"),
+        _row([(), (1024,), (1024,)], count=10, kernel="k"),  # exact
+    ]}
+    res = adapt.propose(mix, table, target=0.25, max_pad=1.0)
+    splits = [p for p in res["proposals"] if p["action"] == "split"]
+    assert len(splits) == 1 and splits[0]["kernel"] == "k"
+    # the new avatar sits exactly at the hot observed shapes, statics
+    # borrowed from the incumbent avatar
+    assert splits[0]["spec"]["args"] == [
+        ["f32", []], ["f32", [128]], ["f32", [128]]]
+    assert splits[0]["spec"]["statics"] == {"s": 1}
+    assert splits[0]["compiles"] == 1 and splits[0]["waste_saved"] > 0
+    # the (1024,) avatar still carries the exact-fit traffic: never
+    # merged away
+    assert [p for p in res["proposals"] if p["action"] == "merge"] == []
+    assert len(res["table"]["k"]) == 2
+    assert res["before"]["pad_frac"] > 0.25
+    assert res["after"]["pad_frac"] < 0.25 and res["after"]["native"] == 0
+    # the incumbent was deep-copied, never mutated
+    assert isinstance(table["k"], dict)
+
+
+def test_propose_merges_only_zero_traffic_avatars():
+    table = {"k": [_spec(1024), _spec(512)]}
+    mix = {"k": [_row([(), (512,), (512,)], count=8, kernel="k")]}
+    res = adapt.propose(mix, table, target=0.25, max_pad=1.0)
+    merges = [p for p in res["proposals"] if p["action"] == "merge"]
+    assert len(merges) == 1 and merges[0]["compiles"] == -1
+    assert merges[0]["spec"]["args"][1] == ["f32", [1024]]
+    assert res["table"]["k"] == [_spec(512)]
+    # a kernel is never left avatar-less, even with zero traffic
+    lone = adapt.propose({}, {"k": _spec(1024)}, target=0.25)
+    assert lone["proposals"] == []
+    assert lone["table"]["k"] == _spec(1024)
+
+
+def test_split_ranking_is_waste_saved_per_compile():
+    table = {"a": _spec(1000), "b": _spec(1000)}
+    mix = {
+        "a": [_row([(), (10,), (10,)], count=100, kernel="a")],
+        "b": [_row([(), (10,), (10,)], count=5, kernel="b")],
+    }
+    # both kernels pay the same per-request pad; "a" carries 20x the
+    # traffic, so its split saves 20x the waste per compile and must
+    # be applied first
+    cands = adapt._split_candidates(table, mix, max_pad=1.0)
+    assert {c["kernel"] for c in cands} == {"a", "b"}
+    by = {c["kernel"]: c for c in cands}
+    assert by["a"]["score"] > by["b"]["score"]
+    res = adapt.propose(mix, table, target=0.001, max_pad=1.0,
+                        max_splits=1)
+    splits = [p for p in res["proposals"] if p["action"] == "split"]
+    assert [p["kernel"] for p in splits] == ["a"]  # budget: best only
+
+
+def test_judge_canary_promotion_gate():
+    m = 0.03
+    # measurement missing on either side: never promote
+    v = adapt.judge_canary({}, {"pad_frac": 0.5, "p99_s": 0.1},
+                           margin=m)
+    assert not v["promote"] and v["reason"] == "no-measurement"
+    # an incumbent already at zero pad has nothing to save
+    v = adapt.judge_canary({"pad_frac": 0.0, "p99_s": 0.1},
+                           {"pad_frac": 0.0, "p99_s": 0.2}, margin=m)
+    assert not v["promote"] and "nothing-to-save" in v["reason"]
+    # pad win at-or-below the margin: rejected
+    v = adapt.judge_canary({"pad_frac": 0.98, "p99_s": 0.1},
+                           {"pad_frac": 1.0, "p99_s": 0.2}, margin=m)
+    assert not v["promote"] and "margin" in v["reason"]
+    assert v["pad_win"] == pytest.approx(0.02)
+    # pad win but p99 not STRICTLY better: rejected
+    v = adapt.judge_canary({"pad_frac": 0.1, "p99_s": 0.2},
+                           {"pad_frac": 0.9, "p99_s": 0.2}, margin=m)
+    assert not v["promote"] and "p99 did not win" in v["reason"]
+    # both gates pass: promoted
+    v = adapt.judge_canary({"pad_frac": 0.1, "p99_s": 0.1},
+                           {"pad_frac": 0.9, "p99_s": 0.2}, margin=m)
+    assert v["promote"] and v["pad_win"] == pytest.approx(8 / 9)
+    # the default margin is the tuning layer's — one authority
+    from tpukernels.tuning import runner
+
+    v = adapt.judge_canary({"pad_frac": 0.1, "p99_s": 0.1},
+                           {"pad_frac": 0.9, "p99_s": 0.2})
+    assert v["margin"] == runner.PROMOTE_MARGIN == 0.03
+
+
+def test_adapt_knobs_fail_loud(monkeypatch):
+    monkeypatch.delenv("TPK_ADAPT_PAD_TARGET", raising=False)
+    monkeypatch.delenv("TPK_ADAPT_MIN_REQUESTS", raising=False)
+    assert adapt.pad_target() == adapt.DEFAULT_PAD_TARGET == 0.25
+    assert adapt.min_requests() == adapt.DEFAULT_MIN_REQUESTS == 50
+    monkeypatch.setenv("TPK_ADAPT_PAD_TARGET", "0.1")
+    assert adapt.pad_target() == 0.1
+    for bad in ("0", "1.5", "-0.2", "abc"):
+        monkeypatch.setenv("TPK_ADAPT_PAD_TARGET", bad)
+        with pytest.raises(ValueError, match="TPK_ADAPT_PAD_TARGET"):
+            adapt.pad_target()
+    monkeypatch.delenv("TPK_ADAPT_PAD_TARGET", raising=False)
+    monkeypatch.setenv("TPK_ADAPT_MIN_REQUESTS", "20")
+    assert adapt.min_requests() == 20
+    for bad in ("0", "-3", "x"):
+        monkeypatch.setenv("TPK_ADAPT_MIN_REQUESTS", bad)
+        with pytest.raises(ValueError, match="TPK_ADAPT_MIN_REQUESTS"):
+            adapt.min_requests()
+
+
+# ---------------------------------------------------------------- #
+# journal mining                                                   #
+# ---------------------------------------------------------------- #
+
+def _req(kernel, shapes, ok=True, pad_frac=0.0, bucketed=True):
+    return {"kind": "serve_request", "kernel": kernel, "ok": ok,
+            "shapes": [list(s) for s in shapes],
+            "dtypes": ["float32"] * len(shapes),
+            "pad_frac": pad_frac, "bucketed": bucketed}
+
+
+def test_shape_mix_counts_ok_requests_only_sorted_by_weight():
+    events = (
+        [_req("vector_add", [(256,)], pad_frac=0.5)] * 3
+        + [_req("vector_add", [(1024,)])]
+        + [_req("vector_add", [(256,)], ok=False)] * 5  # tell us nothing
+        + [_req("scan", [(64,)])] * 2
+        + [{"kind": "bench", "kernel": "vector_add"}]
+        + [{"kind": "serve_request", "ok": True}]  # malformed: dropped
+    )
+    mix = adapt.shape_mix(events)
+    assert adapt.mix_requests(mix) == 6
+    rows = mix["vector_add"]
+    assert [r["count"] for r in rows] == [3, 1]  # heaviest first
+    assert rows[0]["shapes"] == [(256,)]
+    assert rows[0]["pad_frac_sum"] == pytest.approx(1.5)
+    assert mix["scan"][0]["count"] == 2
+
+
+def test_traffic_order_ranks_by_frequency_with_registry_tail():
+    events = ([_req("vector_add", [(8,)])] * 4
+              + [_req("scan", [(8,)])] * 2
+              + [_req("scan", [(8,)], ok=False)]
+              + [_req("unknown_kernel", [(8,)])])
+    known = ["scan", "sgemm", "vector_add"]
+    ordered, counts = adapt.traffic_order(events, known)
+    assert ordered == ["vector_add", "scan", "sgemm"]
+    assert counts == {"vector_add": 4, "scan": 3}
+    # no evidence: registry order kept, empty counts = fallback cue
+    ordered, counts = adapt.traffic_order([], known)
+    assert ordered == known and counts == {}
+
+
+def test_histogram_pad_frac_reads_last_metrics_event():
+    hist = {"serve.bucket_pad_frac": {"count": 4, "sum": 2.0}}
+    old = {"serve.bucket_pad_frac": {"count": 2, "sum": 1.8}}
+    events = [
+        {"kind": "metrics", "histograms": old},
+        {"kind": "metrics", "histograms": {}},
+        {"kind": "metrics", "histograms": hist},
+    ]
+    assert adapt.histogram_pad_frac(events) == pytest.approx(0.5)
+    assert adapt.histogram_pad_frac(events[:1]) == pytest.approx(0.9)
+    assert adapt.histogram_pad_frac([]) is None
+
+
+def test_replay_entries_heaviest_groups_with_avatar_statics():
+    table = {"a": _spec(1024, statics={"rows": 8}), "b": _spec(512)}
+    mix = {
+        "a": [_row([(), (128,), (128,)], count=9, kernel="a"),
+              _row([(), (64,), (64,)], count=2, kernel="a")],
+        "b": [_row([(), (32,), (32,)], count=5, kernel="b")],
+        "orphan": [_row([(7,)], count=99, kernel="orphan")],
+    }
+    entries = adapt.replay_entries(mix, table, top=2)
+    # the orphan kernel has no avatar: it can never bucket, so it is
+    # not replay traffic; the top-2 cap keeps the heaviest groups
+    assert [(e["kernel"], e["weight"]) for e in entries] == [
+        ("a", 9), ("b", 5)]
+    assert entries[0]["args"] == [["f32", []], ["f32", [128]],
+                                  ["f32", [128]]]
+    assert entries[0]["statics"] == {"rows": 8}
+
+
+def test_measured_side_weighs_slo_probe_p99s():
+    events = (
+        [_req("a", [(8,)], pad_frac=0.5)]
+        + [_req("a", [(8,)], pad_frac=0.0, bucketed=False)]
+        + [_req("a", [(8,)], ok=False, pad_frac=0.9)]  # excluded
+        + [{"kind": "slo_probe",
+            "verdicts": {"x": {"p99_s": 0.3, "count": 1}}},
+           {"kind": "slo_probe",
+            "verdicts": {"x": {"p99_s": 0.1, "count": 3},
+                         "y": {"p99_s": 0.2, "count": 1},
+                         "z": {"p99_s": None, "count": 4}}}]
+    )
+    side = adapt.measured_side(events)
+    assert side["requests"] == 2 and side["bucketed"] == 1
+    assert side["pad_frac"] == pytest.approx(0.25)
+    # last slo_probe wins, request-weighted over measurable verdicts
+    assert side["p99_s"] == pytest.approx((0.1 * 3 + 0.2) / 4)
+    empty = adapt.measured_side([])
+    assert empty["pad_frac"] is None and empty["p99_s"] is None
+
+
+# ---------------------------------------------------------------- #
+# the persisted candidate artifact                                 #
+# ---------------------------------------------------------------- #
+
+def _result(table):
+    proj = {"pad_frac": 0.5, "bucketed": 6, "native": 0, "buckets": 1}
+    return {"before": dict(proj), "after": dict(proj),
+            "proposals": [], "table": table}
+
+
+def _mix_one(kernel="vector_add", n=256, count=6):
+    return {kernel: [_row([(), (n,), (n,)], count=count,
+                          kernel=kernel)]}
+
+
+def test_candidate_artifact_validation(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("TPK_ADAPT_DIR", str(tmp_path))
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(tmp_path / "j.jsonl"))
+    adapt.reset()
+    table = {"vector_add": _spec(512)}
+    p = adapt.record_candidate(_result(table), _mix_one(), 0.25,
+                               jax_version="not-this-jax")
+    assert p == str(tmp_path / "adapt.json")
+    data = json.load(open(p))
+    assert data["status"] == "proposed" and data["canary"] is None
+    assert data["requests_mined"] == 6
+    # the frozen replay spec rides in the artifact
+    assert data["replay"][0]["kernel"] == "vector_add"
+    assert data["replay"][0]["weight"] == 6
+    # unvalidated read serves the CLI's `show`
+    assert adapt.load(validate=False)["table"] == table
+    # jax-version mismatch: rejected loudly, never canaried
+    assert adapt.load() is None
+    err = capsys.readouterr().err
+    assert "adapt candidate rejected" in err and "not-this-jax" in err
+    evs = _events(tmp_path / "j.jsonl")
+    assert any(e["kind"] == "adapt_rejected" for e in evs)
+
+    import jax
+
+    adapt.reset()
+    adapt.record_candidate(_result(table), _mix_one(), 0.25,
+                           jax_version=jax.__version__)
+    good = adapt.load()
+    assert good is not None and good["table"] == table
+
+    # stale: a commit touching the serve sources postdates the sha
+    data = json.load(open(p))
+    data["source_sha"] = "0" * 40
+    with open(p, "w") as f:
+        json.dump(data, f)
+    adapt.reset()
+    assert adapt.load() is None
+    assert "stale" in capsys.readouterr().err
+
+    # torn mid-write: reads as absent, cold behavior not a crash
+    with open(p, "w") as f:
+        f.write('{"status": "propo')
+    adapt.reset()
+    assert adapt.load() is None
+
+    # malformed (no table): rejected before any validation
+    with open(p, "w") as f:
+        json.dump({"status": "proposed", "jax": jax.__version__}, f)
+    adapt.reset()
+    assert adapt.load(validate=False) is None
+    assert "malformed" in capsys.readouterr().err
+
+
+def test_promote_writes_the_stable_buckets_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPK_ADAPT_DIR", str(tmp_path / "deep" / "d"))
+    table = {"vector_add": [_spec(512), _spec(64)]}
+    bp = adapt.promote(table)
+    assert bp == adapt.buckets_path()
+    assert json.load(open(bp)) == table
+
+
+# ---------------------------------------------------------------- #
+# multi-avatar bucketing + the reload() pickup seam                #
+# ---------------------------------------------------------------- #
+
+def test_bucket_for_multi_avatar_picks_min_pad(monkeypatch):
+    import numpy as np
+
+    from tpukernels.serve import bucketing
+
+    monkeypatch.setenv("TPK_SERVE_BUCKETS", json.dumps(
+        {"vector_add": [_spec(2048), _spec(256)]}))
+    bucketing.reload()
+    ops = (np.float32(1), np.ones(256, np.float32),
+           np.ones(256, np.float32))
+    spec, pad = bucketing.bucket_for("vector_add", ops, {})
+    assert spec is not None and pad == 0.0
+    assert spec["args"][1][1] == [256]  # the cheaper avatar won
+    ops = (np.float32(1), np.ones(1200, np.float32),
+           np.ones(1200, np.float32))
+    spec, pad = bucketing.bucket_for("vector_add", ops, {})
+    assert spec is not None and spec["args"][1][1] == [2048]
+    assert 0.0 < pad <= 0.5  # under the TPK_SERVE_MAX_PAD_FRAC cap
+    ops = (np.float32(1), np.ones(4096, np.float32),
+           np.ones(4096, np.float32))
+    spec, reason = bucketing.bucket_for("vector_add", ops, {})
+    assert spec is None and isinstance(reason, str)
+
+
+def test_bucketing_reload_picks_up_rewritten_file(tmp_path,
+                                                  monkeypatch):
+    from tpukernels.serve import bucketing
+
+    table_path = tmp_path / "buckets.json"
+    table_path.write_text(json.dumps({"vector_add": _spec(512)}))
+    monkeypatch.setenv("TPK_SERVE_BUCKETS", str(table_path))
+    bucketing.reload()
+    assert bucketing.kernel_specs("vector_add") == [_spec(512)]
+    # a promotion rewrites the FILE behind the unchanged env value:
+    # invisible until reload() — undrain's hook — busts the cache
+    table_path.write_text(json.dumps({"vector_add": [_spec(64)]}))
+    assert bucketing.kernel_specs("vector_add") == [_spec(512)]
+    bucketing.reload()
+    assert bucketing.kernel_specs("vector_add") == [_spec(64)]
+    # a reload onto a malformed table raises AND keeps serving the
+    # last-good table — an undrain must not wedge the fleet
+    table_path.write_text("{not json")
+    with pytest.raises(ValueError):
+        bucketing.reload()
+    assert bucketing.kernel_specs("vector_add") == [_spec(64)]
+
+
+# ---------------------------------------------------------------- #
+# loadgen's replay-spec lane (usage + validation)                  #
+# ---------------------------------------------------------------- #
+
+def _loadgen(tmp_path, *args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+         *args],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+        env=_scrubbed_env(None),
+    )
+
+
+def test_loadgen_replay_spec_usage_errors(tmp_path):
+    spec = tmp_path / "replay.json"
+    spec.write_text(json.dumps({"entries": [
+        {"kernel": "vector_add",
+         "args": [["f32", []], ["f32", [8]], ["f32", [8]]],
+         "statics": {}, "weight": 2}]}))
+    # a replay spec only makes sense against a daemon
+    r = _loadgen(tmp_path, "--shapes", str(spec), "--simulate", "5",
+                 "--requests", "5")
+    assert r.returncode == 2 and "requires --serve" in r.stderr
+    # the file IS the mix: --kernel/--mix don't combine
+    r = _loadgen(tmp_path, "--serve", "/nonexistent.sock", "--shapes",
+                 str(spec), "--kernel", "scan", "--requests", "5")
+    assert r.returncode == 2 and "don't combine" in r.stderr
+    # unknown class / unreadable file
+    r = _loadgen(tmp_path, "--serve", "/nonexistent.sock", "--shapes",
+                 str(tmp_path / "missing.json"), "--requests", "5")
+    assert r.returncode == 2 and "replay-spec" in r.stderr
+    # malformed entries are named, not silently skipped
+    for bad, hint in (
+        ({"entries": []}, "at least one entry"),
+        ({"entries": [{"kernel": "k", "args": [["f64", [4]]]}]},
+         "bad arg"),
+        ({"entries": [{"kernel": "k", "args": [["f32", [4]]],
+                       "weight": 0}]}, "weight"),
+    ):
+        spec.write_text(json.dumps(bad))
+        r = _loadgen(tmp_path, "--serve", "/nonexistent.sock",
+                     "--shapes", str(spec), "--requests", "5")
+        assert r.returncode == 2 and hint in r.stderr, r.stderr
+
+
+# ---------------------------------------------------------------- #
+# the closed loop, end to end on CPU                               #
+# ---------------------------------------------------------------- #
+
+def _tool(name, args, env, timeout=300):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", name), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env,
+    )
+
+
+def test_adaptive_bucket_loop_end_to_end(tmp_path):
+    """Skewed traffic at a coarse table -> propose -> canary win at
+    identical seeds -> promotion journaled -> the promoted table
+    serves the same mix with pad_frac below target in obs_report; the
+    incumbent table file is never touched."""
+    adapt_dir = tmp_path / "adapt"
+    adapt_dir.mkdir()
+    incumbent_path = tmp_path / "incumbent.json"
+    incumbent_path.write_text(json.dumps(
+        {"vector_add": _spec(1 << 20)}))
+    incumbent_bytes = incumbent_path.read_bytes()
+    # the skewed live mix: everything lands at (4096,), paying
+    # ~99.6% pad against the coarse 1M avatar
+    traffic = tmp_path / "traffic.json"
+    traffic.write_text(json.dumps({"entries": [
+        {"kernel": "vector_add",
+         "args": [["f32", []], ["f32", [4096]], ["f32", [4096]]],
+         "statics": {}, "weight": 1.0}]}))
+    base = _scrubbed_env(None)
+    base["TPK_ADAPT_DIR"] = str(adapt_dir)
+    base["TPK_SERVE_BUCKETS"] = str(incumbent_path)
+    base["TPK_SERVE_MAX_PAD_FRAC"] = "1.0"  # let the waste bucket
+    base["TPK_ADAPT_MIN_REQUESTS"] = "20"
+    base["TPK_SLO_DIR"] = str(tmp_path / "slo")
+    daemon_env = {"TPK_SERVE_BUCKETS": str(incumbent_path),
+                  "TPK_SERVE_MAX_PAD_FRAC": "1.0"}
+
+    # 1. live traffic against the incumbent leaves the evidence
+    with _daemon(tmp_path, env_extra=daemon_env, tag="traffic") as (
+            sock, j1, _proc):
+        env = dict(base)
+        env["TPK_HEALTH_JOURNAL"] = j1
+        r = _tool("loadgen.py",
+                  ["--serve", sock, "--shapes", str(traffic),
+                   "--seed", "7", "--requests", "24", "--rate", "100"],
+                  env)
+        assert r.returncode == 0, r.stdout + r.stderr
+    mined = [e for e in _events(j1)
+             if e.get("kind") == "serve_request" and e.get("ok")]
+    assert len(mined) >= 20 and all(e["bucketed"] for e in mined)
+    assert all(e["pad_frac"] > 0.9 for e in mined)
+
+    # 2. propose: mine the journal, persist the split candidate
+    ops_journal = tmp_path / "ops.jsonl"
+    env = dict(base)
+    env["TPK_HEALTH_JOURNAL"] = str(ops_journal)
+    r = _tool("serve_optimize.py", ["propose", "--journal", j1], env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "proposed" in r.stdout, r.stdout
+    cand = json.load(open(adapt_dir / "adapt.json"))
+    assert cand["status"] == "proposed"
+    assert cand["before"]["pad_frac"] > 0.9
+    assert cand["after"]["pad_frac"] < 0.25  # the default target
+    specs = cand["table"]["vector_add"]
+    assert isinstance(specs, list)
+    assert any(s["args"][1][1] == [4096] for s in specs)
+
+    # 3. canary: replay the frozen mix against both tables at
+    # identical seeds; the exact-fit candidate must win pad AND p99
+    r = _tool("serve_optimize.py",
+              ["canary", "--seed", "11", "--requests", "16",
+               "--rate", "100", "--check"], env, timeout=420)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PROMOTED" in r.stdout, r.stdout
+    promoted_path = adapt_dir / "buckets.json"
+    assert promoted_path.exists()
+    cand = json.load(open(adapt_dir / "adapt.json"))
+    assert cand["status"] == "promoted"
+    assert cand["canary"]["seed"] == 11
+    assert cand["canary"]["verdict"]["promote"] is True
+    kinds = [e["kind"] for e in _events(ops_journal)]
+    assert {"adapt_proposed", "adapt_canary",
+            "adapt_promoted"} <= set(kinds)
+    promoted_ev = [e for e in _events(ops_journal)
+                   if e["kind"] == "adapt_promoted"][-1]
+    assert promoted_ev["pad_frac"] == pytest.approx(0.0)
+    # promotion rewrote ONLY the stable buckets.json: the incumbent
+    # table file is byte-identical untouched
+    assert incumbent_path.read_bytes() == incumbent_bytes
+
+    # 4. the promoted table serves the same mix waste-free, and
+    # obs_report's one-look line says so
+    with _daemon(tmp_path, env_extra={
+            "TPK_SERVE_BUCKETS": str(promoted_path),
+            "TPK_SERVE_MAX_PAD_FRAC": "1.0"}, tag="promoted") as (
+            sock, j3, _proc):
+        env = dict(base)
+        env["TPK_HEALTH_JOURNAL"] = j3
+        r = _tool("loadgen.py",
+                  ["--serve", sock, "--shapes", str(traffic),
+                   "--seed", "7", "--requests", "24", "--rate", "100"],
+                  env)
+        assert r.returncode == 0, r.stdout + r.stderr
+    served = [e for e in _events(j3)
+              if e.get("kind") == "serve_request" and e.get("ok")]
+    assert served and all(e["bucketed"] for e in served)
+    assert all(e["pad_frac"] == 0.0 for e in served)
+    r = _tool("obs_report.py", ["--journal", j3], base)
+    assert "adaptive buckets" in r.stdout, r.stdout + r.stderr
+    assert "below target" in r.stdout, r.stdout
+
+
+def test_canary_rejects_non_winning_candidate(tmp_path, monkeypatch):
+    """A candidate that cannot beat the incumbent (identical table:
+    pad_win is exactly 0) is measured, REJECTED with evidence, and
+    changes nothing: no buckets.json, incumbent bytes untouched."""
+    import jax
+
+    adapt_dir = tmp_path / "adapt"
+    incumbent_path = tmp_path / "incumbent.json"
+    incumbent = {"vector_add": _spec(512)}
+    incumbent_path.write_text(json.dumps(incumbent))
+    incumbent_bytes = incumbent_path.read_bytes()
+    monkeypatch.setenv("TPK_ADAPT_DIR", str(adapt_dir))
+    # traffic at (256,) pads ~50% on the 512 avatar — there IS waste,
+    # but the candidate table is the incumbent itself, so the canary
+    # measures identical pads and the margin gate must hold
+    adapt.record_candidate(_result(incumbent), _mix_one(n=256), 0.25,
+                           jax_version=jax.__version__)
+    ops_journal = tmp_path / "ops.jsonl"
+    env = _scrubbed_env(None)
+    env["TPK_ADAPT_DIR"] = str(adapt_dir)
+    env["TPK_SERVE_BUCKETS"] = str(incumbent_path)
+    env["TPK_HEALTH_JOURNAL"] = str(ops_journal)
+    env["TPK_SLO_DIR"] = str(tmp_path / "slo")
+    r = _tool("serve_optimize.py",
+              ["canary", "--seed", "3", "--requests", "6", "--rate",
+               "200", "--check"], env, timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REJECTED" in r.stdout and "incumbent stays" in r.stdout
+    data = json.load(open(adapt_dir / "adapt.json"))
+    assert data["status"] == "rejected"
+    assert data["canary"]["verdict"]["promote"] is False
+    evs = _events(ops_journal)
+    canary_ev = [e for e in evs if e["kind"] == "adapt_canary"][-1]
+    assert canary_ev["promote"] is False
+    assert any(e["kind"] == "adapt_rejected" for e in evs)
+    assert not any(e["kind"] == "adapt_promoted" for e in evs)
+    # nothing changed: the fleet's table file does not exist, the
+    # incumbent is byte-identical
+    assert not (adapt_dir / "buckets.json").exists()
+    assert incumbent_path.read_bytes() == incumbent_bytes

@@ -735,9 +735,13 @@ def test_production_queue_is_wellformed():
     assert next(s for s in q if s.name == "prewarm_all").cost_from == \
         "prewarm"
     # CPU-only steps must say so (they must never wait on a window)
-    for name in ("obs_check", "autotune_smoke", "san_asan",
-                 "san_ubsan"):
+    for name in ("obs_check", "autotune_smoke", "adapt_propose",
+                 "san_asan", "san_ubsan"):
         assert not next(s for s in q if s.name == name).needs_chip
+    # the adaptive-bucket canary spends chip time on a measured
+    # verdict: it must wait for the proposal AND a warm manifest
+    assert set(next(s for s in q if s.name == "adapt_canary").after) \
+        == {"prewarm_all", "adapt_propose"}
 
 
 def test_production_plan_order_reproduces_next_md(tmp_path,
@@ -795,3 +799,8 @@ def test_production_plan_order_reproduces_next_md(tmp_path,
                      if s.name == "fleet_fsck")
     assert not fsck_spec.gating
     assert "fsck" in fsck_spec.shell
+    # the closed loop schedules in order: the CPU-only proposal rides
+    # the density-2.0 housekeeping group, the chip canary follows it
+    assert order.index("adapt_propose") > order.index("serve_probe")
+    assert order.index("adapt_canary") > order.index("adapt_propose")
+    assert order.index("adapt_canary") < order.index("knob_sanity")

@@ -318,6 +318,30 @@ def _fmt(ev):
                 + ("DRAINING" if ev.get("phase") == "begin"
                    else "restored to the ring")
                 + f" ({ev.get('inflight')} in flight)")
+    if kind == "adapt_proposed":
+        before = (ev.get("before") or {}).get("pad_frac")
+        after = (ev.get("after") or {}).get("pad_frac")
+        return (f"{ts} [pid {pid}] adaptive buckets: proposed "
+                f"{len(ev.get('proposals') or [])} split/merge(s) "
+                f"over {ev.get('requests_mined')} mined request(s)"
+                + (f", projected pad_frac {before:.3f} -> {after:.3f}"
+                   if isinstance(before, (int, float))
+                   and isinstance(after, (int, float)) else "")
+                + f" (target {ev.get('pad_target')})")
+    if kind == "adapt_canary":
+        return (f"{ts} [pid {pid}] adaptive buckets: canary "
+                f"{'WON' if ev.get('promote') else 'LOST'} at seed "
+                f"{ev.get('seed')} - {ev.get('reason')}")
+    if kind == "adapt_promoted":
+        pf = ev.get("pad_frac")
+        return (f"{ts} [pid {pid}] adaptive buckets: PROMOTED "
+                f"{ev.get('table')}"
+                + (f" (measured pad_frac {pf:.3f})"
+                   if isinstance(pf, (int, float)) else "")
+                + " - undrain picks it up live")
+    if kind == "adapt_rejected":
+        return (f"{ts} [pid {pid}] adaptive buckets: candidate "
+                f"REJECTED - {ev.get('reason')} (incumbent stays)")
     if kind == "serve_tenant_throttled":
         return (f"{ts} [pid {pid}] tenant {ev.get('tenant')} "
                 f"THROTTLED ({ev.get('priority')} {ev.get('kernel')} "
@@ -741,7 +765,9 @@ def summarize(events, bad=0) -> str:
         f"{counts.get('router_quarantined', 0)} router quarantine(s), "
         f"{counts.get('artifact_rejected', 0)} torn artifact(s), "
         f"{counts.get('fleet_fsck', 0)} fsck run(s), "
-        f"{counts.get('chaos_event', 0)} chaos event(s)"
+        f"{counts.get('chaos_event', 0)} chaos event(s), "
+        f"{counts.get('adapt_promoted', 0)} bucket promotion(s), "
+        f"{counts.get('adapt_rejected', 0)} bucket rejection(s)"
     )
     return "\n".join(out)
 

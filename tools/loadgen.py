@@ -41,7 +41,16 @@ deterministic canary operands — CPU-fast, the 60-second supervisor
 probe and the CI proof; ``record`` materializes the registered
 ``aot.BENCH_CONFIGS`` avatar shapes — the real serving shapes, for
 chip windows. ``--mix all`` spreads requests uniformly over every
-registry kernel; ``k1=w1,k2=w2`` weights them.
+registry kernel; ``k1=w1,k2=w2`` weights them. Anything else is a
+REPLAY-SPEC file path (requires ``--serve``; ``--kernel``/``--mix``
+don't apply): JSON ``{"entries": [{"kernel", "args": [[kind,
+shape], ...], "statics", "weight"}, ...]}`` — an OBSERVED shape mix
+materialized verbatim, which is how the traffic-adaptive canary
+(``tools/serve_optimize.py``; docs/SERVING.md §adaptive buckets)
+replays the journal's shape population against candidate vs
+incumbent bucket tables at identical seeds. Replay verdicts record
+under shape class ``replay``, which has no SLO target row and can
+never gate.
 
 ``--simulate MS`` replaces dispatch with a deterministic virtual
 clock (single-server queue, seeded service times around MS; no jax
@@ -299,8 +308,65 @@ def run_real(schedule, shape_class: str, echo) -> None:
         obs_metrics.observe(f"slo.service_s.{kernel}", s1 - s0)
 
 
+def _replay_operands(entry):
+    """Materialize one replay-spec entry's operands: np.ones at the
+    OBSERVED shapes (values never matter to pad accounting), host
+    scalars as 0-d arrays exactly like :func:`_operands_np`."""
+    import numpy as np
+
+    dt = {"f32": np.float32, "i32": np.int32}
+    args = tuple(
+        dt[kind](1) if not shape
+        else np.ones([int(d) for d in shape], dt[kind])
+        for kind, shape in (tuple(a) for a in entry["args"])
+    )
+    return args, dict(entry.get("statics") or {})
+
+
+def _load_replay(path):
+    """Read and validate a replay-spec file (module docstring has the
+    format). Returns ``(entries_by_id, mix)`` where the mix keys are
+    synthetic entry ids (``e000``...) — the schedule draws over
+    ENTRIES (one observed shape population each), while dispatch and
+    metrics use each entry's real kernel name."""
+    import json as _json
+
+    with open(path) as f:
+        spec = _json.load(f)
+    entries = spec.get("entries") if isinstance(spec, dict) else None
+    if not isinstance(entries, list) or not entries:
+        raise ValueError(
+            'want {"entries": [...]} with at least one entry'
+        )
+    replay, mix = {}, {}
+    for i, ent in enumerate(entries):
+        if not isinstance(ent, dict) \
+                or not isinstance(ent.get("kernel"), str):
+            raise ValueError(f"entry {i}: needs a kernel name")
+        args = ent.get("args")
+        if not isinstance(args, list) or not args:
+            raise ValueError(
+                f"entry {i}: needs args [[kind, [dims]], ...]"
+            )
+        for a in args:
+            if (not isinstance(a, (list, tuple)) or len(a) != 2
+                    or a[0] not in ("f32", "i32")
+                    or not isinstance(a[1], (list, tuple))):
+                raise ValueError(
+                    f"entry {i}: bad arg {a!r} (want "
+                    '["f32"|"i32", [dims]])'
+                )
+        w = float(ent.get("weight", 1.0))
+        if w <= 0:
+            raise ValueError(f"entry {i}: weight must be > 0")
+        eid = f"e{i:03d}"
+        replay[eid] = ent
+        mix[eid] = w
+    return replay, mix
+
+
 def run_serve(schedule, shape_class: str, socket_path: str, echo,
-              seed: int = 0, tenant=None, priority=None):
+              seed: int = 0, tenant=None, priority=None, replay=None):
     """Drive the serving daemon through the schedule, open-loop — the
     ``run_real`` arithmetic with the daemon in place of
     ``registry.dispatch``. Latency stays completion minus SCHEDULED
@@ -314,8 +380,13 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
     ``tenant``/``priority`` ride every request header (the fleet
     router's admission point) and a tenant's series record under
     ``<kernel>@<tenant>`` so its verdicts earn their own slo.json
-    rows. Returns the daemon's ping stats (device_kind, jax version)
-    for the verdict record."""
+    rows. With ``replay`` (a ``_load_replay`` entries-by-id map) the
+    schedule's keys are entry ids; each entry materializes its
+    observed shapes while dispatch and metrics use its real kernel
+    name, so two entries of one kernel merge into one latency
+    histogram — the canary compares POPULATIONS, not entries.
+    Returns the daemon's ping stats (device_kind, jax version) for
+    the verdict record."""
     import random as random_mod
 
     from tpukernels.serve import client as serve_client
@@ -397,28 +468,33 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
     stats = cli.ping()  # reachability gate: a dead socket aborts HERE
     bytes_before = stats.get("bytes_copied")
     prepared = {}
-    for kernel in sorted({k for _t, k in schedule}):
-        prepared[kernel] = _operands_np(kernel, shape_class)
-        args, statics = prepared[kernel]
+    for key in sorted({k for _t, k in schedule}):
+        if replay is not None:
+            kname = replay[key]["kernel"]
+            args, statics = _replay_operands(replay[key])
+        else:
+            kname = key
+            args, statics = _operands_np(key, shape_class)
+        prepared[key] = (kname, args, statics)
         w0 = time.perf_counter()
-        warmed = dispatch_patiently(cli, kernel, args, statics,
-                                    _rid(f"warm-{kernel}"), warm=True)
-        echo(f"# warmed {kernel} in {time.perf_counter() - w0:.3f}s"
+        warmed = dispatch_patiently(cli, kname, args, statics,
+                                    _rid(f"warm-{key}"), warm=True)
+        echo(f"# warmed {kname} in {time.perf_counter() - w0:.3f}s"
              " (served)" + ("" if warmed else " DROPPED"))
     t0 = time.perf_counter()
-    for i, (t, kernel) in enumerate(schedule):
+    for i, (t, key) in enumerate(schedule):
         now = time.perf_counter() - t0
         if t > now:
             time.sleep(t - now)
-        args, statics = prepared[kernel]
+        kname, args, statics = prepared[key]
         s0 = time.perf_counter()
-        if dispatch_patiently(cli, kernel, args, statics,
+        if dispatch_patiently(cli, kname, args, statics,
                               _rid(f"{i:05d}")):
             s1 = time.perf_counter()
-            obs_metrics.inc(f"slo.requests.{_mk(kernel)}")
-            obs_metrics.observe(f"slo.latency_s.{_mk(kernel)}",
+            obs_metrics.inc(f"slo.requests.{_mk(kname)}")
+            obs_metrics.observe(f"slo.latency_s.{_mk(kname)}",
                                 (s1 - t0) - t)
-            obs_metrics.observe(f"slo.service_s.{_mk(kernel)}",
+            obs_metrics.observe(f"slo.service_s.{_mk(kname)}",
                                 s1 - s0)
     # re-ping AFTER the dispatches: the daemon resolves device_kind /
     # jax lazily on its first dispatch, and the verdict record should
@@ -575,10 +651,28 @@ def main(argv=None):
     except ValueError as e:
         print(f"loadgen: bad value for {a}: {e}", file=sys.stderr)
         return 2
+    replay = None
     if shape_class not in ("probe", "record"):
-        print(f"loadgen: --shapes {shape_class!r} (known: probe, "
-              "record)", file=sys.stderr)
-        return 2
+        # anything else names a replay-spec FILE (docstring has the
+        # format) — the adaptive-bucket canary's lane
+        if serve_sock is None:
+            print("loadgen: a --shapes replay spec requires --serve "
+                  "(it replays observed traffic against a daemon's "
+                  "bucket table)", file=sys.stderr)
+            return 2
+        if kernel is not None or mix_raw is not None:
+            print("loadgen: --kernel/--mix don't combine with a "
+                  "replay spec (the file IS the mix)",
+                  file=sys.stderr)
+            return 2
+        try:
+            replay, replay_mix = _load_replay(shape_class)
+        except (OSError, ValueError) as e:
+            print(f"loadgen: --shapes {shape_class!r}: {e} (known "
+                  "classes: probe, record; anything else must be a "
+                  "readable replay-spec file)", file=sys.stderr)
+            return 2
+        shape_class = "replay"
     if rate <= 0:
         print("loadgen: --rate must be > 0", file=sys.stderr)
         return 2
@@ -612,7 +706,8 @@ def main(argv=None):
     try:
         if seed is None:
             seed = default_seed()
-        mix = _parse_mix(mix_raw, kernel)
+        mix = (replay_mix if replay is not None
+               else _parse_mix(mix_raw, kernel))
         schedule = build_schedule(
             seed, arrivals, rate, requests, duration, mix, period
         )
@@ -654,7 +749,8 @@ def main(argv=None):
                 serve_stats = run_serve(schedule, shape_class,
                                         serve_sock, echo, seed=seed,
                                         tenant=tenant,
-                                        priority=priority)
+                                        priority=priority,
+                                        replay=replay)
             except (OSError, serve_protocol.ProtocolError) as e:
                 print(f"loadgen: serve daemon at {serve_sock} "
                       f"unreachable: {e}", file=sys.stderr)

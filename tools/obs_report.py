@@ -427,6 +427,61 @@ def copy_section(events, out):
             out.append(f"    {flag}")
 
 
+def adapt_section(events, out):
+    """Traffic-adaptive bucket loop (docs/SERVING.md §adaptive
+    buckets): the latest proposal / canary / promotion evidence from
+    the journal, plus the live ``serve.bucket_pad_frac`` aggregate
+    judged against ``TPK_ADAPT_PAD_TARGET`` — the operator's one-look
+    answer to "is the promoted table still earning its keep"."""
+    from tpukernels.serve import adapt as _adapt
+
+    latest = {}
+    for e in events:
+        if e.get("kind") in ("adapt_proposed", "adapt_canary",
+                             "adapt_promoted", "adapt_rejected"):
+            latest[e["kind"]] = e
+    live = _adapt.histogram_pad_frac(events)
+    if not latest and live is None:
+        return
+
+    def _pf(v):
+        return f"{v:.3f}" if isinstance(v, (int, float)) else "n/a"
+
+    out.append("")
+    out.append("== adaptive buckets ==")
+    p = latest.get("adapt_proposed")
+    if p:
+        out.append(
+            f"  proposed: {len(p.get('proposals') or [])} action(s) "
+            f"over {p.get('requests_mined')} mined request(s), "
+            "projected pad_frac "
+            f"{_pf((p.get('before') or {}).get('pad_frac'))} -> "
+            f"{_pf((p.get('after') or {}).get('pad_frac'))} "
+            f"(target {p.get('pad_target')})"
+        )
+    c = latest.get("adapt_canary")
+    if c:
+        out.append(f"  canary: promote={c.get('promote')} - "
+                   f"{c.get('reason')}")
+    pr = latest.get("adapt_promoted")
+    if pr:
+        out.append(f"  promoted: {pr.get('table')} (measured "
+                   f"pad_frac {_pf(pr.get('pad_frac'))})")
+    rj = latest.get("adapt_rejected")
+    if rj:
+        out.append(f"  rejected: {rj.get('reason')}")
+    if live is not None:
+        try:
+            target = _adapt.pad_target()
+        except ValueError:
+            target = None
+        line = f"  live serve.bucket_pad_frac {_pf(live)}"
+        if target is not None:
+            line += (" below target " if live < target
+                     else " AT-OR-OVER target ") + str(target)
+        out.append(line)
+
+
 def reqtrace_section(events, out):
     """Request-phase table from the assembled per-request timelines
     (docs/OBSERVABILITY.md §request tracing): phase-attribution
@@ -673,6 +728,19 @@ def main(argv=None):
         }
         for name in trace_low:
             print(f"{name}: trace_coverage (non-gating)")
+        # a promoted bucket table that stops delivering its measured
+        # pad_frac gates like a regression too: the promotion was a
+        # >3%-margin claim about live traffic, and the journal's
+        # post-promotion serve_request evidence is the recount
+        # (docs/SERVING.md §adaptive buckets)
+        pad_bad = {
+            n: v for n, v in trend.analyze_pad_waste(events).items()
+            if v["verdict"] == "pad_waste_regression"
+        }
+        for name, v in pad_bad.items():
+            print(f"{name}: pad_waste_regression")
+            for flag in v["flags"]:
+                print(f"  {flag}")
         # validated (non-fake) bus-bw scaling series gate exactly like
         # bench trends — the paper's multi-chip headline must not be
         # the one layer that can regress silently
@@ -704,12 +772,13 @@ def main(argv=None):
             f"{len(breaches)} confirmed SLO breach(es), "
             f"{len(scaling_bad)} scaling regression(s), "
             f"{len(copy_bad)} copy-budget regression(s), "
+            f"{len(pad_bad)} pad-waste regression(s), "
             f"{len(trace_bad)} trace inconsistenc(ies), "
             f"{len(trace_low)} trace-coverage (non-gating), "
             f"{len(below_eff)} below-scaling-efficiency (non-gating)"
         )
         return 1 if (bad or corrupt or breaches or scaling_bad
-                     or copy_bad or trace_bad) else 0
+                     or copy_bad or pad_bad or trace_bad) else 0
 
     if roofline_only:
         out = []
@@ -729,6 +798,10 @@ def main(argv=None):
         n: v for n, v in trend.analyze_trace_budget(events).items()
         if v["verdict"] == "trace_inconsistent"
     }
+    pad_bad = {
+        n: v for n, v in trend.analyze_pad_waste(events).items()
+        if v["verdict"] == "pad_waste_regression"
+    }
     trend_section(verdicts, out)
     roofline_section(verdicts, out)
     span_section(events, out)
@@ -738,16 +811,17 @@ def main(argv=None):
     slo_section(out)
     scaling_section(scaling_analysis, out)
     copy_section(events, out)
+    adapt_section(events, out)
     reqtrace_section(events, out)
     shapes_section(events, out)
     metrics_section(events, out)
     out.append("")
-    if bad or scaling_bad or copy_bad or trace_bad:
+    if bad or scaling_bad or copy_bad or pad_bad or trace_bad:
         out.append(
             "VERDICT: " + "; ".join(
                 f"{n} {v['verdict']}"
                 for n, v in {**bad, **scaling_bad, **copy_bad,
-                             **trace_bad}.items()
+                             **pad_bad, **trace_bad}.items()
             )
         )
     else:
@@ -760,7 +834,8 @@ def main(argv=None):
             )
         )
     print("\n".join(out))
-    return 1 if bad or scaling_bad or copy_bad or trace_bad else 0
+    return 1 if (bad or scaling_bad or copy_bad or pad_bad
+                 or trace_bad) else 0
 
 
 if __name__ == "__main__":

@@ -10,6 +10,11 @@ Usage:
                                                 # per metric)
     python tools/prewarm.py --check             # machine mode (rc only
                                                 # prints failures)
+    python tools/prewarm.py --order traffic     # hottest kernels first
+                                                # (journal serve_request
+                                                # frequency; registry
+                                                # order when no traffic
+                                                # evidence exists)
 
 Compiles the whole suite OFF-window so a healthy flap window opens
 with a hot cache: the registry-level pass lowers every kernel's
@@ -24,6 +29,12 @@ Every kernel lands a ``prewarm_kernel`` journal event whose measured
 walls feed the supervisor's chip-minute cost estimate for the
 ``prewarm_all`` step (tools/revalidate.py); the run is bracketed by
 ``prewarm_start`` / ``prewarm_end``.
+
+``--order traffic`` re-ranks the compile queue by live request
+frequency (the journal's ``serve_request`` records, via
+``tpukernels.serve.adapt.traffic_order``) so a prewarm cut short by
+its window still warmed what traffic actually hits; with no traffic
+evidence it says so on stderr and keeps registry order.
 
 Exit codes mirror ``tools/obs_report.py --check``:
     0 — everything asked for compiled (warm cache, go measure);
@@ -78,6 +89,7 @@ def main(argv=None):
     kernels = None
     bench_metrics: list = []
     timeout_s = 900.0
+    order = "registry"
     it = iter(argv)
     try:
         for a in it:
@@ -89,6 +101,8 @@ def main(argv=None):
                                  if m.strip()]
             elif a == "--timeout-s":
                 timeout_s = float(next(it))
+            elif a == "--order":
+                order = next(it)
             elif a != "--check":
                 print(__doc__, file=sys.stderr)
                 print(f"prewarm: unknown argument {a!r}", file=sys.stderr)
@@ -98,6 +112,10 @@ def main(argv=None):
         return 2
     except ValueError:
         print(f"prewarm: {a} needs a numeric value", file=sys.stderr)
+        return 2
+    if order not in ("registry", "traffic"):
+        print(f"prewarm: --order {order!r} (known: registry, traffic)",
+              file=sys.stderr)
         return 2
     if not aot.enabled():
         # a prewarm that silently compiles nothing would read as a hot
@@ -120,6 +138,22 @@ def main(argv=None):
             print(f"prewarm: unknown/unprecompilable kernels {unknown}; "
                   f"known: {known}", file=sys.stderr)
             return 2
+    if order == "traffic":
+        from tpukernels.resilience.journal import load_events
+        from tpukernels.serve import adapt
+
+        events, _bad = load_events(
+            [journal.path() or journal.default_path()]
+        )
+        kernels, counts = adapt.traffic_order(events, kernels)
+        if counts:
+            print("prewarm: traffic order "
+                  + ", ".join(f"{k}={counts.get(k, 0)}"
+                              for k in kernels))
+        else:
+            print("prewarm: --order traffic but the journal holds no "
+                  "serve_request evidence - keeping registry order",
+                  file=sys.stderr)
     from bench import BENCH_METRICS  # noqa: E402 — after cache env setup
 
     metric_names = [n for n, _f in BENCH_METRICS]

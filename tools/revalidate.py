@@ -79,7 +79,7 @@ PRODUCTION_QUEUE = [
 set -o pipefail
 prewarm_log="docs/logs/prewarm_$(date +%Y-%m-%d_%H%M%S).log"
 if timeout -k 10 3540 python tools/prewarm.py --bench all --check \\
-    --timeout-s 420 >"$prewarm_log" 2>&1; then
+    --order traffic --timeout-s 420 >"$prewarm_log" 2>&1; then
   tail -1 "$prewarm_log"
 else
   echo "WARN: prewarm_all failed rc=$? (non-gating) -" \\
@@ -408,6 +408,47 @@ python tools/serve_ctl.py fsck
 """, gating=False, stamp="daily", timeout_s=120, cost_min=1, value=2,
       needs_chip=False,
       inputs=("tpukernels/serve", "tools/serve_ctl.py")),
+    # 3e. traffic-adaptive bucket proposal (docs/SERVING.md §adaptive
+    #     buckets): mine the day's serve_request shape mix and persist
+    #     a split/merge candidate when projected pad waste sits over
+    #     TPK_ADAPT_PAD_TARGET. Pure journal arithmetic — CPU-only,
+    #     daily, non-gating; after serve_probe so the day's journal
+    #     holds at least the probe's own traffic evidence.
+    S("adapt_propose", """
+set -o pipefail
+adapt_log="docs/logs/adapt_propose_$(date +%Y-%m-%d_%H%M%S).log"
+if timeout -k 10 240 env JAX_PLATFORMS=cpu python \\
+    tools/serve_optimize.py propose >"$adapt_log" 2>&1; then
+  tail -1 "$adapt_log"
+else
+  echo "WARN: adapt propose failed rc=$? (non-gating) - $adapt_log"
+  exit 1
+fi
+""", gating=False, stamp="daily", timeout_s=300, cost_min=1, value=2,
+      needs_chip=False, after=("serve_probe",),
+      inputs=("tpukernels/serve", "tools/serve_optimize.py")),
+    # 3f. adaptive-bucket canary (docs/SERVING.md §adaptive buckets):
+    #     re-autotune the candidate table (--autotune quick, the >3%
+    #     margin), boot incumbent + candidate daemons off-window and
+    #     replay the frozen shape mix at identical seeds; promotion
+    #     rewrites buckets.json for the fleet's next undrain. Chip
+    #     time, so after prewarm_all (warm manifest) and after the
+    #     proposal that feeds it; non-gating — a rejected candidate
+    #     is the gate WORKING.
+    S("adapt_canary", """
+set -o pipefail
+adapt_log="docs/logs/adapt_canary_$(date +%Y-%m-%d_%H%M%S).log"
+if timeout -k 10 900 python tools/serve_optimize.py canary \\
+    --autotune quick >"$adapt_log" 2>&1; then
+  tail -2 "$adapt_log"
+else
+  echo "WARN: adapt canary failed rc=$? (non-gating) - $adapt_log"
+  exit 1
+fi
+""", gating=False, stamp="daily", timeout_s=960, cost_min=6, value=11,
+      after=("prewarm_all", "adapt_propose"),
+      inputs=("tpukernels/serve", "tpukernels/tuning",
+              "tools/serve_optimize.py", "tools/loadgen.py")),
     # 4. sanitizer gates: CPU-only rebuild + full gate, then restore
     #    the normal build; last on purpose (lowest density).
 ]

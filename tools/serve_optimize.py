@@ -1,0 +1,380 @@
+"""Traffic-adaptive bucket optimizer CLI (docs/SERVING.md §adaptive
+buckets; the control plane over ``tpukernels/serve/adapt.py``).
+
+Usage:
+    python tools/serve_optimize.py propose [--journal PATH ...]
+                                           [--target F] [--check]
+    python tools/serve_optimize.py canary  [--seed N] [--requests N]
+                                           [--rate R] [--autotune MODE]
+                                           [--margin F] [--check]
+    python tools/serve_optimize.py show
+
+``propose`` (CPU-only, jax never dispatches) mines the health
+journal's ``serve_request`` shape mix, projects it against the
+incumbent ``TPK_SERVE_BUCKETS`` table, and — when the projected mean
+pad_frac sits at or above ``TPK_ADAPT_PAD_TARGET`` and at least
+``TPK_ADAPT_MIN_REQUESTS`` requests back the evidence — persists the
+ranked split/merge candidate as ``adapt.json`` (journal:
+``adapt_proposed``). No traffic, no proposal: a quiet journal exits 0
+saying so.
+
+``canary`` judges a persisted candidate END TO END, off-window: it
+optionally re-autotunes the candidate table's kernels (``--autotune
+smoke|quick``; the >3% tuning margin applies there as everywhere),
+then boots an INCUMBENT daemon and a CANDIDATE daemon on throwaway
+sockets — each with its own side table file, serve dir and journal —
+and replays the candidate's frozen shape mix through ``tools/
+loadgen.py --shapes <replay>`` against both at IDENTICAL seeds (the
+per-entry warm dispatches double as the candidate table's prewarm).
+The measured sides meet :func:`adapt.judge_canary`: promotion needs a
+pad_frac win over the incumbent beyond the tuning layer's
+PROMOTE_MARGIN **and** a strictly better p99. A win atomically
+rewrites the stable ``buckets.json`` the fleet's ``TPK_SERVE_BUCKETS``
+points at (journal: ``adapt_promoted``) — a running router/daemon
+picks it up on ``undrain``, no restart; a loss records
+``adapt_rejected`` and the incumbent file is never touched. Either
+way the verdict lands in ``adapt.json`` and an ``adapt_canary``
+journal event.
+
+Exit codes: 0 — did what the verb asked (including "nothing to do");
+1 — with ``--check``, the canary measured and REJECTED the candidate
+(or a verb's machinery failed); 2 — usage error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpukernels.resilience import journal  # noqa: E402
+from tpukernels.serve import adapt  # noqa: E402
+
+
+def _mine_events(paths):
+    events, bad = journal.load_events(paths)
+    if bad:
+        print(f"# serve_optimize: {bad} unparseable journal line(s) "
+              "skipped", file=sys.stderr)
+    return events
+
+
+def _cmd_propose(journals, target, check):
+    from tpukernels.serve import bucketing
+
+    if target is None:
+        target = adapt.pad_target()
+    need = adapt.min_requests()
+    events = _mine_events(journals)
+    mix = adapt.shape_mix(events)
+    seen = adapt.mix_requests(mix)
+    max_pad = bucketing.max_pad_frac()
+    incumbent = bucketing.bucket_configs()
+    if seen < need:
+        print(f"serve_optimize: {seen} OK serve_request(s) mined < "
+              f"TPK_ADAPT_MIN_REQUESTS={need} - no proposal (a table "
+              "re-shaped around an anecdote would thrash)")
+        return 0
+    before = adapt.project(incumbent, mix, max_pad)
+    hist = adapt.histogram_pad_frac(events)
+    if before["pad_frac"] < target and before["native"] == 0:
+        print(f"serve_optimize: projected pad_frac "
+              f"{before['pad_frac']:.3f} already below target "
+              f"{target} over {seen} request(s) - no proposal")
+        return 0
+    result = adapt.propose(mix, incumbent, target, max_pad=max_pad)
+    if not result["proposals"]:
+        print("serve_optimize: no split/merge improves on the "
+              f"incumbent (pad_frac {before['pad_frac']:.3f}, "
+              f"{before['native']} native) - no proposal")
+        return 0
+    p = adapt.record_candidate(result, mix, target)
+    journal.emit(
+        "adapt_proposed", path=p, requests_mined=seen,
+        pad_target=target,
+        hist_pad_frac=hist,
+        proposals=[
+            {"action": a["action"], "kernel": a["kernel"],
+             "waste_saved": a["waste_saved"],
+             "compiles": a["compiles"]}
+            for a in result["proposals"]
+        ],
+        before=result["before"], after=result["after"],
+    )
+    splits = sum(a["action"] == "split" for a in result["proposals"])
+    merges = len(result["proposals"]) - splits
+    print(f"serve_optimize: proposed {splits} split(s), {merges} "
+          f"merge(s) over {seen} request(s): projected pad_frac "
+          f"{result['before']['pad_frac']:.3f} -> "
+          f"{result['after']['pad_frac']:.3f} (target {target}), "
+          f"native {result['before']['native']} -> "
+          f"{result['after']['native']} -> {os.path.relpath(p)}")
+    print("serve_optimize: next: python tools/serve_optimize.py "
+          "canary")
+    return 0
+
+
+def _spawn_daemon(sock, table_path, side_dir, side_journal):
+    """One canary-side daemon on a throwaway socket: its own table
+    file, serve dir and journal, inheriting everything else (platform
+    knobs included) from this process."""
+    env = dict(os.environ)
+    env["TPK_SERVE_BUCKETS"] = table_path
+    env["TPK_SERVE_DIR"] = side_dir
+    env["TPK_HEALTH_JOURNAL"] = side_journal
+    env.pop("TPK_SERVE_SOCKET", None)  # --socket is authoritative
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpukernels.serve", "--socket", sock],
+        cwd=_REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _wait_ready(proc, sock, timeout_s=60.0):
+    from tpukernels.serve import client as serve_client
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"canary daemon died rc={proc.returncode}: "
+                f"{(proc.communicate()[1] or '').strip()[-500:]}"
+            )
+        try:
+            with serve_client.ServeClient(sock, timeout_s=5) as c:
+                c.ping()
+            return
+        except OSError:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"canary daemon at {sock} never answered ping"
+                )
+            time.sleep(0.1)
+
+
+def _reap(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(10)
+
+
+def _run_side(name, table, replay_path, seed, requests, rate, tmp,
+              echo):
+    """Boot one side's daemon, replay the frozen mix through loadgen
+    at ``seed``, reap, and measure the side's isolated journal."""
+    side_dir = os.path.join(tmp, name)
+    os.makedirs(side_dir, exist_ok=True)
+    table_path = os.path.join(side_dir, "buckets.json")
+    with open(table_path, "w") as f:
+        json.dump(table, f)
+    sock = os.path.join(side_dir, "s.sock")
+    side_journal = os.path.join(side_dir, "health.jsonl")
+    proc = _spawn_daemon(sock, table_path, side_dir, side_journal)
+    try:
+        _wait_ready(proc, sock)
+        env = dict(os.environ)
+        env["TPK_HEALTH_JOURNAL"] = side_journal
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "loadgen.py"),
+             "--serve", sock, "--shapes", replay_path,
+             "--seed", str(seed), "--requests", str(requests),
+             "--rate", str(rate)],
+            cwd=_REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"{name} replay loadgen rc={r.returncode}: "
+                f"{(r.stdout or '').strip()[-500:]}"
+            )
+    finally:
+        _reap(proc)
+    side = adapt.measured_side(_mine_events([side_journal]))
+    echo(f"# {name}: pad_frac="
+         + (f"{side['pad_frac']:.4f}" if side["pad_frac"] is not None
+            else "n/a")
+         + " p99="
+         + (f"{side['p99_s'] * 1e3:.2f}ms" if side["p99_s"] is not None
+            else "n/a")
+         + f" over {side['requests']} request(s), "
+         f"{side['bucketed']} bucketed")
+    return side
+
+
+def _cmd_canary(seed, requests, rate, autotune, margin, check):
+    cand = adapt.load()
+    if cand is None:
+        print("serve_optimize: no valid adapt.json candidate - run "
+              "propose first (a stale/torn one was rejected loudly "
+              "above)")
+        return 1 if check else 0
+    if cand.get("status") != "proposed":
+        print(f"serve_optimize: candidate already judged "
+              f"(status {cand.get('status')!r}) - propose again for "
+              "a fresh one")
+        return 0
+    replay = cand.get("replay") or []
+    if not replay:
+        print("serve_optimize: candidate has no replay entries - "
+              "nothing measurable", file=sys.stderr)
+        return 1
+    from tpukernels.serve import bucketing
+
+    incumbent = bucketing.bucket_configs()
+    echo = print
+    if autotune != "off":
+        from tpukernels.tuning import runner
+
+        echo(f"# re-autotuning candidate table kernels "
+             f"({autotune})...")
+        runner.tune_table(
+            cand["table"], smoke=autotune == "smoke",
+            quick=autotune == "quick", echo=echo,
+        )
+    tmp = tempfile.mkdtemp(prefix="tpk_adapt_canary_")
+    try:
+        replay_path = os.path.join(tmp, "replay.json")
+        with open(replay_path, "w") as f:
+            json.dump({"entries": replay}, f)
+        inc_m = _run_side("incumbent", incumbent, replay_path, seed,
+                          requests, rate, tmp, echo)
+        cand_m = _run_side("candidate", cand["table"], replay_path,
+                           seed, requests, rate, tmp, echo)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    verdict = adapt.judge_canary(cand_m, inc_m, margin=margin)
+    journal.emit(
+        "adapt_canary", path=adapt.path(), seed=seed,
+        requests=requests, promote=verdict["promote"],
+        reason=verdict["reason"], pad_win=verdict.get("pad_win"),
+        candidate=cand_m, incumbent=inc_m,
+    )
+    status = "promoted" if verdict["promote"] else "rejected"
+
+    def _stamp(data):
+        data["status"] = status
+        data["canary"] = {
+            "seed": seed, "requests": requests,
+            "verdict": dict(verdict),
+        }
+        return data
+
+    adapt.update(_stamp)
+    if verdict["promote"]:
+        bp = adapt.promote(cand["table"])
+        journal.emit(
+            "adapt_promoted", path=adapt.path(), table=bp,
+            pad_frac=cand_m["pad_frac"], p99_s=cand_m["p99_s"],
+            pad_win=verdict.get("pad_win"), seed=seed,
+        )
+        print(f"serve_optimize: PROMOTED - {verdict['reason']}")
+        print(f"serve_optimize: table -> {os.path.relpath(bp)}; "
+              f"point TPK_SERVE_BUCKETS={bp} and undrain "
+              "(fleetctl undrain / the daemon's undrain op) to pick "
+              "it up live")
+        return 0
+    journal.emit(
+        "adapt_rejected", path=adapt.path(), reason=verdict["reason"],
+        pad_win=verdict.get("pad_win"), candidate=cand_m,
+        incumbent=inc_m,
+    )
+    print(f"serve_optimize: REJECTED - {verdict['reason']} "
+          "(incumbent stays)")
+    return 1 if check else 0
+
+
+def _cmd_show():
+    cand = adapt.load(validate=False)
+    if cand is None:
+        print("serve_optimize: no adapt.json candidate at "
+              + adapt.path())
+        return 0
+    print(json.dumps(cand, indent=2, sort_keys=True))
+    return 0
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if not argv or argv[0] not in ("propose", "canary", "show"):
+        print(__doc__, file=sys.stderr)
+        print("serve_optimize: want a verb: propose | canary | show",
+              file=sys.stderr)
+        return 2
+    verb, rest = argv[0], argv[1:]
+    journals: list = []
+    target = margin = None
+    seed, requests, rate = 7, 60, 50.0
+    autotune = "off"
+    check = False
+    it = iter(rest)
+    try:
+        for a in it:
+            if a == "--journal":
+                journals.append(next(it))
+            elif a == "--target":
+                target = float(next(it))
+            elif a == "--seed":
+                seed = int(next(it))
+            elif a == "--requests":
+                requests = int(next(it))
+            elif a == "--rate":
+                rate = float(next(it))
+            elif a == "--margin":
+                margin = float(next(it))
+            elif a == "--autotune":
+                autotune = next(it)
+            elif a == "--check":
+                check = True
+            else:
+                print(__doc__, file=sys.stderr)
+                print(f"serve_optimize: unknown argument {a!r}",
+                      file=sys.stderr)
+                return 2
+    except StopIteration:
+        print(f"serve_optimize: {a} requires a value", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"serve_optimize: bad value for {a}: {e}",
+              file=sys.stderr)
+        return 2
+    if autotune not in ("off", "smoke", "quick"):
+        print(f"serve_optimize: --autotune {autotune!r} (known: off, "
+              "smoke, quick)", file=sys.stderr)
+        return 2
+    if target is not None and not 0.0 < target <= 1.0:
+        print(f"serve_optimize: --target {target} must be in (0, 1]",
+              file=sys.stderr)
+        return 2
+    # unattended runs land their evidence in the day's journal — the
+    # loadgen/bench/prewarm CLI routing default
+    if os.environ.get("TPK_HEALTH_JOURNAL") is None:
+        os.environ["TPK_HEALTH_JOURNAL"] = journal.default_path()
+    if not journals:
+        journals = [journal.path() or journal.default_path()]
+    try:
+        if verb == "propose":
+            return _cmd_propose(journals, target, check)
+        if verb == "canary":
+            return _cmd_canary(seed, requests, rate, autotune, margin,
+                               check)
+        return _cmd_show()
+    except (RuntimeError, ValueError) as e:
+        print(f"serve_optimize: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

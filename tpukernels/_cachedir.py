@@ -224,6 +224,38 @@ def slo_path(env: dict | None = None) -> str:
     return os.path.join(d, "slo.json")
 
 
+def adapt_dir(env: dict | None = None) -> str:
+    """State directory of the traffic-adaptive bucket optimizer
+    (docs/SERVING.md §adaptive buckets; ``tpukernels/serve/adapt.py``):
+    the candidate artifact (``adapt.json``) and the promoted bucket
+    table (``buckets.json``) live here, beside the caches whose warm
+    path the table shapes — unless ``TPK_ADAPT_DIR`` redirects (tests
+    isolate it per suite run so a rehearsal proposal can never steer
+    the operator's real serving config). Same read-the-env-per-call
+    rule as the tuning/AOT/integrity/SLO/serve paths.
+    """
+    target = os.environ if env is None else env
+    d = target.get("TPK_ADAPT_DIR")
+    if not d:
+        d = target.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            _REPO, ".jax_cache"
+        )
+    return d
+
+
+def adapt_path(env: dict | None = None) -> str:
+    """Path of the candidate artifact (``adapt.json``)."""
+    return os.path.join(adapt_dir(env), "adapt.json")
+
+
+def adapt_buckets_path(env: dict | None = None) -> str:
+    """Path of the PROMOTED bucket table (``buckets.json``) — the
+    stable file an operator points ``TPK_SERVE_BUCKETS`` at so a
+    promotion lands behind an unchanged env value and ``undrain``
+    picks it up live (docs/SERVING.md §adaptive buckets)."""
+    return os.path.join(adapt_dir(env), "buckets.json")
+
+
 def serve_dir(env: dict | None = None) -> str:
     """Runtime directory of the kernel-serving daemon
     (docs/SERVING.md; ``tpukernels/serve/``): the Unix-domain socket

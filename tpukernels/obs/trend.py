@@ -463,6 +463,88 @@ def analyze_trace_budget(events) -> dict:
     return verdicts
 
 
+# live pad_frac may exceed a promotion's measured canary pad by this
+# absolute slack before the promise counts as broken: traffic drifts,
+# and the verdict exists to catch a promotion that never delivered,
+# not to re-litigate every shape-mix wobble
+PAD_WASTE_SLACK = 0.05
+PAD_WASTE_MIN_REQUESTS = 20
+
+
+def analyze_pad_waste(events) -> dict:
+    """Promoted-bucket-table verdicts over the journal: did the live
+    traffic's pad_frac stay at the level the promotion MEASURED
+    (docs/SERVING.md §adaptive buckets)? The ``analyze_copy_budget``
+    pattern — only the latest ``adapt_promoted`` event is judged, and
+    only against the OK ``serve_request`` evidence that postdates it.
+
+    - ``pad_waste_regression`` (GATES like a copy/bench regression):
+      the live mean pad_frac exceeds the promoted table's measured
+      canary pad_frac by more than ``PAD_WASTE_SLACK`` — the
+      promotion's premise (this traffic, this table, this waste) no
+      longer holds, and the optimizer should re-propose.
+    - ``no_data``: a promotion with fewer than
+      ``PAD_WASTE_MIN_REQUESTS`` subsequent requests — drift judged
+      off a handful of dispatches is an anecdote.
+    - ``ok`` otherwise; no ``adapt_promoted`` event yields no verdict
+      at all (an unadapted fleet has made no promise to break)."""
+    promoted = None
+    for e in events:
+        if e.get("kind") == "adapt_promoted":
+            promoted = e
+    if promoted is None:
+        return {}
+    promised = promoted.get("pad_frac")
+    if not _is_measurement(promised):
+        return {}
+    t0 = promoted.get("t")
+    pads = []
+    seen_promo = False
+    for e in events:
+        if e is promoted:
+            seen_promo = True
+            continue
+        if e.get("kind") != "serve_request" or not e.get("ok"):
+            continue
+        t = e.get("t")
+        if _is_measurement(t) and _is_measurement(t0):
+            if t < t0:
+                continue
+        elif not seen_promo:
+            continue  # no timestamps: fall back to journal order
+        pads.append(float(e.get("pad_frac") or 0.0))
+    name = f"pad_waste[{os.path.basename(str(promoted.get('table') or 'buckets.json'))}]"
+    flags = []
+    if len(pads) < PAD_WASTE_MIN_REQUESTS:
+        verdict = "no_data"
+        live = (sum(pads) / len(pads)) if pads else None
+        flags.append(
+            f"{len(pads)} request(s) since the promotion < min "
+            f"{PAD_WASTE_MIN_REQUESTS} - no drift verdict yet"
+        )
+    else:
+        live = sum(pads) / len(pads)
+        if live > promised + PAD_WASTE_SLACK:
+            verdict = "pad_waste_regression"
+            flags.append(
+                f"PAD WASTE REGRESSION: live mean pad_frac {live:.3f} "
+                f"over {len(pads)} request(s) exceeds the promoted "
+                f"table's measured {promised:.3f} by more than "
+                f"{PAD_WASTE_SLACK} - the traffic has drifted off the "
+                "promoted buckets; re-propose"
+            )
+        else:
+            verdict = "ok"
+    return {name: {
+        "verdict": verdict,
+        "promised_pad_frac": promised,
+        "live_pad_frac": round(live, 6) if live is not None else None,
+        "requests": len(pads),
+        "slack": PAD_WASTE_SLACK,
+        "flags": flags,
+    }}
+
+
 def analyze_repo(root, eps=CEILING_EPS) -> dict:
     """One-call path for tools: series + baseline + verdicts."""
     return analyze(load_series(root), load_baseline(root), eps=eps)

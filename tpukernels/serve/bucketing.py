@@ -38,7 +38,14 @@ correct first, warm second.
 ``TPK_SERVE_BUCKETS`` (inline JSON or a file path, the
 ``TPK_FAULT_PLAN`` convention) overrides the avatar table — how the
 CPU tests prove the pad math without materializing the record shapes,
-and how an operator serves a custom shape population.
+and how an operator serves a custom shape population. A table value
+may be one avatar spec (the historical shape) or a LIST of specs —
+what the traffic-adaptive optimizer's bucket SPLITS produce
+(docs/SERVING.md §adaptive buckets); a request lands on the fitting
+avatar with the least padding. A promoted table arrives as a changed
+FILE behind the unchanged ``TPK_SERVE_BUCKETS`` path, so
+:func:`reload` (called by the router/daemon on ``undrain``) busts the
+parse cache and picks it up without a fleet restart.
 
 Stdlib + numpy only; the avatar table comes from ``tpukernels.aot``
 (stdlib at import).
@@ -125,6 +132,35 @@ def bucket_configs() -> dict:
     return table
 
 
+def reload():
+    """Bust the parse-once config cache and re-read the table — the
+    promoted-table pickup hook (docs/SERVING.md §adaptive buckets).
+    The cache is keyed on the RAW env value, so a promotion that
+    atomically rewrites the file behind a stable ``TPK_SERVE_BUCKETS``
+    path is invisible until this runs; the router and daemon call it
+    on ``undrain``, the operator's "config changed" signal. Raises
+    like :func:`bucket_configs` on a malformed table — an undrain
+    must not silently serve yesterday's avatars — but a reload that
+    FAILS leaves the previously parsed table in effect, so one torn
+    promotion cannot take the request path down with it."""
+    old = dict(_CONFIG_CACHE)
+    _CONFIG_CACHE["raw"] = _CONFIG_CACHE["table"] = None
+    try:
+        return bucket_configs()
+    except (OSError, ValueError):
+        _CONFIG_CACHE.update(old)
+        raise
+
+
+def kernel_specs(kernel: str) -> list:
+    """The kernel's avatar specs as a list — one entry for the
+    historical single-spec table shape, N after an adaptive split."""
+    spec = bucket_configs().get(kernel)
+    if spec is None:
+        return []
+    return list(spec) if isinstance(spec, list) else [spec]
+
+
 def _spec_args(spec):
     """[(dtype_name, shape_tuple), ...] for one avatar spec (tolerates
     JSON lists where BENCH_CONFIGS has tuples)."""
@@ -136,20 +172,45 @@ def _spec_args(spec):
 
 
 def bucket_for(kernel: str, arrays, statics: dict):
-    """Match one request against the kernel's avatar.
+    """Match one request against the kernel's avatar(s).
 
     ``arrays`` are the request's numpy operands (0-d = host scalar).
     Returns ``(spec, pad_frac)`` when the request buckets — ``spec``
     is the avatar entry, ``pad_frac`` the wasted-element fraction
     (0.0 for an exact fit) — or ``(None, reason)`` when it must
     dispatch natively. Pad-up only: any dim over the avatar's is a
-    non-match, never a truncation."""
+    non-match, never a truncation. A kernel with SEVERAL avatars (an
+    adaptively split table) lands the request on the fitting avatar
+    with the LEAST padding — the projected-cost rule the optimizer's
+    proposal math assumes (``tpukernels/serve/adapt.py``)."""
     try:
-        spec = bucket_configs().get(kernel)
+        raw = bucket_configs().get(kernel)
     except (OSError, ValueError) as e:
         raise ValueError(f"TPK_SERVE_BUCKETS: {e}") from None
-    if spec is None:
+    if raw is None:
         return None, "no-avatar"
+    specs = list(raw) if isinstance(raw, list) else [raw]
+    if not specs:
+        return None, "no-avatar"
+    best = reason = None
+    for spec in specs:
+        got, how = _match_one(kernel, arrays, statics, spec)
+        if got is None:
+            if reason is None:
+                reason = how  # first avatar's reason, deterministic
+            continue
+        if how == 0.0:
+            return got, 0.0  # exact fit: nothing beats zero pad
+        if best is None or how < best[1]:
+            best = (got, how)
+    if best is not None:
+        return best
+    return None, reason
+
+
+def _match_one(kernel: str, arrays, statics: dict, spec):
+    """One request against ONE avatar spec — the single-avatar match
+    body :func:`bucket_for` ranks over."""
     want = _spec_args(spec)
     if len(want) != len(arrays):
         return None, "arg-count-mismatch"

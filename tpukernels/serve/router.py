@@ -743,6 +743,23 @@ class Router:
                 self._draining.discard(idx)
                 self._cooldown.pop(idx, None)
             inflight = self._inflight[idx]
+        if op == "undrain":
+            # undrain is the operator's "config changed" signal: a
+            # promoted adaptive bucket table lands as a rewritten file
+            # behind the unchanged TPK_SERVE_BUCKETS path, and the
+            # router hashes buckets itself (spec_stubs/bucket_for) —
+            # re-read it NOW or keep routing on yesterday's avatars
+            # (docs/SERVING.md §adaptive buckets). A malformed table
+            # answers as a control-channel error (the __init__
+            # fail-fast rule, surfaced to the operator who undrained)
+            # and the old parsed table stays in effect.
+            try:
+                bucketing.reload()
+            except (OSError, ValueError) as e:
+                return {"v": protocol.VERSION, "ok": False,
+                        "kind": "error",
+                        "error": f"undrain refused: TPK_SERVE_BUCKETS "
+                                 f"reload failed: {e}"}
         if op == "undrain" and self._health is not None:
             # the operator restored this worker on purpose: forget its
             # crash window and quarantine — the next probe pass

@@ -430,6 +430,8 @@ class Server:
                                      request=header.get("id"))
                     self._admit(conn, header, payloads,
                                 inline_bytes=inline_bytes)
+                elif op == "undrain":
+                    conn.send(self._undrain())
                 else:
                     conn.send({"v": protocol.VERSION,
                                "id": header.get("id"), "ok": False,
@@ -442,6 +444,31 @@ class Server:
                 conn.sock.close()
             except OSError:
                 pass
+
+    def _undrain(self) -> dict:
+        """The standalone daemon's promoted-table pickup (the router
+        forwards nothing here — its own ``undrain`` busts its own
+        cache): re-read TPK_SERVE_BUCKETS through ``bucketing.reload``
+        and drop the avatar-shaped pad staging pool, whose buffers
+        were sized for the OLD table's buckets. A malformed new table
+        answers as an error and the old one stays in effect
+        (docs/SERVING.md §adaptive buckets)."""
+        try:
+            table = bucketing.reload()
+        except (OSError, ValueError) as e:
+            return {"v": protocol.VERSION, "ok": False,
+                    "kind": "error",
+                    "error": f"undrain refused: TPK_SERVE_BUCKETS "
+                             f"reload failed: {e}"}
+        with self._lock:
+            self._pad_pool.clear()
+        journal.emit(
+            "serve_drain", worker=None, socket=self.socket_path,
+            phase="undrain", inflight=len(self._inflight),
+            kernels=sorted(table),
+        )
+        return {"v": protocol.VERSION, "ok": True,
+                "reloaded": sorted(table)}
 
     def _stats(self) -> dict:
         with self._lock:

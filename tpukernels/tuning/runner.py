@@ -413,3 +413,46 @@ def tune(
         "cache_path": tcache.path(),
         "pruned": pruned,
     }
+
+
+def tune_table(
+    table: dict,
+    smoke: bool = False,
+    quick: bool = False,
+    max_candidates: int | None = None,
+    timeout_s: float | None = None,
+    base_env: dict | None = None,
+    echo=None,
+):
+    """Sweep every tunable kernel a bucket TABLE names — the
+    adaptive-bucket canary's re-autotune step (docs/SERVING.md
+    §adaptive buckets): a candidate table changes the shapes the fleet
+    compiles for, so the tuned knobs deserve a fresh look before the
+    canary measures it. Same promotion rule as :func:`tune` (the >3%
+    margin per kernel); kernels with no declared bench metric are
+    skipped loudly, never an error — a table is allowed to bucket
+    kernels that don't bench. Returns ``{kernel: summary-or-None}``
+    (None = skipped)."""
+    from tpukernels import registry
+
+    echo = echo or (lambda line: None)
+    out = {}
+    for kernel in sorted(table):
+        try:
+            space = registry.tunables(kernel)
+        except KeyError:
+            echo(f"# tune_table: {kernel!r} not in the registry, "
+                 "skipped")
+            out[kernel] = None
+            continue
+        if space.metric is None:
+            echo(f"# tune_table: {kernel} declares no bench metric, "
+                 "skipped")
+            out[kernel] = None
+            continue
+        out[kernel] = tune(
+            kernel, smoke=smoke, quick=quick,
+            max_candidates=max_candidates, timeout_s=timeout_s,
+            base_env=base_env, echo=echo,
+        )
+    return out
