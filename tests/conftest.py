@@ -255,6 +255,16 @@ if "TPK_SCALING_DIR" not in os.environ:
 # their own values.
 os.environ.pop("TPK_ADAPT_PAD_TARGET", None)
 os.environ.pop("TPK_ADAPT_MIN_REQUESTS", None)
+# An exported multi-day mining window would make every single-journal
+# proposal test silently fold an operator's rollup series — tests
+# that exercise the window pin their own value.
+os.environ.pop("TPK_ADAPT_WINDOW_DAYS", None)
+# An exported flush interval would start the periodic metrics flusher
+# (docs/OBSERVABILITY.md §live telemetry) in EVERY test process and
+# its children, interleaving metrics_snapshot noise into journals the
+# tests assert on byte-for-byte — tests that exercise the flusher set
+# it explicitly on their own subprocesses.
+os.environ.pop("TPK_METRICS_FLUSH_S", None)
 if "TPK_ADAPT_DIR" not in os.environ:
     import tempfile
 
@@ -266,6 +276,32 @@ if "TPK_ADAPT_DIR" not in os.environ:
     for _f in ("adapt.json", "buckets.json"):
         try:  # a previous suite run's candidate must not steer this one
             os.unlink(os.path.join(_adapt_dir, _f))
+        except OSError:
+            pass
+
+# Isolate the daily-rollup series dir (docs/OBSERVABILITY.md §daily
+# rollups) the same way: rollup CLI runs spawned by tests write
+# rollup_<date>.json artifacts, and test noise must never land beside
+# the repo's committed docs/logs series — the files p99_creep and
+# multi-day adapt mining read. Stale artifacts from a previous suite
+# run are cleared so determinism/series assertions start clean. Tests
+# that assert series contents point TPK_ROLLUP_DIR at their own tmp
+# path.
+if "TPK_ROLLUP_DIR" not in os.environ:
+    import tempfile
+
+    _rollup_dir = os.path.join(
+        tempfile.gettempdir(), f"tpk_rollup_test_{os.getuid()}"
+    )
+    os.makedirs(_rollup_dir, exist_ok=True)
+    os.environ["TPK_ROLLUP_DIR"] = _rollup_dir
+    import glob as _rollup_glob
+
+    for _f in _rollup_glob.glob(
+        os.path.join(_rollup_dir, "rollup_*.json")
+    ):
+        try:  # a previous suite run's artifacts must not accumulate
+            os.unlink(_f)
         except OSError:
             pass
 

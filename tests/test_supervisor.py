@@ -736,7 +736,7 @@ def test_production_queue_is_wellformed():
         "prewarm"
     # CPU-only steps must say so (they must never wait on a window)
     for name in ("obs_check", "autotune_smoke", "adapt_propose",
-                 "san_asan", "san_ubsan"):
+                 "rollup_daily", "san_asan", "san_ubsan"):
         assert not next(s for s in q if s.name == name).needs_chip
     # the adaptive-bucket canary spends chip time on a measured
     # verdict: it must wait for the proposal AND a warm manifest
@@ -804,3 +804,12 @@ def test_production_plan_order_reproduces_next_md(tmp_path,
     assert order.index("adapt_propose") > order.index("serve_probe")
     assert order.index("adapt_canary") > order.index("adapt_propose")
     assert order.index("adapt_canary") < order.index("knob_sanity")
+    # the daily rollup feeds the multi-day miner, so it must land
+    # before adapt_propose (docs/OBSERVABILITY.md §daily rollups)
+    assert order.index("rollup_daily") < order.index("adapt_propose")
+    assert order.index("rollup_daily") < order.index("san_asan")
+    rollup_spec = next(s for s in cli.PRODUCTION_QUEUE
+                       if s.name == "rollup_daily")
+    assert not rollup_spec.gating
+    assert rollup_spec.stamp == "daily"
+    assert "tpukernels.obs.rollup" in rollup_spec.shell

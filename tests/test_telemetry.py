@@ -1,0 +1,613 @@
+"""CPU suite for live fleet telemetry (docs/OBSERVABILITY.md §live
+telemetry / §daily rollups; ISSUE 18).
+
+Covers the streaming-snapshot plane end to end: the periodic flusher's
+delta/seq encoding and the one shared ``merge_journal_metrics`` fold
+(final ``metrics`` event authoritative, deduped by (pid, seq), the
+two encodings NEVER summed), the byte-identical-stdout proof with the
+flusher on vs off, the read-only ``stats`` op against a live daemon
+and a live 2-worker fleet mid-burst (``serve_ctl top --once`` renders
+nonzero rows for every worker), the kill -9 acceptance (a SIGKILLed
+worker's last snapshot — at most one flush interval old — survives
+into ``obs_report``), daily-rollup determinism + torn/stale/date
+rejection, the NON-GATING ``p99_creep`` long-horizon verdict, and
+multi-day adapt mining (``TPK_ADAPT_WINDOW_DAYS``) including a
+``serve_optimize propose`` that mines a valid candidate from a 3-day
+rollup window with no same-day serve traffic.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_distributed import _scrubbed_env
+from test_fleet import _ctl, _fleet, _scan_case
+from test_serve import SCAN_BUCKET, _daemon, _events
+
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.resilience import journal as _journal
+from tpukernels.serve import adapt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _snap(pid, seq, counters, hists=None, gauges=None):
+    return {"kind": "metrics_snapshot", "pid": pid, "seq": seq,
+            "site": "flush:t", "counters": counters,
+            "gauges": gauges or {}, "histograms": hists or {}}
+
+
+def _req_line(kernel, n, pad_frac, wall_s, pid=101, ok=True):
+    """One synthetic journal serve_request line (vector_add-shaped:
+    scalar + two length-n operands)."""
+    return {"kind": "serve_request", "pid": pid, "kernel": kernel,
+            "ok": ok, "shapes": [[], [n], [n]],
+            "dtypes": ["float32"] * 3, "pad_frac": pad_frac,
+            "bucketed": True, "wall_s": wall_s, "t": 0.0}
+
+
+# ---------------------------------------------------------------- #
+# snapshot encoding: seq, deltas, the shared merge fold            #
+# ---------------------------------------------------------------- #
+
+def test_snapshot_delta_and_seq_arithmetic(tmp_path, monkeypatch):
+    """Counters ride as DELTAS, histograms full-cumulative only when
+    moved, seq is monotonic, and the merge fold reconstructs the
+    exact totals."""
+    jp = tmp_path / "health.jsonl"
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", str(jp))
+    obs_metrics.reset()
+    try:
+        # nothing ever recorded: no event, no age
+        assert obs_metrics.emit_periodic_snapshot("t") is None
+        assert obs_metrics.last_flush_age_s() is None
+        obs_metrics.inc("t.a", 2)
+        obs_metrics.inc("t.b")
+        obs_metrics.observe("t.h", 0.5)
+        assert obs_metrics.emit_periodic_snapshot("t") == 1
+        assert obs_metrics.last_flush_age_s() < 5.0
+        obs_metrics.inc("t.a", 3)
+        assert obs_metrics.emit_periodic_snapshot("t") == 2
+        obs_metrics.observe("t.h", 1.0)
+        assert obs_metrics.emit_periodic_snapshot("t") == 3
+        # no movement at all still emits (the heartbeat), empty deltas
+        assert obs_metrics.emit_periodic_snapshot("t") == 4
+        obs_metrics.emit_snapshot("atexit:test")  # the final word
+        events = _events(jp)
+        snaps = [e for e in events if e["kind"] == "metrics_snapshot"]
+        assert [e["seq"] for e in snaps] == [1, 2, 3, 4]
+        assert snaps[0]["counters"] == {"t.a": 2, "t.b": 1}
+        # second flush: only the moved counter, as a delta; the
+        # unmoved histogram is omitted entirely
+        assert snaps[1]["counters"] == {"t.a": 3}
+        assert snaps[1]["histograms"] == {}
+        # moved histogram rides full-cumulative: latest row stands
+        # alone
+        assert snaps[0]["histograms"]["t.h"]["count"] == 1
+        assert snaps[2]["histograms"]["t.h"]["count"] == 2
+        assert snaps[2]["histograms"]["t.h"]["sum"] == \
+            pytest.approx(1.5)
+        assert snaps[3]["counters"] == {}
+        merged = obs_metrics.merge_journal_metrics(events)
+        st = merged[os.getpid()]
+        assert st["final"]
+        assert st["counters"]["t.a"] == 5
+        assert st["counters"]["t.b"] == 1
+        assert st["histograms"]["t.h"]["count"] == 2
+    finally:
+        obs_metrics.reset()
+
+
+def test_merge_dedupes_by_pid_seq_and_never_sums_final():
+    """The double-count seam, pinned: a pid's final ``metrics`` event
+    SUPERSEDES its snapshot stream (never summed with it), replayed
+    (pid, seq) duplicates fold once, and a pid with no final flush is
+    reconstructed from its deduped stream in seq order."""
+    events = [
+        _snap(1, 1, {"a": 2}),
+        _snap(1, 2, {"a": 3, "b": 1}),
+        _snap(1, 2, {"a": 3, "b": 1}),  # replayed line: folded ONCE
+        {"kind": "metrics", "pid": 1, "site": "atexit:x",
+         "counters": {"a": 100}, "gauges": {}, "histograms": {}},
+        # out-of-order delivery folds in seq order
+        _snap(2, 2, {"a": 30}),
+        _snap(2, 1, {"a": 4}, gauges={"g": 7.0}),
+    ]
+    merged = obs_metrics.merge_journal_metrics(events)
+    # pid 1 streamed AND exited cleanly: the final word wins outright
+    # (2+3+100 == 105 would be the double-count bug)
+    assert merged[1]["final"]
+    assert merged[1]["counters"] == {"a": 100}
+    # pid 2 died hard: deltas summed once each, dedup by (pid, seq)
+    assert not merged[2]["final"]
+    assert merged[2]["seq"] == 2
+    assert merged[2]["counters"] == {"a": 34}
+    assert merged[2]["gauges"] == {"g": 7.0}
+
+
+def test_histogram_pad_frac_pools_across_processes():
+    """The adapt miner's pad histogram reads through the merge fold:
+    sum-of-sums over sum-of-counts across pids, final-vs-snapshot
+    encodings never summed for one pid."""
+    row_a = {"count": 4, "sum": 1.0}
+    events = [
+        _snap(1, 1, {}, hists={"serve.bucket_pad_frac":
+                               {"count": 2, "sum": 0.9}}),
+        {"kind": "metrics", "pid": 1, "site": "atexit:x",
+         "counters": {}, "gauges": {},
+         "histograms": {"serve.bucket_pad_frac": row_a}},
+        _snap(2, 1, {}, hists={"serve.bucket_pad_frac":
+                               {"count": 1, "sum": 0.5}}),
+    ]
+    assert adapt.histogram_pad_frac(events) == pytest.approx(1.5 / 5)
+    assert adapt.histogram_pad_frac([]) is None
+
+
+def test_flush_interval_knob_fail_loud():
+    assert obs_metrics.flush_interval_s({}) is None
+    for raw in ("", " ", "0", "off", "none", "false", "OFF"):
+        assert obs_metrics.flush_interval_s(
+            {"TPK_METRICS_FLUSH_S": raw}) is None
+    assert obs_metrics.flush_interval_s(
+        {"TPK_METRICS_FLUSH_S": "0.25"}) == 0.25
+    for bad in ("-1", "abc", "0x2"):
+        with pytest.raises(ValueError, match="TPK_METRICS_FLUSH_S"):
+            obs_metrics.flush_interval_s({"TPK_METRICS_FLUSH_S": bad})
+
+
+# ---------------------------------------------------------------- #
+# the flusher thread: byte-identical stdout, journal evidence      #
+# ---------------------------------------------------------------- #
+
+def test_flusher_stdout_byte_identical_on_vs_off(tmp_path):
+    """The TPK_TRACE proof pattern: a clean run's stdout is
+    byte-identical with the flusher on vs off — only the journal
+    grows ``metrics_snapshot`` events (auto-started at import from
+    the env knob, no code opt-in)."""
+    body = textwrap.dedent("""
+        import time
+        from tpukernels.obs import metrics
+        for _ in range(8):
+            metrics.inc("proof.ticks")
+            metrics.observe("proof.wall_s", 0.01)
+            time.sleep(0.05)
+        print("proof:", metrics.snapshot()["counters"]["proof.ticks"])
+    """)
+    outs, journals = [], []
+    for tag, extra in (("off", {}),
+                       ("on", {"TPK_METRICS_FLUSH_S": "0.1"})):
+        jp = tmp_path / f"health_{tag}.jsonl"
+        env = _scrubbed_env(None)
+        env["TPK_HEALTH_JOURNAL"] = str(jp)
+        env.update(extra)
+        r = subprocess.run([sys.executable, "-c", body], cwd=REPO,
+                           env=env, capture_output=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        outs.append(r.stdout)
+        journals.append(_events(jp))
+    assert outs[0] == outs[1], "flusher must not perturb stdout"
+    off_ev, on_ev = journals
+    assert [e for e in off_ev if e["kind"] == "metrics_snapshot"] == []
+    snaps = [e for e in on_ev if e["kind"] == "metrics_snapshot"]
+    assert len(snaps) >= 2, "0.4s run at 0.1s interval must flush"
+    assert [e["seq"] for e in snaps] == \
+        list(range(1, len(snaps) + 1))
+    assert all(e["site"].startswith("flush:") for e in snaps)
+    # both runs still carry the unchanged atexit final
+    for evs in journals:
+        final = [e for e in evs if e["kind"] == "metrics"]
+        assert len(final) == 1
+        assert final[0]["counters"]["proof.ticks"] == 8
+    # and the merge agrees with the final on both
+    for evs in journals:
+        (st,) = obs_metrics.merge_journal_metrics(evs).values()
+        assert st["final"] and st["counters"]["proof.ticks"] == 8
+
+
+# ---------------------------------------------------------------- #
+# the stats op: daemon, fleet, serve_ctl top                       #
+# ---------------------------------------------------------------- #
+
+def test_stats_op_daemon_live(tmp_path):
+    """A lone daemon answers the read-only stats op with its live
+    metric snapshot, pad-pool state and flusher age; the ping pong
+    carries ``last_snapshot_age_s`` for ``serve_ctl status``."""
+    from tpukernels.serve import client as serve_client
+
+    extra = {"TPK_SERVE_BUCKETS": SCAN_BUCKET,
+             "TPK_METRICS_FLUSH_S": "0.2"}
+    with _daemon(tmp_path, env_extra=extra) as (sock, journal, proc):
+        x, want = _scan_case()
+        with serve_client.ServeClient(sock, timeout_s=30) as c:
+            np.testing.assert_array_equal(c.dispatch("scan", x), want)
+            time.sleep(0.5)  # past one flush interval
+            pong = c.ping()
+            st = c.stats()
+        assert "last_snapshot_age_s" in pong
+        assert st["ok"] and st["op"] == "stats"
+        assert st["role"] == "daemon"
+        assert st["served"] >= 1
+        counters = st["metrics"]["counters"]
+        assert counters["serve.requests.scan"] >= 1
+        wall = st["metrics"]["histograms"]["serve.wall_s.scan"]
+        assert wall["count"] >= 1 and wall["p99"] > 0
+        # the 6000-element request padded up into the 8192 avatar:
+        # the staging pool holds that bucket's buffer
+        assert any(v["bufs"] >= 1 and v["bytes"] > 0
+                   for v in st["pad_pool"].values())
+        # flusher alive: age bounded by the interval (+ scheduling
+        # slack), never None
+        assert st["last_snapshot_age_s"] is not None
+        assert st["last_snapshot_age_s"] < 5.0
+
+
+def test_fleet_stats_top_and_kill9_snapshot_survival(tmp_path):
+    """The live-fleet acceptance: mid-burst, the router's stats op
+    aggregates both workers and ``serve_ctl top --once`` renders a
+    nonzero rps/p50/p99/served row for EVERY worker; after a kill -9
+    the dead worker's telemetry — its last ``metrics_snapshot``, at
+    most one flush interval old — survives into ``obs_report``."""
+    from tpukernels.serve import client as serve_client
+
+    interval = 0.2
+    extra = {"TPK_SERVE_BUCKETS": SCAN_BUCKET,
+             "TPK_METRICS_FLUSH_S": str(interval)}
+    with _fleet(tmp_path, n=2, env_extra=extra) as (front, journal,
+                                                    env):
+        x, want = _scan_case()
+        va = np.arange(1024, dtype=np.float32)
+        stop = threading.Event()
+        errors: list = []
+
+        def burst():
+            try:
+                with serve_client.ServeClient(front,
+                                              timeout_s=30) as c:
+                    while not stop.is_set():
+                        # scan|8192 primaries on worker0,
+                        # vector_add|1024 on worker1 (ring math
+                        # pinned in test_fleet) - every worker earns
+                        # nonzero rows
+                        np.testing.assert_array_equal(
+                            c.dispatch("scan", x), want)
+                        c.dispatch("vector_add", np.float32(2.0),
+                                   va, va)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=burst) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            # mid-burst: poll the fleet view until BOTH workers have
+            # served traffic
+            deadline = time.monotonic() + 60
+            while True:
+                with serve_client.ServeClient(front,
+                                              timeout_s=10) as c:
+                    st = c.stats()
+                assert st["ok"] and st["role"] == "router"
+                ws = st.get("worker_stats") or []
+                if (len(ws) == 2 and all(w for w in ws)
+                        and all(w["served"] >= 3 for w in ws)):
+                    break
+                assert time.monotonic() < deadline, \
+                    f"fleet never warmed both workers: {ws}"
+                time.sleep(0.2)
+            fleet_row = st["fleet"]
+            assert fleet_row["answering"] == 2
+            assert fleet_row["served"] == sum(w["served"] for w in ws)
+            # mid-burst dashboard: one frame, rc 0, nonzero rows for
+            # every worker
+            r = _ctl(env, "top", "--once")
+            assert r.returncode == 0, r.stdout + r.stderr
+            assert "workers=2/2" in r.stdout
+            rows = {}
+            for line in r.stdout.splitlines():
+                parts = line.split()
+                if parts and parts[0] in ("worker0", "worker1"):
+                    rows[parts[0]] = parts
+            assert set(rows) == {"worker0", "worker1"}
+            for name, parts in rows.items():
+                rps, p50, p99 = parts[2], parts[3], parts[4]
+                depth, served = parts[5], parts[7]
+                assert float(rps) > 0, (name, parts)
+                assert float(p50) > 0 and float(p99) > 0
+                assert "/" in depth  # depth/queue_max rendered
+                assert int(served) >= 3
+                assert parts[9] != "-"  # snap_age: flusher alive
+            # the status satellite: snap_age per worker from the pong
+            r = _ctl(env, "status")
+            assert r.returncode == 0
+            assert "snap_age=" in r.stdout
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(30)
+        assert not errors, errors
+        # ---- kill -9: the snapshot survives the worker ---------- #
+        time.sleep(3 * interval)  # a post-burst flush lands
+        pidfile = os.path.join(str(tmp_path / "f"), "fleet",
+                               "worker0", "serve.pid")
+        with open(pidfile) as f:
+            wpid = int(f.readline().strip())
+        t_kill = time.time()
+        os.kill(wpid, signal.SIGKILL)
+        events = _events(journal)
+        snaps = [e for e in events
+                 if e.get("kind") == "metrics_snapshot"
+                 and e.get("pid") == wpid]
+        assert snaps, "killed worker never flushed a snapshot"
+        # bounded loss: the last snapshot is at most one interval old
+        # (generous scheduling slack for a loaded CI box)
+        assert t_kill - snaps[-1]["t"] <= interval + 2.0
+        merged = obs_metrics.merge_journal_metrics(events)
+        st = merged[wpid]
+        assert not st["final"], "SIGKILL cannot have flushed atexit"
+        assert st["seq"] == max(e["seq"] for e in snaps)
+        assert st["counters"].get("serve.requests.scan", 0) >= 3
+        # and obs_report renders the dead worker from its stream
+        r = subprocess.run(
+            [sys.executable, os.path.join("tools", "obs_report.py"),
+             "--journal", journal],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300,
+        )
+        assert f"[pid {wpid}]" in r.stdout, r.stdout + r.stderr
+        line = next(ln for ln in r.stdout.splitlines()
+                    if f"[pid {wpid}]" in ln)
+        assert "no final flush" in line
+
+
+# ---------------------------------------------------------------- #
+# daily rollups: determinism, rejection, retention                 #
+# ---------------------------------------------------------------- #
+
+def _write_journal(path, lines):
+    with open(path, "w") as f:
+        for e in lines:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_rollup_determinism_and_rejection(tmp_path, monkeypatch,
+                                          capsys):
+    from tpukernels.obs import rollup
+
+    monkeypatch.setenv("TPK_ROLLUP_DIR", str(tmp_path / "roll"))
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL",
+                       str(tmp_path / "health_t.jsonl"))
+    rollup.reset()
+    day = "2026-08-01"
+    jp = tmp_path / f"health_{day}.jsonl"
+    _write_journal(jp, (
+        [_req_line("vector_add", 128, 0.875, 0.002)] * 3
+        + [_req_line("vector_add", 64, 0.9, 0.001, ok=False)]
+        + [_snap(101, 1, {"serve.requests.vector_add": 2})]
+        + [{"kind": "metrics", "pid": 101, "site": "atexit:x",
+            "counters": {"serve.requests.vector_add": 3},
+            "gauges": {}, "histograms": {}}]
+        + [{"kind": "serve_start", "pid": 101}]
+    ))
+    p = rollup.write_day(day, paths=[str(jp)])
+    assert os.path.basename(p) == f"rollup_{day}.json"
+    b1 = open(p, "rb").read()
+    rollup.reset()
+    assert open(rollup.write_day(day, paths=[str(jp)]),
+                "rb").read() == b1, "re-rolling must be byte-identical"
+    art = rollup.load_day(day)
+    assert art["date"] == day and art["schema"] == rollup.SCHEMA
+    assert art["kinds"]["serve_request"] == 4
+    # only OK requests feed the latency rows
+    assert art["requests"]["vector_add"]["count"] == 3
+    assert art["requests"]["vector_add"]["p99"] > 0
+    # counters through the merge fold: final supersedes the
+    # snapshot stream, never summed (3, not 5)
+    assert art["counters"]["serve.requests.vector_add"] == 3
+    mix = art["shape_mix"]["vector_add"]
+    assert mix[0]["count"] == 3
+    # a rollup_written event landed in the live journal
+    ev, _ = _journal.load_events([str(tmp_path / "health_t.jsonl")])
+    assert any(e["kind"] == "rollup_written" and e["date"] == day
+               for e in ev)
+    capsys.readouterr()
+    # stale jax: rejected loudly, read as absent
+    stale = json.load(open(p))
+    stale["jax"] = "0.0.0-stale"
+    sp = str(tmp_path / "roll" / "rollup_2026-08-02.json")
+    json.dump(dict(stale, date="2026-08-02"), open(sp, "w"))
+    assert rollup.load_day("2026-08-02") is None
+    assert "rollup rejected" in capsys.readouterr().err
+    # torn file: rejected, never parsed as empty state
+    tp = str(tmp_path / "roll" / "rollup_2026-08-03.json")
+    open(tp, "w").write('{"schema": 1, "date": "2026-08-0')
+    assert rollup.load_day("2026-08-03") is None
+    assert "rollup rejected" in capsys.readouterr().err
+    # filename/date mismatch: a renamed artifact must not impersonate
+    # another day
+    mp = str(tmp_path / "roll" / "rollup_2026-08-04.json")
+    open(mp, "w").write(b1.decode())
+    assert rollup.load_day("2026-08-04") is None
+    assert "rollup rejected" in capsys.readouterr().err
+    # the series loader skips the bad days and keeps the good one
+    series = rollup.load_series()
+    assert [d for d, _ in series] == [day]
+    # and obs_report's full-report section renders the day (pids is
+    # a COUNT in the artifact — regression pin for the len() crash)
+    env = _scrubbed_env(None)
+    env["TPK_ROLLUP_DIR"] = str(tmp_path / "roll")
+    env["TPK_HEALTH_JOURNAL"] = str(tmp_path / "health_t.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "obs_report.py"),
+         "--journal", str(jp)],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "== daily rollups (1 day(s)" in r.stdout
+    assert f"{day}: 7 event(s), 1 pid(s), 3 request(s)" in r.stdout
+    # retention: an ancient artifact is pruned, recent ones kept
+    old = str(tmp_path / "roll" / "rollup_2020-01-01.json")
+    open(old, "w").write(b1.decode())
+    gone = rollup.prune(retention_days=90, today="2026-08-06")
+    assert [os.path.basename(g) for g in gone] == \
+        ["rollup_2020-01-01.json"]
+    assert os.path.exists(p) and not os.path.exists(old)
+    rollup.reset()
+
+
+# ---------------------------------------------------------------- #
+# p99_creep: the long-horizon verdict                              #
+# ---------------------------------------------------------------- #
+
+def _day(d, p99, count=50):
+    return (d, {"requests": {"scan": {"count": count, "p99": p99}}})
+
+
+def test_p99_creep_fires_on_drift_quiet_on_flat_and_spikes():
+    from tpukernels.obs import trend
+
+    drifting = [_day(f"2026-08-0{i}", v) for i, v in
+                enumerate([1.0, 1.01, 1.02, 1.03, 1.2], start=1)]
+    v = trend.analyze_p99_creep(drifting)["p99_creep[scan]"]
+    assert v["verdict"] == "p99_creep"
+    assert v["days"] == 5 and v["latest_date"] == "2026-08-05"
+    assert any("non-gating" in f for f in v["flags"])
+    # flat series: quiet — each day inside the 5% band
+    flat = [_day(f"2026-08-0{i}", v) for i, v in
+            enumerate([1.0, 1.0, 1.01, 1.0, 1.02], start=1)]
+    assert trend.analyze_p99_creep(flat)["p99_creep[scan]"][
+        "verdict"] == "ok"
+    # a mid-window spike that RECOVERED must not flag the flat tail
+    spike = [_day(f"2026-08-0{i}", v) for i, v in
+             enumerate([1.0, 1.5, 1.0, 1.0, 1.02], start=1)]
+    assert trend.analyze_p99_creep(spike)["p99_creep[scan]"][
+        "verdict"] == "ok"
+    # latest over the median but NOT the worst day in the window:
+    # still recovering from day 1, not creeping
+    recover = [_day(f"2026-08-0{i}", v) for i, v in
+               enumerate([2.0, 1.0, 1.0, 1.5], start=1)]
+    assert trend.analyze_p99_creep(recover)["p99_creep[scan]"][
+        "verdict"] == "ok"
+    # under the evidence floor: no_data, never a finding
+    thin = trend.analyze_p99_creep(drifting[:2])["p99_creep[scan]"]
+    assert thin["verdict"] == "no_data" and thin["days"] == 2
+    # zero-count rows contribute nothing
+    empty = trend.analyze_p99_creep(
+        [_day("2026-08-01", 1.0, count=0)])
+    assert empty == {}
+
+
+# ---------------------------------------------------------------- #
+# multi-day adapt mining (TPK_ADAPT_WINDOW_DAYS)                   #
+# ---------------------------------------------------------------- #
+
+def test_window_days_knob_fail_loud(monkeypatch):
+    monkeypatch.delenv("TPK_ADAPT_WINDOW_DAYS", raising=False)
+    assert adapt.window_days() == 1
+    monkeypatch.setenv("TPK_ADAPT_WINDOW_DAYS", "3")
+    assert adapt.window_days() == 3
+    for bad in ("0", "-1", "1.5", "abc"):
+        monkeypatch.setenv("TPK_ADAPT_WINDOW_DAYS", bad)
+        with pytest.raises(ValueError, match="TPK_ADAPT_WINDOW_DAYS"):
+            adapt.window_days()
+
+
+def test_window_mix_folds_prior_rollups_never_today(tmp_path,
+                                                    monkeypatch):
+    """days=N mines today's journal + the N-1 prior rollup days; a
+    rollup dated today is SKIPPED (today's live journal already
+    carries that traffic — folding both would double-count)."""
+    from tpukernels.obs import rollup
+
+    monkeypatch.setenv("TPK_ROLLUP_DIR", str(tmp_path / "roll"))
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL",
+                       str(tmp_path / "health_t.jsonl"))
+    rollup.reset()
+    today = "2026-08-07"
+    for day, n in (("2026-08-05", 4), ("2026-08-06", 6)):
+        jp = tmp_path / f"health_{day}.jsonl"
+        _write_journal(
+            jp, [_req_line("vector_add", 128, 0.5, 0.001)] * n)
+        rollup.write_day(day, paths=[str(jp)])
+    # a same-day rollup exists too — it must NOT be folded
+    jp = tmp_path / f"health_{today}.jsonl"
+    _write_journal(jp, [_req_line("vector_add", 128, 0.5, 0.001)] * 9)
+    rollup.write_day(today, paths=[str(jp)])
+    live = [_req_line("vector_add", 128, 0.5, 0.001)] * 2
+    mix, used = adapt.window_mix(live, days=3, end_date=today)
+    assert used == 3
+    assert adapt.mix_requests(mix) == 2 + 4 + 6
+    row = mix["vector_add"][0]
+    assert row["count"] == 12
+    assert row["pad_frac_sum"] == pytest.approx(6.0)
+    # days=1: today's journal alone, rollups untouched
+    mix1, used1 = adapt.window_mix(live, days=1, end_date=today)
+    assert used1 == 1 and adapt.mix_requests(mix1) == 2
+    # a window larger than the series uses what exists, reported
+    # honestly
+    mix9, used9 = adapt.window_mix(live, days=9, end_date=today)
+    assert used9 == 3 and adapt.mix_requests(mix9) == 12
+    rollup.reset()
+
+
+def test_propose_mines_3day_rollup_window_without_today_traffic(
+        tmp_path, monkeypatch):
+    """The acceptance proof: with ZERO same-day serve traffic,
+    ``serve_optimize propose`` under TPK_ADAPT_WINDOW_DAYS=3 mines
+    the prior days' rollup shape mix into a valid split candidate."""
+    from tpukernels.obs import rollup
+
+    roll_dir = str(tmp_path / "roll")
+    adapt_dir = str(tmp_path / "adapt")
+    monkeypatch.setenv("TPK_ROLLUP_DIR", roll_dir)
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL",
+                       str(tmp_path / "health_t.jsonl"))
+    rollup.reset()
+    # two prior days of hot (128,) traffic against a 1024 avatar:
+    # 60 requests >= the 50-request evidence floor, pad ~0.875
+    for day in ("2026-08-05", "2026-08-06"):
+        jp = tmp_path / f"health_{day}.jsonl"
+        _write_journal(
+            jp, [_req_line("vector_add", 128, 0.875, 0.001)] * 30)
+        assert rollup.write_day(day, paths=[str(jp)])
+    today_journal = str(tmp_path / "health_today.jsonl")
+    open(today_journal, "w").close()  # no same-day traffic at all
+    env = _scrubbed_env(None)
+    env["TPK_ROLLUP_DIR"] = roll_dir
+    env["TPK_ADAPT_DIR"] = adapt_dir
+    env["TPK_ADAPT_WINDOW_DAYS"] = "3"
+    env["TPK_HEALTH_JOURNAL"] = today_journal
+    env["TPK_SERVE_BUCKETS"] = json.dumps(
+        {"vector_add": {"args": [["f32", []], ["f32", [1024]],
+                                 ["f32", [1024]]], "statics": {}}})
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "serve_optimize.py"),
+         "propose", "--journal", today_journal],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "3-day window" in r.stdout
+    assert "2 prior rollup day(s)" in r.stdout
+    assert "proposed 1 split(s)" in r.stdout
+    # the candidate validates through the standard artifact read
+    monkeypatch.setenv("TPK_ADAPT_DIR", adapt_dir)
+    cand = adapt.load()
+    assert cand is not None
+    splits = [a for a in cand["proposals"] if a["action"] == "split"]
+    assert len(splits) == 1 and splits[0]["kernel"] == "vector_add"
+    assert splits[0]["spec"]["args"][1] == ["f32", [128]]
+    # the evidence trail records the window that fed it
+    ev, _ = _journal.load_events([today_journal])
+    prop = next(e for e in ev if e["kind"] == "adapt_proposed")
+    assert prop["window_days"] == 3
+    assert prop["requests_mined"] == 60
+    rollup.reset()

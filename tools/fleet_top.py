@@ -1,0 +1,231 @@
+"""Live fleet dashboard over the read-only ``stats`` op
+(docs/SERVING.md §stats op; surfaced as ``serve_ctl top [--once]``).
+
+One ``stats`` round trip per frame against the fleet's front socket
+(or a lone daemon's socket) renders one row per worker: request rate,
+streaming-histogram p50/p99 latency, queue depth, in-flight count,
+spills/throttles at the router, bytes copied, and the flusher's
+``last_snapshot_age_s`` (docs/OBSERVABILITY.md §live telemetry — a
+growing age means the worker's metrics flusher died). Everything on
+screen comes from live processes; nothing is read from the journal,
+so `top` works against a fleet whose journaling is off.
+
+- ``--once`` prints one frame and exits (0 when the stats plane
+  answered, 1 when nothing did) — the scriptable face, and what the
+  live-fleet acceptance proof drives.
+- Without it, the terminal refreshes every ``--interval`` seconds
+  (default 2) until Ctrl-C; rates are computed from the DELTA between
+  frames, so an idle fleet shows 0.0 rps no matter how busy its past.
+
+Latency columns merge every ``serve.wall_s.<kernel>`` histogram a
+worker carries — same log-bucket geometry fleet-wide, so the merged
+p50/p99 go through the one shared ``metrics.percentiles`` arithmetic
+(clamped to the exact observed max).
+
+Read-only by design: this tool sends only ``stats`` (and falls back
+to nothing else), takes no locks anywhere, and emits no journal
+events — watching the fleet must never change it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpukernels import _cachedir  # noqa: E402
+from tpukernels.obs import metrics as obs_metrics  # noqa: E402
+from tpukernels.serve import client as serve_client  # noqa: E402
+from tpukernels.serve import fleet as serve_fleet  # noqa: E402
+from tpukernels.serve import health as serve_health  # noqa: E402
+from tpukernels.serve import protocol as serve_protocol  # noqa: E402
+
+
+def _target_socket(socket_path=None) -> str:
+    """The front socket when a router holds its pidfile (fleet view),
+    else the lone daemon's socket — the `serve_ctl status` resolution
+    order, so `top` always watches what `status` reports on."""
+    if socket_path:
+        return socket_path
+    held, _pid = serve_health.pidfile_state(
+        serve_fleet.router_pidfile_path()
+    )
+    if held:
+        cfg = serve_fleet.load_config() or {}
+        return cfg.get("front") or serve_fleet.front_socket_path()
+    return _cachedir.serve_socket_path()
+
+
+def _fetch(sock: str):
+    try:
+        with serve_client.ServeClient(sock, timeout_s=5) as c:
+            reply = c.stats()
+    except (OSError, serve_protocol.ProtocolError):
+        return None
+    if not isinstance(reply, dict) or not reply.get("ok"):
+        return None
+    return reply
+
+
+def _wall_latency_ms(metrics_snap) -> tuple:
+    """(count, p50_ms, p99_ms) merged over every serve.wall_s.<kernel>
+    histogram in one worker's metrics snapshot, or (0, None, None)."""
+    count = 0
+    max_v = 0.0
+    buckets: dict = {}
+    for name, row in (
+        (metrics_snap or {}).get("histograms") or {}
+    ).items():
+        if not name.startswith("serve.wall_s."):
+            continue
+        if not isinstance(row, dict) or not row.get("count"):
+            continue
+        count += int(row["count"])
+        max_v = max(max_v, float(row.get("max") or 0.0))
+        for b, c in (row.get("buckets") or {}).items():
+            buckets[b] = buckets.get(b, 0) + int(c)
+    if not count:
+        return 0, None, None
+    p50, p99 = obs_metrics.percentiles(
+        count, max_v, buckets, qs=(0.5, 0.99)
+    )
+    return count, p50 * 1e3, p99 * 1e3
+
+
+def _rows(reply) -> list:
+    """Normalize a stats reply into per-worker row dicts. A router
+    reply yields one row per worker (index-aligned ``worker_stats``);
+    a lone daemon yields one row for itself."""
+    if reply.get("role") == "router":
+        rows = []
+        meta = reply.get("workers") or []
+        for i, ws in enumerate(reply.get("worker_stats") or []):
+            m = meta[i] if i < len(meta) else {}
+            state = ("DOWN" if ws is None
+                     else "draining" if m.get("draining")
+                     else "quarantined" if m.get("quarantined")
+                     else m.get("state") or "up")
+            rows.append({"name": f"worker{i}", "state": state,
+                         "stats": ws, "routed": m.get("routed")})
+        return rows
+    return [{"name": f"daemon:{reply.get('pid')}", "state": "up",
+             "stats": reply, "routed": None}]
+
+
+def _fmt(v, spec="{:.1f}", none="-") -> str:
+    return none if v is None else spec.format(v)
+
+
+def render(reply, prev=None, dt=None, out=sys.stdout) -> dict:
+    """Print one frame; returns {worker_name: served} for the next
+    frame's rate deltas. ``prev``/``dt`` make rps a frame delta; with
+    neither (the --once path) it is lifetime served / uptime."""
+    role = reply.get("role") or "daemon"
+    if role == "router":
+        fleet = reply.get("fleet") or {}
+        head = (f"fleet: routed={reply.get('routed')} "
+                f"spilled={reply.get('spilled')} "
+                f"throttled={reply.get('throttled')} "
+                f"rejected={reply.get('rejected')} "
+                f"level={reply.get('level')} "
+                f"workers={fleet.get('answering')}"
+                f"/{reply.get('n_workers')} "
+                f"uptime={reply.get('uptime_s')}s")
+    else:
+        head = (f"daemon: pid {reply.get('pid')} "
+                f"uptime={reply.get('uptime_s')}s "
+                f"device={reply.get('device_kind')}")
+    print(head, file=out)
+    print(f"{'WORKER':<12} {'STATE':<11} {'RPS':>7} {'P50MS':>8} "
+          f"{'P99MS':>8} {'DEPTH':>7} {'INFL':>5} {'SERVED':>8} "
+          f"{'COPIED':>9} {'SNAP_AGE':>8}", file=out)
+    served_now: dict = {}
+    for row in _rows(reply):
+        ws = row["stats"]
+        if ws is None:
+            print(f"{row['name']:<12} {row['state']:<11} "
+                  f"{'-':>7} {'-':>8} {'-':>8} {'-':>7} {'-':>5} "
+                  f"{'-':>8} {'-':>9} {'-':>8}", file=out)
+            continue
+        served = ws.get("served") or 0
+        served_now[row["name"]] = served
+        if prev is not None and dt:
+            rps = max(0.0, served - prev.get(row["name"], served)) / dt
+        else:
+            up = ws.get("uptime_s") or 0
+            rps = (served / up) if up else 0.0
+        _n, p50, p99 = _wall_latency_ms(ws.get("metrics"))
+        age = ws.get("last_snapshot_age_s")
+        depth = f"{ws.get('depth')}/{ws.get('queue_max')}"
+        print(f"{row['name']:<12} {row['state']:<11} "
+              f"{rps:>7.1f} {_fmt(p50, '{:.2f}'):>8} "
+              f"{_fmt(p99, '{:.2f}'):>8} {depth:>7} "
+              f"{ws.get('inflight'):>5} {served:>8} "
+              f"{ws.get('bytes_copied'):>8}B "
+              f"{_fmt(age, '{:.1f}s'):>8}", file=out)
+    return served_now
+
+
+def run(once=False, interval_s=2.0, socket_path=None) -> int:
+    sock = _target_socket(socket_path)
+    reply = _fetch(sock)
+    if reply is None:
+        print(f"fleet_top: no stats answer on {sock} - is a "
+              "daemon/fleet running (and new enough for the stats "
+              "op)?", file=sys.stderr)
+        return 1
+    if once:
+        render(reply)
+        return 0
+    prev = None
+    t_prev = None
+    try:
+        while True:
+            if reply is not None:
+                # home + clear: redraw in place, no scrollback spam
+                sys.stdout.write("\x1b[H\x1b[2J")
+                now = time.monotonic()
+                dt = (now - t_prev) if t_prev is not None else None
+                prev = render(reply, prev=prev, dt=dt)
+                t_prev = now
+                sys.stdout.flush()
+            else:
+                print(f"fleet_top: no stats answer on {sock} - "
+                      "retrying", file=sys.stderr)
+            time.sleep(interval_s)
+            reply = _fetch(sock)
+    except KeyboardInterrupt:
+        return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    once = False
+    interval_s = 2.0
+    socket_path = None
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--once":
+                once = True
+            elif a == "--interval":
+                interval_s = float(next(it))
+            elif a == "--socket":
+                socket_path = next(it)
+            else:
+                print(__doc__, file=sys.stderr)
+                print(f"fleet_top: unknown argument {a!r}",
+                      file=sys.stderr)
+                return 2
+    except (StopIteration, ValueError):
+        print(f"fleet_top: {a} needs a value", file=sys.stderr)
+        return 2
+    return run(once=once, interval_s=interval_s,
+               socket_path=socket_path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

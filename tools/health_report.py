@@ -30,6 +30,7 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from tpukernels.obs import metrics as _metrics  # noqa: E402
 from tpukernels.obs import slo as _slo  # noqa: E402
 from tpukernels.obs import trace as _trace  # noqa: E402
 from tpukernels.resilience import journal as _journal  # noqa: E402
@@ -123,10 +124,27 @@ def _fmt(ev):
         return None
     if kind == "metrics":
         snap = ev.get("counters") or {}
-        return (f"{ts} [pid {pid}] metrics snapshot "
+        return (f"{ts} [pid {pid}] final metrics snapshot "
                 f"({ev.get('site')}): {len(snap)} counter(s), "
                 f"{len(ev.get('gauges') or {})} gauge(s), "
                 f"{len(ev.get('histograms') or {})} histogram(s)")
+    if kind == "metrics_snapshot":
+        # periodic flusher stream (docs/OBSERVABILITY.md §live
+        # telemetry) is high-volume; the per-pid fold renders in the
+        # aggregate table (_metrics_table), never line by line — and
+        # never summed with the final `metrics` event above
+        return None
+    if kind == "rollup_written":
+        return (f"{ts} [pid {pid}] daily rollup written for "
+                f"{ev.get('date')}: {ev.get('events')} event(s), "
+                f"{ev.get('requests')} request(s) over "
+                f"{ev.get('kernels')} kernel(s)"
+                + (f", {ev.get('bad_lines')} unparseable line(s)"
+                   if ev.get("bad_lines") else ""))
+    if kind == "rollup_rejected":
+        return (f"{ts} [pid {pid}] daily rollup REJECTED "
+                f"{ev.get('path')}: {ev.get('reason')} - reader "
+                "fell back to skipping that day")
     if kind == "supervisor_resume":
         return (f"{ts} [pid {pid}] supervisor RESUMED from checkpoint"
                 f" (green={','.join(ev.get('green') or []) or '-'}"
@@ -697,6 +715,37 @@ def _route_table(events):
     return out
 
 
+def _metrics_table(events):
+    """Per-process metric state from the one shared
+    ``metrics.merge_journal_metrics`` fold (docs/OBSERVABILITY.md
+    §live telemetry): the atexit ``metrics`` event is authoritative
+    where present; a pid that died without one (SIGKILL) is rebuilt
+    from its ``metrics_snapshot`` stream, deduped by (pid, seq). The
+    two encodings are never summed — a pid that streamed AND exited
+    cleanly counts once."""
+    merged = _metrics.merge_journal_metrics(events)
+    if not merged:
+        return []
+    out = ["metric state per process (final metrics event, else "
+           "deduped snapshot stream):"]
+    for pid, st in sorted(merged.items(), key=lambda kv: str(kv[0])):
+        how = ("final" if st.get("final")
+               else f"last snapshot seq={st.get('seq')}, NO final "
+                    "flush - died hard")
+        counters = st.get("counters") or {}
+        served = sum(v for k, v in counters.items()
+                     if k.startswith("serve.requests.")
+                     and isinstance(v, (int, float)))
+        out.append(
+            f"  pid {pid} ({st.get('site')}, {how}): "
+            f"{len(counters)} counter(s), "
+            f"{len(st.get('gauges') or {})} gauge(s), "
+            f"{len(st.get('histograms') or {})} histogram(s)"
+            + (f", {int(served)} served request(s)" if served else "")
+        )
+    return out
+
+
 def summarize(events, bad=0) -> str:
     out = []
     events = sorted(events, key=lambda e: e.get("t", 0.0))
@@ -728,6 +777,10 @@ def summarize(events, bad=0) -> str:
     breakdown = _span_breakdown(events)
     if breakdown:
         out.extend(breakdown)
+        out.append("-" * 60)
+    mtable = _metrics_table(events)
+    if mtable:
+        out.extend(mtable)
         out.append("-" * 60)
     counts = {}
     for ev in events:

@@ -52,10 +52,22 @@ Sections (docs/OBSERVABILITY.md):
 11. **Shapes seen** — requested (pre-pad) shape mix per (kernel,
     bucket) with pad waste, from the per-request shape-mix records
     on ``serve_request`` — ROADMAP item 5's optimizer input.
-12. **Metric snapshots** — the last ``metrics`` event per process:
-    counters (probe retries, watchdog kills, tuning-cache traffic),
-    gauges, latency histograms (count-weighted p50/p95/p99 + exact
-    max).
+12. **Metric snapshots** — per-process metric state reconstructed by
+    the one shared ``metrics.merge_journal_metrics`` fold
+    (docs/OBSERVABILITY.md §live telemetry): a process's final
+    ``metrics`` event is authoritative; a process that died without
+    one (SIGKILL) is reconstructed from its ``metrics_snapshot``
+    stream, deduped by (pid, seq) — counters (probe retries, watchdog
+    kills, tuning-cache traffic), gauges, latency histograms
+    (count-weighted p50/p95/p99 + exact max). The two encodings are
+    never summed.
+13. **Daily rollups** — the long-horizon series
+    (``tpukernels/obs/rollup.py``): validated ``rollup_<date>.json``
+    artifacts with per-kernel request counts and daily p99s, judged
+    by the NON-GATING ``p99_creep`` trend verdict (latest day's p99
+    more than ``trend.P99_CREEP_FRAC`` above the prior days' median
+    AND the worst day in the window — the slow multi-day tail drift
+    the per-run epsilon band structurally misses).
 
 Exit-code signaling (``tools/tpu_revalidate.sh`` runs ``--check``
 non-gating and keys a WARN off it):
@@ -80,8 +92,8 @@ non-gating and keys a WARN off it):
         evidence itself is wrong; docs/OBSERVABILITY.md §request
         tracing) — all of these gate identically;
     2 — usage error (never 1: rc 1 is reserved for real findings).
-``below_scaling_efficiency`` and ``trace_coverage`` print as
-non-gating information, the ``below_roofline`` pattern.
+``below_scaling_efficiency``, ``trace_coverage`` and ``p99_creep``
+print as non-gating information, the ``below_roofline`` pattern.
 
 ``--check`` prints only the non-ok verdict lines (machine/CI mode;
 ``below_roofline`` lines print as non-gating information); the
@@ -98,7 +110,9 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from tpukernels.obs import metrics as _metrics  # noqa: E402
 from tpukernels.obs import reqtrace as _reqtrace  # noqa: E402
+from tpukernels.obs import rollup as _rollup  # noqa: E402
 from tpukernels.obs import scaling as _scaling  # noqa: E402
 from tpukernels.obs import slo as _slo  # noqa: E402
 from tpukernels.obs import trace, trend  # noqa: E402
@@ -270,12 +284,11 @@ def integrity_section(events, out):
     quarantined = [e for e in events
                    if e.get("kind") == "output_integrity_quarantined"]
     checks = deep = errors = 0
-    last = {}
-    for e in events:
-        if e.get("kind") == "metrics":
-            last[e.get("pid")] = e
-    for e in last.values():
-        c = e.get("counters") or {}
+    # per-pid states from the shared merge (final `metrics` event
+    # authoritative, else the deduped snapshot stream) — summing raw
+    # events would double-count a pid that streamed AND exited cleanly
+    for st in _metrics.merge_journal_metrics(events).values():
+        c = st.get("counters") or {}
         checks += c.get("integrity.checks", 0)
         deep += c.get("integrity.deep_checks", 0)
         errors += c.get("integrity.check_errors", 0)
@@ -572,25 +585,34 @@ def shapes_section(events, out):
 
 
 def metrics_section(events, out):
-    snaps = [e for e in events if e.get("kind") == "metrics"]
+    # the one shared reconstruction (docs/OBSERVABILITY.md §live
+    # telemetry): a pid's atexit `metrics` event is authoritative; a
+    # pid that never got one (SIGKILL) is rebuilt from its deduped
+    # `metrics_snapshot` stream — the two encodings are never summed
+    merged = _metrics.merge_journal_metrics(events)
+    n_events = sum(1 for e in events
+                   if e.get("kind") in ("metrics", "metrics_snapshot"))
     out.append("")
-    out.append(f"== metric snapshots ({len(snaps)} in journal) ==")
-    if not snaps:
-        out.append("(no metrics events in the journal)")
+    out.append(f"== metric snapshots ({n_events} event(s), "
+               f"{len(merged)} process(es)) ==")
+    if not merged:
+        out.append("(no metrics/metrics_snapshot events in the "
+                   "journal)")
         return
-    # last snapshot per pid: each process's final state supersedes its
-    # own earlier emissions; distinct processes (parent + children)
-    # all contribute
-    last = {}
-    for e in snaps:
-        last[e.get("pid")] = e
-    for pid, e in sorted(last.items(), key=lambda kv: str(kv[0])):
-        out.append(f"[pid {pid}] site={e.get('site')}")
-        for k, v in sorted((e.get("counters") or {}).items()):
+    for pid, st in sorted(merged.items(), key=lambda kv: str(kv[0])):
+        if st.get("final"):
+            how = "final"
+        else:
+            # no atexit flush — this process died hard; what follows
+            # is its last streamed snapshot (at most one flush
+            # interval stale)
+            how = f"last snapshot seq={st.get('seq')}, no final flush"
+        out.append(f"[pid {pid}] site={st.get('site')} ({how})")
+        for k, v in sorted((st.get("counters") or {}).items()):
             out.append(f"  counter   {k} = {v}")
-        for k, v in sorted((e.get("gauges") or {}).items()):
+        for k, v in sorted((st.get("gauges") or {}).items()):
             out.append(f"  gauge     {k} = {v}")
-        for k, h in sorted((e.get("histograms") or {}).items()):
+        for k, h in sorted((st.get("histograms") or {}).items()):
             # percentiles come straight off the snapshot (the
             # emitter's count-weighted derivation — never re-derived
             # from buckets here)
@@ -600,6 +622,39 @@ def metrics_section(events, out):
                 f"max={h.get('max')} p50={h.get('p50')} "
                 f"p95={h.get('p95')} p99={h.get('p99')}"
             )
+
+
+def rollup_section(out):
+    """Long-horizon health off the daily rollup series
+    (docs/OBSERVABILITY.md §daily rollups): one line per rollup day,
+    then the NON-GATING ``p99_creep`` verdicts — the slow multi-day
+    tail drift the per-run epsilon band structurally misses."""
+    try:
+        series = _rollup.load_series()
+    except Exception as e:  # noqa: BLE001 — the report must render
+        out.append("")
+        out.append(f"== daily rollups (unreadable: {e!r}) ==")
+        return
+    if not series:
+        return
+    out.append("")
+    out.append(f"== daily rollups ({len(series)} day(s) in "
+               f"{os.path.relpath(_rollup.rollup_dir())}) ==")
+    for date, art in series[-7:]:
+        reqs = art.get("requests") or {}
+        total = sum((r or {}).get("count") or 0 for r in reqs.values())
+        out.append(f"  {date}: {art.get('events')} event(s), "
+                   f"{art.get('pids') or 0} pid(s), "
+                   f"{total} request(s) over {len(reqs)} kernel(s)")
+    for name, v in sorted(trend.analyze_p99_creep(series).items()):
+        if v["verdict"] == "p99_creep":
+            out.append(f"  {name}: p99_creep (non-gating)")
+            for flag in v["flags"]:
+                out.append(f"    {flag}")
+        elif v["verdict"] == "ok":
+            out.append(f"  {name}: ok over {v['days']} day(s) "
+                       f"(latest p99 {v['latest']}s, baseline "
+                       f"{v['baseline']}s)")
 
 
 def main(argv=None):
@@ -760,6 +815,27 @@ def main(argv=None):
             # informational, never part of the rc — the below_roofline
             # pattern for the weak-scaling curve
             print(f"weak/{name}: below_scaling_efficiency (non-gating)")
+        # multi-day tail drift off the rollup series prints as
+        # information only: p99_creep is a long-horizon early warning
+        # (docs/OBSERVABILITY.md §daily rollups), not a per-run
+        # finding, so it never touches the rc — the below_roofline
+        # pattern. Judge only what loads: an unreadable series (lazy
+        # jax import on a journal-only host) must not fake findings.
+        try:
+            creep_series = _rollup.load_series()
+        except Exception as e:  # noqa: BLE001 — gate what validates
+            print(f"obs_report: rollup series unreadable, p99 creep "
+                  f"not judged ({e!r})", file=sys.stderr)
+            creep_series = []
+        creeping = {
+            n: v
+            for n, v in trend.analyze_p99_creep(creep_series).items()
+            if v["verdict"] == "p99_creep"
+        }
+        for name, v in sorted(creeping.items()):
+            print(f"{name}: p99_creep (non-gating)")
+            for flag in v["flags"]:
+                print(f"  {flag}")
         ok = sum(1 for v in verdicts.values() if v["verdict"] == "ok")
         nodata = sum(
             1 for v in verdicts.values() if v["verdict"] == "no_data"
@@ -775,7 +851,8 @@ def main(argv=None):
             f"{len(pad_bad)} pad-waste regression(s), "
             f"{len(trace_bad)} trace inconsistenc(ies), "
             f"{len(trace_low)} trace-coverage (non-gating), "
-            f"{len(below_eff)} below-scaling-efficiency (non-gating)"
+            f"{len(below_eff)} below-scaling-efficiency (non-gating), "
+            f"{len(creeping)} p99-creep (non-gating)"
         )
         return 1 if (bad or corrupt or breaches or scaling_bad
                      or copy_bad or pad_bad or trace_bad) else 0
@@ -815,6 +892,7 @@ def main(argv=None):
     reqtrace_section(events, out)
     shapes_section(events, out)
     metrics_section(events, out)
+    rollup_section(out)
     out.append("")
     if bad or scaling_bad or copy_bad or pad_bad or trace_bad:
         out.append(

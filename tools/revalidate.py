@@ -408,12 +408,34 @@ python tools/serve_ctl.py fsck
 """, gating=False, stamp="daily", timeout_s=120, cost_min=1, value=2,
       needs_chip=False,
       inputs=("tpukernels/serve", "tools/serve_ctl.py")),
-    # 3e. traffic-adaptive bucket proposal (docs/SERVING.md §adaptive
+    # 3e. daily journal rollup (docs/OBSERVABILITY.md §daily rollups):
+    #     compact each day's health journals into a validated
+    #     rollup_<date>.json series artifact and prune past retention
+    #     — the long-horizon substrate for p99_creep and multi-day
+    #     adapt mining. Pure journal arithmetic — CPU-only, daily,
+    #     non-gating: losing a day's rollup degrades the trend window,
+    #     it does not block the queue.
+    S("rollup_daily", """
+set -o pipefail
+rollup_log="docs/logs/rollup_daily_$(date +%Y-%m-%d_%H%M%S).log"
+if timeout -k 10 240 env JAX_PLATFORMS=cpu python \\
+    -m tpukernels.obs.rollup >"$rollup_log" 2>&1; then
+  tail -1 "$rollup_log"
+else
+  echo "WARN: daily rollup failed rc=$? (non-gating) - $rollup_log"
+  exit 1
+fi
+""", gating=False, stamp="daily", timeout_s=300, cost_min=1, value=2,
+      needs_chip=False,
+      inputs=("tpukernels/obs/rollup.py", "tpukernels/obs/metrics.py")),
+    # 3f. traffic-adaptive bucket proposal (docs/SERVING.md §adaptive
     #     buckets): mine the day's serve_request shape mix and persist
     #     a split/merge candidate when projected pad waste sits over
     #     TPK_ADAPT_PAD_TARGET. Pure journal arithmetic — CPU-only,
     #     daily, non-gating; after serve_probe so the day's journal
-    #     holds at least the probe's own traffic evidence.
+    #     holds at least the probe's own traffic evidence, and after
+    #     rollup_daily so a TPK_ADAPT_WINDOW_DAYS>1 miner sees a
+    #     fresh prior-day series (docs/SERVING.md §adaptive buckets).
     S("adapt_propose", """
 set -o pipefail
 adapt_log="docs/logs/adapt_propose_$(date +%Y-%m-%d_%H%M%S).log"
@@ -425,9 +447,9 @@ else
   exit 1
 fi
 """, gating=False, stamp="daily", timeout_s=300, cost_min=1, value=2,
-      needs_chip=False, after=("serve_probe",),
+      needs_chip=False, after=("serve_probe", "rollup_daily"),
       inputs=("tpukernels/serve", "tools/serve_optimize.py")),
-    # 3f. adaptive-bucket canary (docs/SERVING.md §adaptive buckets):
+    # 3g. adaptive-bucket canary (docs/SERVING.md §adaptive buckets):
     #     re-autotune the candidate table (--autotune quick, the >3%
     #     margin), boot incumbent + candidate daemons off-window and
     #     replay the frozen shape mix at identical seeds; promotion

@@ -12,6 +12,7 @@ Usage:
     python tools/serve_ctl.py health [--wait S]
     python tools/serve_ctl.py guardian [--wait S]
     python tools/serve_ctl.py fsck
+    python tools/serve_ctl.py top [--once] [--interval S] [--socket PATH]
 
 Single daemon: ``start`` spawns ``python -m tpukernels.serve``
 detached and waits for a protocol ping; ``stop`` SIGTERMs the pid
@@ -50,6 +51,15 @@ original front socket (``tpukernels/serve/guardian.py``) — and waits
 for it to hold its pidfile flock. ``stop-fleet`` stops the guardian
 FIRST: stopped any later it would read the intentional router stop
 as a crash and respawn it mid-teardown.
+
+``top`` (docs/SERVING.md §stats op) is the live fleet dashboard —
+one read-only ``stats`` round trip per frame against the front
+socket (or a lone daemon) rendering rps, streaming-histogram
+p50/p99, queue depths, spills/throttles, bytes copied and the
+metrics flusher's ``last_snapshot_age_s`` per worker. ``--once``
+prints a single frame and exits; without it the screen refreshes
+every ``--interval`` seconds until Ctrl-C. Delegates to
+``tools/fleet_top.py``.
 
 ``fsck`` (docs/RESILIENCE.md §atomic state) reaps what crashes leave
 behind: pidfiles whose flock nothing holds, ``tpkserve-*`` shm
@@ -132,6 +142,12 @@ def _stats_line(stats) -> str:
     # fleet operator reads here instead of the journal — copied stays
     # 0 while the shm warm path is engaged, window collapses to 0ms
     # when the daemon idles (docs/SERVING.md)
+    # snap_age: the metrics flusher's last_snapshot_age_s
+    # (docs/OBSERVABILITY.md §live telemetry) — "off" when
+    # TPK_METRICS_FLUSH_S is unset; a value growing past the flush
+    # interval means the flusher thread died and this worker's
+    # journal telemetry is silently going stale
+    age = stats.get("last_snapshot_age_s")
     return (f"served={stats.get('served')} "
             f"rejected={stats.get('rejected')} "
             f"requeued={stats.get('requeued')} "
@@ -140,6 +156,7 @@ def _stats_line(stats) -> str:
             f"copied={stats.get('bytes_copied')}B "
             f"window={stats.get('batch_window_ms')}ms "
             f"lanes={','.join(stats.get('lanes') or ['inline'])} "
+            f"snap_age={'off' if age is None else f'{age:.1f}s'} "
             f"buckets={len(buckets)}"
             + (f" [{', '.join(buckets)}]" if buckets else ""))
 
@@ -610,7 +627,7 @@ def fsck() -> int:
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     verbs = ("start", "stop", "status", "start-fleet", "stop-fleet",
-             "drain", "undrain", "health", "guardian", "fsck")
+             "drain", "undrain", "health", "guardian", "fsck", "top")
     if not argv or argv[0] not in verbs:
         print(__doc__, file=sys.stderr)
         return 2
@@ -626,6 +643,7 @@ def main(argv=None):
         count = int(rest[0])
         rest = rest[1:]
     wait_s, socket_path = 30.0, None
+    once, interval_s = False, 2.0
     it = iter(rest)
     try:
         for a in it:
@@ -633,6 +651,10 @@ def main(argv=None):
                 wait_s = float(next(it))
             elif a == "--socket":
                 socket_path = next(it)
+            elif a == "--once" and cmd == "top":
+                once = True
+            elif a == "--interval" and cmd == "top":
+                interval_s = float(next(it))
             else:
                 print(__doc__, file=sys.stderr)
                 print(f"serve_ctl: unknown argument {a!r}",
@@ -663,6 +685,14 @@ def main(argv=None):
         return guardian(wait_s)
     if cmd == "fsck":
         return fsck()
+    if cmd == "top":
+        # the dashboard lives in its own module (tools/fleet_top.py);
+        # loaded by path because tools/ is a script dir, not a package
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import fleet_top
+
+        return fleet_top.run(once=once, interval_s=interval_s,
+                             socket_path=socket_path)
     return status(socket_path)
 
 

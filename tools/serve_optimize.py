@@ -74,8 +74,14 @@ def _cmd_propose(journals, target, check):
         target = adapt.pad_target()
     need = adapt.min_requests()
     events = _mine_events(journals)
-    mix = adapt.shape_mix(events)
+    days = adapt.window_days()
+    mix, days_used = adapt.window_mix(events, days=days)
     seen = adapt.mix_requests(mix)
+    if days > 1:
+        print(f"serve_optimize: mining a {days}-day window "
+              f"(TPK_ADAPT_WINDOW_DAYS): today's journal + "
+              f"{days_used - 1} prior rollup day(s), {seen} "
+              "request(s) total")
     max_pad = bucketing.max_pad_frac()
     incumbent = bucketing.bucket_configs()
     if seen < need:
@@ -101,6 +107,7 @@ def _cmd_propose(journals, target, check):
         "adapt_proposed", path=p, requests_mined=seen,
         pad_target=target,
         hist_pad_frac=hist,
+        window_days=days_used,
         proposals=[
             {"action": a["action"], "kernel": a["kernel"],
              "waste_saved": a["waste_saved"],

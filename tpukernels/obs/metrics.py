@@ -22,7 +22,9 @@ Design constraints, in order:
    because the failing run is exactly the one a postmortem reads.
    C hosts never finalize the interpreter, so ``capi.shutdown_from_c``
    calls :func:`emit_snapshot` explicitly (the same split the
-   profiler-flush uses). Only a hard SIGKILL loses the snapshot.
+   profiler-flush uses). Only a hard SIGKILL loses the snapshot —
+   and with the periodic flusher below enabled, at most one flush
+   interval of it.
 3. **Histograms are streaming: summaries plus log buckets.** Each
    histogram keeps count/sum/min/max (mean derivable) AND a
    log-bucketed distribution (base 2^(1/4) ≈ 19%-wide buckets — one
@@ -33,6 +35,20 @@ Design constraints, in order:
    ``tpukernels/obs/slo.py``'s latency-SLO verdicts) read percentiles
    without re-deriving bucket arithmetic. Memory stays bounded: a
    bucket per occupied power-of-2^(1/4), never a sample list.
+4. **Live streaming is opt-in and delta-encoded.** With
+   ``TPK_METRICS_FLUSH_S`` set (default OFF — the TPK_TRACE opt-in
+   pattern, clean-path stdout stays byte-identical either way), a
+   daemon flusher thread emits one ``metrics_snapshot`` journal event
+   per interval: a monotonic per-process ``seq``, counter DELTAS
+   since the previous flush (zero deltas omitted), full gauges, and
+   only the histogram rows whose count moved (each emitted row is
+   full-cumulative, so the latest row per name stands alone). The
+   atexit ``metrics`` event stays the final authoritative FULL
+   snapshot; consumers must dedupe by (pid, seq), fold snapshot
+   deltas in seq order, and let a final ``metrics`` event supersede
+   the folds entirely — never sum the two.
+   :func:`merge_journal_metrics` is the one shared reconstruction
+   every reader uses (docs/OBSERVABILITY.md §live telemetry).
 
 State is per-process (bench ``--one`` children snapshot their own)
 and THREAD-SAFE: a single module lock guards every record/snapshot,
@@ -46,7 +62,10 @@ update; :func:`reset` exists for tests.
 from __future__ import annotations
 
 import math
+import os
+import sys
 import threading
+import time
 
 from tpukernels.resilience import journal
 
@@ -175,12 +194,225 @@ def emit_snapshot(site: str | None = None):
     journal.emit("metrics", site=site, **snapshot())
 
 
+# --- periodic snapshot flusher (docstring item 4) -----------------
+#
+# All flusher bookkeeping lives under the same _LOCK as the recorders:
+# _SEQ is the per-process monotonic snapshot sequence, _FLUSH_COUNTERS
+# holds counter values as of the last flush (deltas are computed
+# against it), _FLUSH_HIST_COUNTS holds each histogram's count at the
+# last flush (a row is re-emitted only when its count moved), and
+# _LAST_FLUSH is the wall time of the last successful flush — the
+# stats op turns it into last_snapshot_age_s so a dead flusher thread
+# is visible before metrics silently go stale.
+
+_SEQ = 0
+_FLUSH_COUNTERS: dict = {}
+_FLUSH_HIST_COUNTS: dict = {}
+_LAST_FLUSH: list = []  # [] = never flushed; [t_wall] otherwise
+_FLUSHER: threading.Thread | None = None
+_FLUSHER_STOP = threading.Event()
+
+
+def flush_interval_s(env=None) -> float | None:
+    """Parse ``TPK_METRICS_FLUSH_S``: ``None`` (flusher off) when the
+    knob is unset, empty, or one of 0/off/none/false; otherwise the
+    interval in seconds. Anything else — a typo'd value, a negative
+    interval — raises ValueError naming the knob, the fail-loud knob
+    contract (docs/KNOBS.md): a daemon started with a broken telemetry
+    config must refuse to start, not silently serve blind."""
+    target = os.environ if env is None else env
+    raw = target.get("TPK_METRICS_FLUSH_S")
+    if raw is None or not raw.strip():
+        return None
+    if raw.strip().lower() in ("0", "off", "none", "false"):
+        return None
+    try:
+        val = float(raw)
+    except ValueError:
+        val = -1.0
+    if not val > 0.0:
+        raise ValueError(
+            f"TPK_METRICS_FLUSH_S={raw!r}: expected a positive number"
+            " of seconds, or 0/off/none/false to disable"
+        )
+    return val
+
+
+def emit_periodic_snapshot(site: str | None = None) -> int | None:
+    """Emit one delta-encoded ``metrics_snapshot`` journal event and
+    return its seq (None when skipped: journaling off or nothing ever
+    recorded). Counter values are DELTAS since the previous snapshot
+    (zero deltas omitted); gauges are full (last-write-wins already);
+    histogram rows are emitted only when their count moved since the
+    last flush, but each emitted row is the full cumulative row — the
+    latest row per name stands alone, no fold needed."""
+    global _SEQ
+    if not journal.enabled():
+        return None
+    with _LOCK:
+        if not (_COUNTERS or _GAUGES or _HISTS):
+            return None
+        deltas = {}
+        for k, v in _COUNTERS.items():
+            d = v - _FLUSH_COUNTERS.get(k, 0)
+            if d:
+                deltas[k] = d
+        hists = {
+            k: _hist_row(v)
+            for k, v in _HISTS.items()
+            if v[0] != _FLUSH_HIST_COUNTS.get(k)
+        }
+        gauges = dict(_GAUGES)
+        _SEQ += 1
+        seq = _SEQ
+        _FLUSH_COUNTERS.clear()
+        _FLUSH_COUNTERS.update(_COUNTERS)
+        _FLUSH_HIST_COUNTS.clear()
+        _FLUSH_HIST_COUNTS.update({k: v[0] for k, v in _HISTS.items()})
+        _LAST_FLUSH[:] = [time.time()]
+    journal.emit(
+        "metrics_snapshot",
+        seq=seq,
+        site=site,
+        counters=deltas,
+        gauges=gauges,
+        histograms=hists,
+    )
+    return seq
+
+
+def last_flush_age_s() -> float | None:
+    """Seconds since the last periodic snapshot, None when the flusher
+    never flushed (off, or nothing recorded yet). A daemon whose value
+    keeps growing past its flush interval has a dead flusher thread."""
+    with _LOCK:
+        if not _LAST_FLUSH:
+            return None
+        return max(0.0, time.time() - _LAST_FLUSH[0])
+
+
+def _flusher_loop(interval_s: float):
+    site = "flush:" + os.path.basename(sys.argv[0] or "?")
+    # No blanket except: journal.emit never raises by contract, so an
+    # exception here is a real bug — letting it kill the thread is what
+    # makes last_snapshot_age_s an honest liveness signal.
+    while not _FLUSHER_STOP.wait(interval_s):
+        emit_periodic_snapshot(site=site)
+
+
+def start_flusher(interval_s: float | None = None) -> bool:
+    """Start the periodic flusher thread (idempotent). With no
+    argument the interval comes from TPK_METRICS_FLUSH_S; returns
+    False (no thread) when the knob is off."""
+    global _FLUSHER
+    if interval_s is None:
+        interval_s = flush_interval_s()
+    if interval_s is None:
+        return False
+    if _FLUSHER is not None and _FLUSHER.is_alive():
+        return True
+    _FLUSHER_STOP.clear()
+    t = threading.Thread(
+        target=_flusher_loop,
+        args=(interval_s,),
+        daemon=True,
+        name="tpk-metrics-flusher",
+    )
+    _FLUSHER = t
+    t.start()
+    return True
+
+
+def stop_flusher():
+    """Stop the flusher thread if running (tests, clean shutdown)."""
+    global _FLUSHER
+    t = _FLUSHER
+    _FLUSHER = None
+    if t is not None and t.is_alive():
+        _FLUSHER_STOP.set()
+        t.join(timeout=5.0)
+    _FLUSHER_STOP.clear()
+
+
+def merge_journal_metrics(events) -> dict:
+    """The one shared reconstruction of per-process metric state from
+    journal events, fixing the snapshot/atexit double-count seam:
+
+    - a pid with a full ``metrics`` event (atexit or explicit flush)
+      uses its LATEST such event outright — snapshots never add to it;
+    - otherwise ``metrics_snapshot`` events are deduped by (pid, seq)
+      and folded in seq order: counter deltas summed, gauges and
+      histogram rows latest-seq-wins per name.
+
+    Returns ``{pid: {"counters", "gauges", "histograms", "site",
+    "seq", "final", "t", "ts"}}`` where ``final`` says whether the pid
+    ended with an authoritative full snapshot and ``seq`` is the
+    highest snapshot sequence seen (None when only ``metrics``)."""
+    finals: dict = {}
+    snaps: dict = {}
+    for e in events:
+        kind = e.get("kind")
+        if kind == "metrics":
+            finals[e.get("pid")] = e
+        elif kind == "metrics_snapshot":
+            seq = e.get("seq")
+            if isinstance(seq, int):
+                snaps.setdefault(e.get("pid"), {})[seq] = e
+    out: dict = {}
+    for pid, by_seq in snaps.items():
+        if pid in finals:
+            continue
+        counters: dict = {}
+        gauges: dict = {}
+        hists: dict = {}
+        last = None
+        for seq in sorted(by_seq):
+            e = by_seq[seq]
+            for k, d in (e.get("counters") or {}).items():
+                if isinstance(d, (int, float)):
+                    counters[k] = counters.get(k, 0) + d
+            for k, v in (e.get("gauges") or {}).items():
+                gauges[k] = v
+            for k, row in (e.get("histograms") or {}).items():
+                if isinstance(row, dict):
+                    hists[k] = row
+            last = e
+        out[pid] = {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+            "site": last.get("site"),
+            "seq": max(by_seq),
+            "final": False,
+            "t": last.get("t"),
+            "ts": last.get("ts"),
+        }
+    for pid, e in finals.items():
+        seqs = snaps.get(pid)
+        out[pid] = {
+            "counters": dict(e.get("counters") or {}),
+            "gauges": dict(e.get("gauges") or {}),
+            "histograms": dict(e.get("histograms") or {}),
+            "site": e.get("site"),
+            "seq": max(seqs) if seqs else None,
+            "final": True,
+            "t": e.get("t"),
+            "ts": e.get("ts"),
+        }
+    return out
+
+
 def reset():
     """Drop all recorded state (tests; never called on real paths)."""
     with _LOCK:
         _COUNTERS.clear()
         _GAUGES.clear()
         _HISTS.clear()
+        _FLUSH_COUNTERS.clear()
+        _FLUSH_HIST_COUNTS.clear()
+        _LAST_FLUSH.clear()
+        global _SEQ
+        _SEQ = 0
 
 
 def _atexit_flush():
@@ -195,3 +427,12 @@ def _atexit_flush():
 import atexit  # noqa: E402 — placed with its registration on purpose
 
 atexit.register(_atexit_flush)
+
+# Opt-in streaming: started at import so ANY process that records
+# metrics (daemon, router, bench child, loadgen) streams snapshots
+# under TPK_METRICS_FLUSH_S without per-callsite wiring. Default off;
+# a malformed knob value raises HERE, at import — the fail-loud knob
+# contract means a process with a broken telemetry config refuses to
+# run rather than serving blind.
+if flush_interval_s() is not None:
+    start_flusher()

@@ -1,0 +1,365 @@
+"""Daily journal rollups: long-horizon series artifacts
+(docs/OBSERVABILITY.md §live telemetry; ROADMAP item 5's multi-day
+headroom).
+
+The journal is the repo's evidence stream, but it is per-day and
+per-run: ``health_<date>.jsonl`` files grow unboundedly detailed and
+the verdict layer only ever reads tails. Long-horizon questions —
+"has sgemm's p99 crept 8% over a week?", "what shape mix should the
+bucket optimizer mine when today had no traffic?" — need a compact,
+validated series. This module compacts ONE day's journal files into
+one ``rollup_<date>.json`` artifact holding exactly what the
+long-horizon consumers read:
+
+- ``counters``: fleet-total metric counters, reconstructed per pid by
+  :func:`tpukernels.obs.metrics.merge_journal_metrics` (snapshots
+  deduped by (pid, seq), atexit events authoritative — the rollup
+  inherits the double-count fix, it does not re-implement it);
+- ``requests``: per-kernel wall-time histograms over OK
+  ``serve_request`` events, in the metrics module's shared log-bucket
+  geometry so rows MERGE with live histograms and feed the same
+  ``percentiles`` arithmetic;
+- ``shape_mix``: :func:`tpukernels.serve.adapt.shape_mix` rows, so
+  the optimizer mines yesterday from 20 lines of rollup instead of
+  200k lines of journal;
+- ``kinds``: an event-kind census (cheap forensics: "how many
+  watchdog kills last Tuesday?").
+
+Discipline is the tuning/aot/slo artifact contract: atomic write
+(:func:`tpukernels.resilience.atomic.dump_json`), stamped with the
+jax version and the newest commit sha touching :data:`SOURCES`,
+validated at read, and a stale/torn/malformed artifact is LOUDLY
+rejected (stderr + ``rollup_rejected`` journal event, once per
+process per cause) — a week-old rollup written by last week's mining
+code must not silently steer today's bucket table. The artifact body
+is deliberately TIMESTAMP-FREE: rolling up the same journal twice
+yields byte-identical files, so the daily supervisor step
+(``rollup_daily``) is idempotent and a changed rollup always means
+changed evidence.
+
+Consumers: ``tools/obs_report.py`` (the ``p99_creep`` trend verdict
+over :func:`load_series`), ``tools/serve_optimize.py`` (multi-day
+mining under ``TPK_ADAPT_WINDOW_DAYS``), and humans. Writer: the
+``python -m tpukernels.obs.rollup`` CLI, run daily and non-gating by
+the supervisor, with :data:`RETENTION_DAYS` pruning.
+
+Rollups live in ``TPK_ROLLUP_DIR`` (default ``docs/logs``, beside
+the journals they compact — the TPK_SCALING_DIR series-artifact
+convention, not the cache-dir one: rollups are evidence, not cache).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+
+from tpukernels import _cachedir
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.resilience import journal
+
+SCHEMA = 1
+# pruned by the daily CLI: long enough for quarterly forensics, short
+# enough that docs/logs never becomes an unbounded artifact graveyard
+RETENTION_DAYS = 90
+
+# sources whose newer commit invalidates a persisted rollup: the
+# compactor itself, the histogram/merge arithmetic the aggregates
+# depend on, and the miner whose shape_mix rows the artifact stores
+SOURCES = (
+    "tpukernels/obs/rollup.py",
+    "tpukernels/obs/metrics.py",
+    "tpukernels/serve/adapt.py",
+)
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_DATE_RE = re.compile(r"health_(\d{4}-\d{2}-\d{2})\.jsonl$")
+_ROLLUP_RE = re.compile(r"rollup_(\d{4}-\d{2}-\d{2})\.json$")
+
+_MEMO: dict = {}
+_REJECT_NOTED: set = set()
+
+
+def reset():
+    """Drop per-process state (tests)."""
+    _MEMO.clear()
+    _REJECT_NOTED.clear()
+
+
+def rollup_dir(env=None) -> str:
+    """``TPK_ROLLUP_DIR`` (re-read per call, the _cachedir dir-helper
+    convention), defaulting to the repo's ``docs/logs`` — rollups are
+    series evidence and live beside the journals they compact."""
+    target = os.environ if env is None else env
+    d = target.get("TPK_ROLLUP_DIR")
+    if d:
+        return d
+    return os.path.join(_REPO, "docs", "logs")
+
+
+def rollup_path(date_str: str, env=None) -> str:
+    return os.path.join(rollup_dir(env), f"rollup_{date_str}.json")
+
+
+def journal_dir() -> str:
+    """The directory holding dated journal files: wherever the live
+    journal resolves (or would resolve) to."""
+    return os.path.dirname(journal.path() or journal.default_path())
+
+
+def journal_dates() -> dict:
+    """``{date: [paths]}`` of dated journal files present on disk,
+    sorted ascending by date."""
+    out: dict = {}
+    for p in sorted(glob.glob(os.path.join(journal_dir(),
+                                           "health_*.jsonl"))):
+        m = _DATE_RE.search(os.path.basename(p))
+        if m:
+            out.setdefault(m.group(1), []).append(p)
+    return dict(sorted(out.items()))
+
+
+def _jax_version():
+    import jax  # lazy: keeps this module importable without jax
+
+    return jax.__version__
+
+
+def build(date_str: str, events, bad_lines: int = 0) -> dict:
+    """The rollup artifact body for one day's events — pure and
+    TIMESTAMP-FREE: same events in, byte-identical JSON out."""
+    from tpukernels.serve import adapt
+    from tpukernels.tuning import cache as tcache
+
+    kinds: dict = {}
+    for e in events:
+        k = e.get("kind")
+        if isinstance(k, str):
+            kinds[k] = kinds.get(k, 0) + 1
+
+    merged = obs_metrics.merge_journal_metrics(events)
+    counters: dict = {}
+    for state in merged.values():
+        for name, v in state["counters"].items():
+            if isinstance(v, (int, float)):
+                counters[name] = counters.get(name, 0) + v
+
+    hists: dict = {}
+    for e in events:
+        if e.get("kind") != "serve_request" or not e.get("ok"):
+            continue
+        kernel = e.get("kernel")
+        w = e.get("wall_s")
+        if not kernel or not isinstance(w, (int, float)):
+            continue
+        h = hists.get(kernel)
+        if h is None:
+            hists[kernel] = [1, float(w), float(w), float(w),
+                             {obs_metrics.bucket_index(w): 1}]
+        else:
+            h[0] += 1
+            h[1] += float(w)
+            h[2] = min(h[2], float(w))
+            h[3] = max(h[3], float(w))
+            b = obs_metrics.bucket_index(w)
+            h[4][b] = h[4].get(b, 0) + 1
+    requests = {
+        k: obs_metrics._hist_row(v) for k, v in sorted(hists.items())
+    }
+
+    mix = adapt.shape_mix(events)
+
+    return {
+        "schema": SCHEMA,
+        "date": date_str,
+        "jax": _jax_version(),
+        "source_sha": tcache.source_sha(SOURCES),
+        "git_head": journal.git_head(),
+        "events": len(events),
+        "bad_lines": bad_lines,
+        "pids": len(merged),
+        "kinds": kinds,
+        "counters": counters,
+        "requests": requests,
+        "shape_mix": mix,
+    }
+
+
+def write_day(date_str: str, paths=None) -> str | None:
+    """Compact one day's journal files into ``rollup_<date>.json``
+    (atomic, ``rollup_written`` journal event). Returns the path, or
+    None when the day has no events to roll up."""
+    if paths is None:
+        paths = journal_dates().get(date_str, [])
+    events, bad = journal.load_events(paths)
+    if not events:
+        return None
+    art = build(date_str, events, bad_lines=bad)
+    p = rollup_path(date_str)
+    d = os.path.dirname(p)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    from tpukernels.resilience import atomic
+
+    atomic.dump_json(p, art)
+    _MEMO.pop(p, None)
+    journal.emit(
+        "rollup_written", path=p, date=date_str,
+        events=len(events), bad_lines=bad,
+        kernels=sorted(art["requests"]),
+        requests=sum(r["count"] for r in art["requests"].values()),
+    )
+    return p
+
+
+def _reject(p: str, reason: str, **fields):
+    """Loud-rejection contract shared with tuning/aot/slo/adapt:
+    stderr note + ``rollup_rejected`` journal event, once per process
+    per (path, cause)."""
+    memo = (p, reason)
+    if memo in _REJECT_NOTED:
+        return
+    _REJECT_NOTED.add(memo)
+    print(f"# rollup rejected: {os.path.basename(p)}: {reason}",
+          file=sys.stderr)
+    journal.emit("rollup_rejected", path=p, reason=reason, **fields)
+
+
+def load_day(date_str: str, validate: bool = True):
+    """The validated rollup for one date, or None. A torn file reads
+    as absent via the shared tolerant reader and is rejected loudly
+    here (the reader's own ``artifact_rejected`` note fires too); a
+    rollup written under a different jax version, or predating a
+    commit to :data:`SOURCES`, is stale — yesterday compacted by last
+    month's mining code must not steer today's bucket table."""
+    p = rollup_path(date_str)
+    data = _cachedir.read_json_memoized(p, _MEMO)
+    if not data:
+        if os.path.exists(p):
+            _reject(p, "torn or empty")
+        return None
+    if data.get("schema") != SCHEMA:
+        _reject(p, f"schema {data.get('schema')!r}, expected {SCHEMA}")
+        return None
+    if data.get("date") != date_str:
+        _reject(p, f"date {data.get('date')!r} does not match filename")
+        return None
+    if not validate:
+        return data
+    if data.get("jax") != _jax_version():
+        _reject(
+            p,
+            f"written under jax {data.get('jax')}, "
+            f"running {_jax_version()}",
+        )
+        return None
+    from tpukernels.tuning import cache as tcache
+
+    sha = tcache.source_sha(SOURCES)
+    if sha is not None and data.get("source_sha") not in (None, sha):
+        _reject(
+            p,
+            "stale: a commit touching " + ",".join(SOURCES)
+            + " postdates this rollup",
+            entry_sha=data.get("source_sha"), current_sha=sha,
+        )
+        return None
+    return data
+
+
+def rollup_dates() -> list:
+    """Dates (ascending) with a rollup artifact on disk — validity
+    checked only at :func:`load_day` time."""
+    out = []
+    for p in sorted(glob.glob(os.path.join(rollup_dir(),
+                                           "rollup_*.json"))):
+        m = _ROLLUP_RE.search(os.path.basename(p))
+        if m:
+            out.append(m.group(1))
+    return out
+
+
+def load_series(days: int | None = None, end_date: str | None = None,
+                validate: bool = True) -> list:
+    """``[(date, rollup), ...]`` ascending over the validated rollups
+    on disk — at most the last ``days`` dates, excluding any after
+    ``end_date``. Invalid artifacts are rejected (loudly, by
+    :func:`load_day`) and skipped, never silently substituted."""
+    dates = rollup_dates()
+    if end_date is not None:
+        dates = [d for d in dates if d <= end_date]
+    if days is not None:
+        dates = dates[-days:]
+    out = []
+    for d in dates:
+        data = load_day(d, validate=validate)
+        if data is not None:
+            out.append((d, data))
+    return out
+
+
+def prune(retention_days: int = RETENTION_DAYS,
+          today: str | None = None) -> list:
+    """Unlink rollups older than ``retention_days`` (by filename
+    date, lexicographic — ISO dates sort). Returns pruned paths."""
+    if today is None:
+        import datetime
+
+        today = datetime.date.today().isoformat()
+    import datetime
+
+    cutoff = (
+        datetime.date.fromisoformat(today)
+        - datetime.timedelta(days=retention_days)
+    ).isoformat()
+    pruned = []
+    for d in rollup_dates():
+        if d < cutoff:
+            p = rollup_path(d)
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+            _MEMO.pop(p, None)
+            pruned.append(p)
+    return pruned
+
+
+def main(argv=None) -> int:
+    """``python -m tpukernels.obs.rollup [--date YYYY-MM-DD]``:
+    compact every dated journal present (or one date) into its rollup
+    and prune past retention. Idempotent and deterministic — the
+    daily supervisor step reruns it freely."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    date = None
+    while argv:
+        a = argv.pop(0)
+        if a == "--date" and argv:
+            date = argv.pop(0)
+        else:
+            print(f"usage: rollup [--date YYYY-MM-DD]  (got {a!r})",
+                  file=sys.stderr)
+            return 2
+    by_date = journal_dates()
+    if date is not None:
+        by_date = {date: by_date.get(date, [])}
+    wrote = 0
+    for d, paths in by_date.items():
+        p = write_day(d, paths)
+        if p:
+            wrote += 1
+            print(f"rollup: {p}")
+        else:
+            print(f"rollup: {d}: no events, skipped")
+    for p in prune():
+        print(f"rollup: pruned {p}")
+    print(f"rollup: {wrote} day(s) written, "
+          f"{len(by_date) - wrote} skipped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -545,6 +545,91 @@ def analyze_pad_waste(events) -> dict:
     }}
 
 
+# a kernel's latest daily p99 may sit this far above the median of
+# its prior days before the drift counts as creep: wider than the 1%
+# eps band on purpose — daily p99s are noisier than bench medians, and
+# this verdict exists to catch the slow multi-day drift the per-run
+# band structurally misses, not to re-fire on single noisy days
+P99_CREEP_FRAC = 0.05
+P99_CREEP_MIN_DAYS = 3
+
+
+def analyze_p99_creep(series) -> dict:
+    """Long-horizon tail-drift verdicts over a daily rollup series
+    (``tpukernels/obs/rollup.py`` :func:`load_series` output:
+    ``[(date, rollup), ...]`` ascending). Per kernel, the daily p99
+    comes off the rollup's ``requests`` wall-time histogram rows.
+
+    - ``p99_creep`` (NON-GATING, the ``below_roofline`` pattern —
+      ``obs_report --check`` keeps rc 0): the latest day's p99 sits
+      more than ``P99_CREEP_FRAC`` above the MEDIAN of the prior
+      days' p99s AND is the worst day in the window — a tail that is
+      both elevated and still rising. A single mid-window spike that
+      already recovered stays ``ok``: that is yesterday's incident,
+      not a trend.
+    - ``no_data``: fewer than ``P99_CREEP_MIN_DAYS`` days carry a p99
+      for the kernel — two points are a line, not a drift.
+    - ``ok`` otherwise. An empty series yields no verdicts at all."""
+    by_kernel: dict = {}
+    for date, r in series:
+        for kernel, row in sorted((r.get("requests") or {}).items()):
+            p99 = (row or {}).get("p99")
+            if _is_measurement(p99) and (row or {}).get("count"):
+                by_kernel.setdefault(kernel, []).append(
+                    (date, float(p99), row.get("count"))
+                )
+    verdicts = {}
+    for kernel, pts in sorted(by_kernel.items()):
+        name = f"p99_creep[{kernel}]"
+        flags = []
+        if len(pts) < P99_CREEP_MIN_DAYS:
+            verdicts[name] = {
+                "verdict": "no_data",
+                "days": len(pts),
+                "latest": pts[-1][1],
+                "baseline": None,
+                "flags": [
+                    f"{len(pts)} day(s) with p99 data < min "
+                    f"{P99_CREEP_MIN_DAYS} - no drift verdict yet"
+                ],
+            }
+            continue
+        prior = sorted(p for _, p, _ in pts[:-1])
+        mid = len(prior) // 2
+        baseline = (
+            prior[mid] if len(prior) % 2
+            else 0.5 * (prior[mid - 1] + prior[mid])
+        )
+        date, latest, count = pts[-1]
+        creeping = (
+            baseline > 0.0
+            and latest > baseline * (1.0 + P99_CREEP_FRAC)
+            and latest >= max(p for _, p, _ in pts)
+        )
+        if creeping:
+            verdict = "p99_creep"
+            flags.append(
+                f"P99 CREEP: {kernel} p99 {latest:.6f}s on {date} "
+                f"({count} request(s)) is "
+                f"{latest / baseline:.3f}x the median of the prior "
+                f"{len(prior)} day(s) ({baseline:.6f}s) and the worst "
+                f"day in the window (band {P99_CREEP_FRAC:.0%}; "
+                "non-gating long-horizon signal)"
+            )
+        else:
+            verdict = "ok"
+        verdicts[name] = {
+            "verdict": verdict,
+            "days": len(pts),
+            "latest": round(latest, 6),
+            "latest_date": date,
+            "baseline": round(baseline, 6),
+            "creep_frac": P99_CREEP_FRAC,
+            "flags": flags,
+        }
+    return verdicts
+
+
 def analyze_repo(root, eps=CEILING_EPS) -> dict:
     """One-call path for tools: series + baseline + verdicts."""
     return analyze(load_series(root), load_baseline(root), eps=eps)

@@ -118,6 +118,27 @@ def min_requests() -> int:
     return val
 
 
+def window_days() -> int:
+    """``TPK_ADAPT_WINDOW_DAYS`` (default 1): how many days of
+    evidence the miners see — 1 is today's live journal only (the
+    PR 16 behavior); N > 1 widens the mix with the prior N-1 days'
+    rollup artifacts (``tpukernels/obs/rollup.py``), so a quiet
+    morning still proposes off a week of real traffic. Fail-loud
+    parse, >= 1."""
+    raw = os.environ.get("TPK_ADAPT_WINDOW_DAYS")
+    if raw is None:
+        return 1
+    try:
+        val = int(raw)
+    except ValueError:
+        val = 0
+    if val < 1:
+        raise ValueError(
+            f"TPK_ADAPT_WINDOW_DAYS={raw!r}: expected an int >= 1"
+        )
+    return val
+
+
 def promote_margin() -> float:
     """The >3%-over-control promotion margin — borrowed from the
     tuning layer (one authority; docs/TUNING.md) so the serving-config
@@ -185,20 +206,96 @@ def mix_requests(mix: dict) -> int:
     return sum(r["count"] for rows in mix.values() for r in rows)
 
 
+def merge_mix(mixes) -> dict:
+    """Combine :func:`shape_mix` outputs (today's live journal, prior
+    days' rollups) into one mix: rows merge by (kernel, shapes,
+    dtypes) with count/pad_frac_sum/bucketed summed — the sums are
+    exactly what re-mining the concatenated events would yield, so
+    the proposal math cannot tell a window from a single day."""
+    groups: dict = {}
+    for mix in mixes:
+        for kernel, rows in (mix or {}).items():
+            for r in rows:
+                try:
+                    shapes = [
+                        tuple(int(d) for d in s) for s in r["shapes"]
+                    ]
+                    dtypes = list(r["dtypes"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                key = (kernel, tuple(shapes), tuple(dtypes))
+                row = groups.get(key)
+                if row is None:
+                    row = groups[key] = {
+                        "kernel": kernel,
+                        "shapes": shapes,
+                        "dtypes": dtypes,
+                        "count": 0,
+                        "pad_frac_sum": 0.0,
+                        "bucketed": 0,
+                    }
+                row["count"] += int(r.get("count") or 0)
+                row["pad_frac_sum"] += float(r.get("pad_frac_sum")
+                                             or 0.0)
+                row["bucketed"] += int(r.get("bucketed") or 0)
+    out: dict = {}
+    for row in groups.values():
+        out.setdefault(row["kernel"], []).append(row)
+    for rows in out.values():
+        rows.sort(key=lambda r: (-r["count"], r["shapes"]))
+    return out
+
+
+def window_mix(events, days: int | None = None,
+               end_date: str | None = None):
+    """The miner's multi-day entry point (ROADMAP item 5's remaining
+    headroom): today's mix from ``events`` (the live journal) widened
+    with the prior ``days - 1`` days' validated rollup mixes. Returns
+    ``(mix, days_used)`` where ``days_used`` counts the rollup days
+    actually folded in (+1 for today). A rollup dated ``end_date``
+    (default: today) is SKIPPED — today's evidence comes from the
+    live journal, and folding today's own rollup in would count every
+    request twice."""
+    if days is None:
+        days = window_days()
+    today_mix = shape_mix(events)
+    if days <= 1:
+        return today_mix, 1
+    from tpukernels.obs import rollup  # lazy: stdlib-only contract
+
+    if end_date is None:
+        end_date = time.strftime("%Y-%m-%d")
+    prior = [
+        (date, art)
+        for date, art in rollup.load_series()
+        if date < end_date
+    ][-(days - 1):]
+    mixes = [today_mix] + [
+        art.get("shape_mix") or {} for _, art in prior
+    ]
+    return merge_mix(mixes), 1 + len(prior)
+
+
 def histogram_pad_frac(events):
-    """Mean live pad_frac (sum/count) off the LAST ``metrics`` event
-    carrying a ``serve.bucket_pad_frac`` histogram, or None — the
-    daemon-side aggregate twin of the per-request evidence."""
-    best = None
-    for e in events:
-        if e.get("kind") != "metrics":
-            continue
-        row = (e.get("histograms") or {}).get("serve.bucket_pad_frac")
+    """Mean live pad_frac (sum/count) of the ``serve.bucket_pad_frac``
+    histogram, or None — the daemon-side aggregate twin of the
+    per-request evidence. Reconstructed per pid by
+    ``metrics.merge_journal_metrics`` (snapshots deduped by (pid,
+    seq), a final ``metrics`` event authoritative — never summed with
+    its own snapshots), then pooled across pids: sum-of-sums over
+    sum-of-counts, each process's traffic weighted by its count."""
+    from tpukernels.obs import metrics as obs_metrics
+
+    total = 0.0
+    count = 0
+    for state in obs_metrics.merge_journal_metrics(events).values():
+        row = state["histograms"].get("serve.bucket_pad_frac")
         if isinstance(row, dict) and row.get("count"):
-            best = row
-    if best is None:
+            total += float(row["sum"])
+            count += int(row["count"])
+    if not count:
         return None
-    return float(best["sum"]) / float(best["count"])
+    return total / count
 
 
 def traffic_order(events, known) -> tuple:

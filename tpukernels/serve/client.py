@@ -300,6 +300,18 @@ class ServeClient:
         self.request_trace = bool(header.get("request_trace"))
         return header
 
+    def stats(self) -> dict:
+        """The read-only live-telemetry op (docs/SERVING.md §stats
+        op): the pong plus the live metrics snapshot, pad-pool state
+        and — against a router — per-worker ``worker_stats`` and the
+        summed ``fleet`` row. An old server answers ``ok: False``
+        with an unknown-op error; callers treat that as 'no stats
+        plane', not a dead daemon."""
+        header, _payloads, _sent = self._roundtrip(
+            {"v": protocol.VERSION, "op": "stats"}
+        )
+        return header
+
     def mint_request_id(self) -> str:
         """One fresh causal request id (pid-scoped, monotonic): the
         default when the caller never set ``next_request_id``."""

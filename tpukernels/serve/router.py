@@ -597,6 +597,8 @@ class Router:
                 op = header.get("op")
                 if op == "ping":
                     conn.send(self._stats())
+                elif op == "stats":
+                    conn.send(self._stats_fleet())
                 elif op == "dispatch":
                     self._route(conn, header, payloads)
                 elif op in ("drain", "undrain"):
@@ -665,6 +667,64 @@ class Router:
                 "device_kind": meta["device_kind"],
                 "jax": meta["jax"],
             }
+
+    def _stats_fleet(self) -> dict:
+        """The read-only ``stats`` op, fleet view (docs/SERVING.md
+        §stats op): the router's own pong + live metrics snapshot,
+        plus one upstream ``stats`` round trip per non-down worker
+        (the ``_worker_meta`` pool pattern — acquire, frame, release,
+        poison on transport failure) aggregated under ``worker_stats``
+        (index-aligned with ``workers``; None for a worker that is
+        down or did not answer) and summed into one ``fleet`` row.
+        Touches only ``self._lock`` between fan-outs — a wedged
+        worker costs its own row, never the whole view."""
+        base = self._stats()
+        base.update(
+            op="stats",
+            metrics=obs_metrics.snapshot(),
+            last_snapshot_age_s=obs_metrics.last_flush_age_s(),
+        )
+        with self._lock:
+            down = set(self._down)
+        wstats: list = []
+        for idx in range(len(self.workers)):
+            if idx in down:
+                wstats.append(None)
+                continue
+            pool = self._pools[idx]
+            sock = None
+            ok = False
+            row = None
+            try:
+                sock = pool.acquire()
+                protocol.send_frame(
+                    sock, {"v": protocol.VERSION, "op": "stats"}
+                )
+                frame = protocol.recv_frame(sock)
+                if frame is not None and frame[0].get("ok"):
+                    row = frame[0]
+                    ok = True
+            except (OSError, protocol.ProtocolError):
+                row = None
+            finally:
+                if sock is not None:
+                    pool.release(sock, poisoned=not ok)
+            wstats.append(row)
+        fleet = {"served": 0, "rejected": 0, "requeued": 0,
+                 "depth": 0, "inflight": 0, "bytes_copied": 0,
+                 "answering": 0}
+        for row in wstats:
+            if not isinstance(row, dict):
+                continue
+            fleet["answering"] += 1
+            for k in ("served", "rejected", "requeued", "depth",
+                      "inflight", "bytes_copied"):
+                v = row.get(k)
+                if isinstance(v, (int, float)):
+                    fleet[k] += v
+        base["worker_stats"] = wstats
+        base["fleet"] = fleet
+        return base
 
     def _worker_meta(self) -> dict:
         """device_kind / jax version borrowed from the first worker

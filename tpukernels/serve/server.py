@@ -430,6 +430,8 @@ class Server:
                                      request=header.get("id"))
                     self._admit(conn, header, payloads,
                                 inline_bytes=inline_bytes)
+                elif op == "stats":
+                    conn.send(self._stats_full())
                 elif op == "undrain":
                     conn.send(self._undrain())
                 else:
@@ -505,7 +507,37 @@ class Server:
             # first dispatch resolves it)
             "device_kind": self._device_kind,
             "jax": self._jax_version(),
+            # flusher liveness (docs/OBSERVABILITY.md §live telemetry):
+            # None when TPK_METRICS_FLUSH_S is off; a value growing
+            # past the flush interval means the flusher thread died
+            "last_snapshot_age_s": obs_metrics.last_flush_age_s(),
         }
+
+    def _stats_full(self) -> dict:
+        """The read-only ``stats`` op (docs/SERVING.md §stats op): the
+        ping pong plus the live metrics snapshot and the per-bucket
+        pad staging pool. Touches ONLY ``self._lock`` and the metrics
+        module lock — never a per-bucket dispatch lock, so `serve_ctl
+        top` against a daemon wedged in a dispatch still answers."""
+        with self._lock:
+            pad_pool = {
+                b: {
+                    "bufs": len(pool),
+                    "bytes": sum(
+                        int(getattr(buf, "nbytes", 0) or 0)
+                        for buf in pool.values()
+                    ),
+                }
+                for b, pool in self._pad_pool.items()
+            }
+        base = self._stats()
+        base.update(
+            op="stats", ok=True, v=protocol.VERSION,
+            role="daemon",
+            metrics=obs_metrics.snapshot(),
+            pad_pool=pad_pool,
+        )
+        return base
 
     @staticmethod
     def _jax_version():
