@@ -349,6 +349,13 @@ os.environ.pop("TPK_FLEET_RESTART_BACKOFF_S", None)
 os.environ.pop("TPK_ROUTER_RESTART_MAX", None)
 os.environ.pop("TPK_ROUTER_RESTART_BACKOFF_S", None)
 os.environ.pop("TPK_CLIENT_RECONNECT_S", None)
+# Deadline + hedging knobs (docs/SERVING.md §deadlines): an exported
+# default deadline would stamp budgets on every test request (and an
+# exported hedge percentile would retime the tail-race tests) — they
+# pin their own.
+os.environ.pop("TPK_DEADLINE_DEFAULT_MS", None)
+os.environ.pop("TPK_ROUTE_HEDGE_PCTL", None)
+os.environ.pop("TPK_ROUTE_HEDGE_MAX_FRAC", None)
 if "TPK_SERVE_DIR" not in os.environ:
     import glob as _serve_glob
     import signal as _serve_signal

@@ -1,0 +1,690 @@
+"""CPU suite for end-to-end request deadlines and hedged tail
+tolerance (docs/SERVING.md §deadlines, §hedged dispatch; ISSUE 19).
+
+The acceptance headline, all on CPU over Unix sockets: a
+``delay_response`` fault holds the scan bucket's home worker's
+COMPLETED response on the floor for 5 s — the router's hedge fires at
+its own forward-wall percentile, re-issues the SAME request_id to the
+ring sibling stamped as a replay, the sibling's response wins the
+race, the loser is cancelled best-effort, the client meets a deadline
+the faulted worker alone would have blown, and the journal proves
+zero duplicate (non-replay) dispatches and zero post-expiry
+dispatches. Plus the pure units: skew-free budget arithmetic
+(``protocol.deadline_from_header`` / ``stamp_budget``), the batch
+coalescing window clamp, WAL-replay expiry and re-stamping, hedge
+gating (samples / siblings / budget-cap), cancel phases
+(queued / inflight / miss) with their claim_done races, loadgen's
+``--deadline-ms`` parse, and the reqtrace assembly of hedge / cancel
+/ expiry evidence.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_fleet import _fleet, _scan_case
+from test_serve import SCAN_BUCKET, _daemon, _events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- #
+# pure units: skew-free budget arithmetic                          #
+# ---------------------------------------------------------------- #
+
+def test_deadline_header_arithmetic_skew_free():
+    """No absolute time crosses the wire: the receiver turns the
+    frame's remaining budget into ITS OWN monotonic deadline, and a
+    forwarder re-stamps exactly the budget net of time spent here —
+    clock skew between processes cannot expire (or resurrect) a
+    request."""
+    from tpukernels.serve import protocol
+
+    # budget_ms (the per-hop remainder) wins over deadline_ms (total)
+    assert protocol.deadline_from_header(
+        {"budget_ms": 500.0, "deadline_ms": 9999.0}, now=100.0
+    ) == pytest.approx(100.5)
+    # a minimal client that only ever stamps the total still works
+    assert protocol.deadline_from_header(
+        {"deadline_ms": 250.0}, now=10.0
+    ) == pytest.approx(10.25)
+    # malformed wire values are no-deadline, never a crash surface
+    for bad in ("x", True, -1, None, [5], {}):
+        assert protocol.deadline_from_header(
+            {"budget_ms": bad}, now=0.0) is None
+    assert protocol.deadline_from_header({}, now=0.0) is None
+
+    # the one subtraction every expiry check shares, clamped at 0
+    assert protocol.budget_ms_remaining(10.25, now=10.0) == (
+        pytest.approx(250.0))
+    assert protocol.budget_ms_remaining(9.0, now=10.0) == 0.0
+
+    # stamp_budget: a copy with the remainder recomputed; the
+    # original header is never mutated, and no deadline = no stamp
+    h = {"kernel": "scan"}
+    assert protocol.stamp_budget(h, None) is h
+    out = protocol.stamp_budget(h, 10.2, now=10.0)
+    assert out["budget_ms"] == pytest.approx(200.0)
+    assert "budget_ms" not in h
+
+    # three hops, 50 ms spent at each: the budget shrinks by exactly
+    # the wall time spent, hop by hop, regardless of any per-process
+    # clock offset (each hop re-derives from its OWN now)
+    budget = 1000.0
+    for hop_clock in (5.0, 10_000.0, 3.0):  # wildly skewed clocks
+        dl = protocol.deadline_from_header(
+            {"budget_ms": budget}, now=hop_clock)
+        sent = protocol.stamp_budget({}, dl, now=hop_clock + 0.05)
+        assert sent["budget_ms"] == pytest.approx(budget - 50.0)
+        budget = sent["budget_ms"]
+    assert budget == pytest.approx(850.0)
+
+
+def test_default_deadline_knob_parse(monkeypatch):
+    from tpukernels.serve import client as serve_client
+
+    monkeypatch.delenv("TPK_DEADLINE_DEFAULT_MS", raising=False)
+    assert serve_client.default_deadline_ms() is None
+    monkeypatch.setenv("TPK_DEADLINE_DEFAULT_MS", "  ")
+    assert serve_client.default_deadline_ms() is None
+    monkeypatch.setenv("TPK_DEADLINE_DEFAULT_MS", "0")
+    assert serve_client.default_deadline_ms() is None
+    monkeypatch.setenv("TPK_DEADLINE_DEFAULT_MS", "2500")
+    assert serve_client.default_deadline_ms() == 2500.0
+    for bad in ("nope", "-5"):
+        monkeypatch.setenv("TPK_DEADLINE_DEFAULT_MS", bad)
+        with pytest.raises(ValueError):
+            serve_client.default_deadline_ms()
+
+
+def test_loadgen_deadline_spec_parse():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_loadgen_dl", os.path.join(REPO, "tools", "loadgen.py"))
+    lg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lg)
+    assert lg._parse_deadline_ms("250") == (250.0, 250.0)
+    assert lg._parse_deadline_ms("200:400") == (200.0, 400.0)
+    for bad in ("0", "-3", "400:200", "0:100"):
+        with pytest.raises(ValueError):
+            lg._parse_deadline_ms(bad)
+
+
+# ---------------------------------------------------------------- #
+# pure units: the batch coalescing window clamp                    #
+# ---------------------------------------------------------------- #
+
+def test_clamp_window_never_dooms_the_tightest_deadline():
+    """Coalescing may delay a deadline-carrying request but never
+    doom it: the window is clamped to HALF the tightest remaining
+    budget (the other half is the dispatch's), and deadline-free
+    members leave the window alone."""
+    import types
+
+    from tpukernels.serve import server
+
+    clamp = server.Server._clamp_window
+    free = types.SimpleNamespace(deadline_at=None)
+    now = time.monotonic()
+    tight = types.SimpleNamespace(deadline_at=now + 0.1)
+    dead = types.SimpleNamespace(deadline_at=now - 1.0)
+
+    # window 0 stays 0 (nothing to clamp)
+    assert clamp(0.0, (tight,)) == 0.0
+    # deadline-free batch: untouched
+    assert clamp(0.25, (free, free)) == 0.25
+    # the tightest member halves it: ~100 ms remaining -> <= 50 ms
+    w = clamp(0.25, (free, tight))
+    assert 0.0 < w <= 0.051
+    # an already-dead member zeroes the wait outright (the dequeue
+    # check answers the expiry; waiting longer helps nobody)
+    assert clamp(0.25, (tight, dead)) == 0.0
+
+
+# ---------------------------------------------------------------- #
+# pure units: WAL-replay expiry + re-stamp                         #
+# ---------------------------------------------------------------- #
+
+def test_wal_replay_expires_dead_budget_and_restamps_live(
+        tmp_path, monkeypatch):
+    """The WAL bridges a router crash with EPOCH time (``t_wal``):
+    at replay, an entry whose budget drained away across the outage
+    is skipped as doomed work (journaled ``serve_request_expired``
+    where=wal_replay), and a live one is forwarded with its budget
+    re-stamped net of the time spent in the WAL."""
+    from tpukernels.serve import router as router_mod
+    from tpukernels.serve import wal as serve_wal
+
+    monkeypatch.setenv("TPK_SERVE_BUCKETS", SCAN_BUCKET)
+    jpath = str(tmp_path / "j.jsonl")
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", jpath)
+    r = router_mod.Router(
+        str(tmp_path / "front.sock"),
+        [str(tmp_path / "w0.sock"), str(tmp_path / "w1.sock")],
+    )
+    w = serve_wal.Wal(str(tmp_path / "router.wal"))
+    r.attach_wal(w)
+    w.append("dead", {
+        "header": {"v": 1, "op": "dispatch", "id": 1,
+                   "request_id": "dead-1", "budget_ms": 50.0},
+        "kernel": "scan", "bucket": "scan|8192|-",
+        "t_wal": time.time() - 10.0, "p64": [],
+    })
+    w.append("live", {
+        "header": {"v": 1, "op": "dispatch", "id": 2,
+                   "request_id": "live-1", "budget_ms": 60_000.0},
+        "kernel": "scan", "bucket": "scan|8192|-",
+        "t_wal": time.time() - 1.0, "p64": [],
+    })
+    forwarded = []
+
+    def fake_forward(idx, header, payloads):
+        forwarded.append((idx, dict(header)))
+        return {"v": 1, "id": header.get("id"), "ok": True}, ()
+
+    monkeypatch.setattr(r, "_forward", fake_forward)
+    assert r.replay_wal() == 2
+
+    # the dead entry never reached a worker
+    assert [h["request_id"] for _i, h in forwarded] == ["live-1"]
+    hdr = forwarded[0][1]
+    # re-stamped: net of ~1 s spent in the WAL, and marked a replay
+    assert 50_000.0 < hdr["budget_ms"] < 60_000.0
+    assert hdr["replay"] == 1
+    # the live result is stashed for the reconnecting client's retry
+    assert "live-1" in r._stash
+    events = _events(jpath)
+    exp = [e for e in events if e["kind"] == "serve_request_expired"]
+    assert len(exp) == 1
+    assert exp[0]["where"] == "wal_replay"
+    assert exp[0]["site"] == "router"
+    assert exp[0]["request_id"] == "dead-1"
+    # both entries settled: nothing left to replay
+    assert w.depth() == 0
+
+
+# ---------------------------------------------------------------- #
+# pure units: hedge gating                                         #
+# ---------------------------------------------------------------- #
+
+def test_hedge_gating_needs_siblings_samples_and_budget(
+        tmp_path, monkeypatch):
+    """A hedge only fires on real evidence: >= 2 workers, >=
+    HEDGE_MIN_SAMPLES completed forward walls, pctl > 0 — and the
+    max-frac budget keeps a fleet-wide slowdown from doubling its
+    own load."""
+    from tpukernels.serve import router as router_mod
+
+    monkeypatch.setenv("TPK_SERVE_BUCKETS", SCAN_BUCKET)
+    w0 = str(tmp_path / "w0.sock")
+    w1 = str(tmp_path / "w1.sock")
+
+    # one worker: no sibling to hedge to, ever
+    solo = router_mod.Router(str(tmp_path / "f1.sock"), [w0])
+    assert solo._hedge_threshold_s() is None
+
+    # pctl 0 = off even with siblings and samples
+    monkeypatch.setenv("TPK_ROUTE_HEDGE_PCTL", "0")
+    off = router_mod.Router(str(tmp_path / "f2.sock"), [w0, w1])
+    for _ in range(router_mod.HEDGE_MIN_SAMPLES + 5):
+        off._note_fwd_wall(0.01)
+    assert off._hedge_threshold_s() is None
+    monkeypatch.delenv("TPK_ROUTE_HEDGE_PCTL")
+
+    r = router_mod.Router(str(tmp_path / "f3.sock"), [w0, w1])
+    # not enough samples to trust a tail estimate yet
+    for _ in range(router_mod.HEDGE_MIN_SAMPLES - 1):
+        r._note_fwd_wall(0.01)
+    assert r._hedge_threshold_s() is None
+    r._note_fwd_wall(0.01)
+    thr = r._hedge_threshold_s()
+    assert thr is not None and thr > 0.0
+
+    # the hedge-budget cap (default 0.1 of routed traffic)
+    assert not r._hedge_frac_ok()       # no routed traffic yet
+    r._routed = 100
+    assert r._hedge_frac_ok()
+    r._hedged = 9
+    assert r._hedge_frac_ok()           # 10 <= 0.1 * 100
+    r._hedged = 10
+    assert not r._hedge_frac_ok()       # 11 > 0.1 * 100
+
+
+# ---------------------------------------------------------------- #
+# pure units: cancel phases and their races                        #
+# ---------------------------------------------------------------- #
+
+class _DummyConn:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, header, payloads=()):
+        self.sent.append(header)
+        return 0
+
+
+def _mk_request(server_mod, serial, request_id):
+    return server_mod._Request(
+        serial=serial, rid=serial, kernel="scan", statics={},
+        arrays=[np.zeros(4, np.int32)], spec=None, pad_frac=0.0,
+        bucket="scan|8192|-", conn=_DummyConn(),
+        request_id=request_id,
+    )
+
+
+def test_cancel_phases_queued_inflight_miss(tmp_path, monkeypatch):
+    """The best-effort ``cancel`` op: a queued loser is dropped
+    before it wastes a dispatch, an in-flight one has its answer
+    suppressed via the claim_done race (a running dispatch cannot be
+    interrupted), and a miss is success too — cancel is advisory,
+    never load-bearing."""
+    from tpukernels.serve import server as server_mod
+
+    monkeypatch.setenv("TPK_SERVE_BUCKETS", SCAN_BUCKET)
+    jpath = str(tmp_path / "j.jsonl")
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", jpath)
+    srv = server_mod.Server(socket_path=str(tmp_path / "s.sock"),
+                            workers=1)
+
+    # miss: unknown id is still ok (advisory), nothing journaled
+    resp = srv._cancel({"request_id": "ghost", "id": 7})
+    assert resp["ok"] is True
+    assert srv._cancelled == 0
+
+    # queued: dropped before dispatch
+    q1 = _mk_request(server_mod, 1, "c-queued")
+    srv._q.put_nowait(q1)
+    resp = srv._cancel({"request_id": "c-queued"})
+    assert resp["ok"] is True
+    assert srv._q.depth() == 0
+    assert q1.done is True
+    assert srv._cancelled == 1
+
+    # inflight: the done flag is claimed, so the worker's eventual
+    # answer loses the claim_done race and is discarded unsent
+    q2 = _mk_request(server_mod, 2, "c-inflight")
+    srv._inflight[q2.serial] = q2
+    resp = srv._cancel({"request_id": "c-inflight"})
+    assert resp["ok"] is True
+    assert q2.claim_done() is False     # the worker's side of the race
+    assert srv._cancelled == 2
+
+    # double-cancel race: the second claim finds done already taken
+    # and degrades to a miss — the counter moves exactly once
+    srv._inflight[3] = q3 = _mk_request(server_mod, 3, "c-race")
+    assert srv._cancel({"request_id": "c-race"})["ok"] is True
+    assert srv._cancel({"request_id": "c-race"})["ok"] is True
+    assert q3.done is True
+    assert srv._cancelled == 3
+
+    events = _events(jpath)
+    phases = [e.get("phase") for e in events
+              if e["kind"] == "serve_cancelled"]
+    assert phases == ["queued", "inflight", "inflight"]
+
+
+# ---------------------------------------------------------------- #
+# hedge race: first response wins, loser cancelled                 #
+# ---------------------------------------------------------------- #
+
+class _FakeWorker:
+    """A protocol-speaking worker avatar: answers dispatches after
+    ``delay_s`` (tagged so the test can see who won) and records the
+    cancel ops it receives — the router under test is real, the
+    workers are scripted."""
+
+    def __init__(self, path, delay_s=0.0, tag="w"):
+        self.path, self.delay_s, self.tag = path, delay_s, tag
+        self.dispatches: list = []
+        self.cancels: list = []
+        self.lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(path)
+        self._srv.listen(16)
+        threading.Thread(target=self._accept, daemon=True,
+                         name=f"fake-{tag}").start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        from tpukernels.serve import protocol
+        try:
+            while True:
+                frame = protocol.recv_frame(conn)
+                if frame is None:
+                    return
+                header, _payloads = frame
+                if header.get("op") == "cancel":
+                    with self.lock:
+                        self.cancels.append(header.get("request_id"))
+                    protocol.send_frame(conn, {
+                        "v": protocol.VERSION, "op": "cancel",
+                        "ok": True, "id": header.get("id")})
+                    continue
+                with self.lock:
+                    self.dispatches.append(dict(header))
+                if self.delay_s:
+                    time.sleep(self.delay_s)
+                protocol.send_frame(conn, {
+                    "v": protocol.VERSION, "id": header.get("id"),
+                    "ok": True, "kind": "result",
+                    "served_by": self.tag, "specs": []})
+        except (OSError, protocol.ProtocolError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def test_hedge_first_response_wins_and_cancels_loser(
+        tmp_path, monkeypatch):
+    """The race itself, against scripted workers: the primary sits on
+    its answer for 3 s, the hedge fires at the (tiny) forward-wall
+    percentile, the sibling's response wins, the loser gets a
+    best-effort cancel — and the hedge leg carries the SAME
+    request_id stamped as a replay with a shrunken budget (the
+    dedupe evidence)."""
+    from tpukernels.serve import protocol
+    from tpukernels.serve import router as router_mod
+
+    monkeypatch.setenv("TPK_SERVE_BUCKETS", SCAN_BUCKET)
+    jpath = str(tmp_path / "j.jsonl")
+    monkeypatch.setenv("TPK_HEALTH_JOURNAL", jpath)
+    slow = _FakeWorker(str(tmp_path / "w0.sock"), delay_s=3.0,
+                       tag="slow")
+    fast = _FakeWorker(str(tmp_path / "w1.sock"), delay_s=0.0,
+                       tag="fast")
+    try:
+        r = router_mod.Router(str(tmp_path / "front.sock"),
+                              [slow.path, fast.path])
+        for _ in range(router_mod.HEDGE_MIN_SAMPLES + 5):
+            r._note_fwd_wall(0.005)
+        r._routed = 100                  # hedge budget available
+        deadline_at = time.monotonic() + 30.0
+        header = {"v": protocol.VERSION, "op": "dispatch", "id": 1,
+                  "kernel": "scan", "request_id": "hx-1",
+                  "deadline_ms": 30_000.0, "budget_ms": 30_000.0}
+        t0 = time.perf_counter()
+        resp, _payloads, widx, hedged = r._forward_hedged(
+            0, [0, 1], header, (), deadline_at, "scan",
+            "scan|8192|-", 1, "hx-1", "-")
+        wall = time.perf_counter() - t0
+
+        assert hedged is True
+        assert widx == 1
+        assert resp["ok"] is True and resp["served_by"] == "fast"
+        assert wall < 3.0, "winner must not wait for the slow primary"
+
+        # the hedge leg: same request_id, replay-stamped, budget net
+        # of the time already burned waiting on the primary
+        with fast.lock:
+            assert len(fast.dispatches) == 1
+            hl = fast.dispatches[0]
+        assert hl["request_id"] == "hx-1"
+        assert hl["replay"] == 1
+        assert 0.0 < hl["budget_ms"] < 30_000.0
+        # exactly ONE non-replay dispatch ever left the router
+        with slow.lock:
+            primaries = list(slow.dispatches)
+        assert len(primaries) == 1
+        assert not primaries[0].get("replay")
+
+        # the loser got the best-effort cancel
+        end = time.monotonic() + 10.0
+        while time.monotonic() < end:
+            with slow.lock:
+                if slow.cancels:
+                    break
+            time.sleep(0.02)
+        with slow.lock:
+            assert slow.cancels == ["hx-1"]
+
+        events = _events(jpath)
+        hedges = [e for e in events if e["kind"] == "serve_hedged"]
+        assert len(hedges) == 1
+        assert hedges[0]["from_worker"] == 0
+        assert hedges[0]["to_worker"] == 1
+        assert hedges[0]["request_id"] == "hx-1"
+        assert hedges[0]["threshold_s"] > 0.0
+        cancels = [e for e in events
+                   if e["kind"] == "serve_cancelled"]
+        assert any(e["site"] == "router" and e["to_worker"] == 0
+                   for e in cancels)
+    finally:
+        slow.close()
+        fast.close()
+
+
+# ---------------------------------------------------------------- #
+# reqtrace assembly of the new evidence                            #
+# ---------------------------------------------------------------- #
+
+def test_reqtrace_assembles_hedge_cancel_and_expiry():
+    from tpukernels.obs import reqtrace
+
+    def ev(kind, rid, **kw):
+        e = {"kind": kind, "pid": 1, "t": 100.0, "request_id": rid}
+        e.update(kw)
+        return e
+
+    events = [
+        ev("serve_client_request", "h1", kernel="scan", wall_s=0.4,
+           ok=True),
+        ev("serve_hedged", "h1", kernel="scan", from_worker=0,
+           to_worker=1, threshold_s=0.05),
+        ev("serve_cancelled", "h1", site="router", to_worker=0,
+           kernel="scan"),
+        ev("serve_request", "h1", kernel="scan", bucket="scan|8192|-",
+           ok=True, wall_s=0.01, worker_id="1", replayed=1),
+        ev("serve_client_request", "x1", kernel="scan", wall_s=0.2,
+           ok=False, error="expired"),
+        ev("serve_request_expired", "x1", site="server",
+           where="worker", kernel="scan"),
+        ev("serve_client_request", "x2", kernel="scan", wall_s=0.01,
+           ok=False, error="deadline infeasible"),
+        ev("serve_deadline_infeasible", "x2", kernel="scan",
+           bucket="scan|8192|-"),
+    ]
+    tls = reqtrace.assemble(events)
+
+    h = tls["h1"]
+    assert h["hedged"] is True
+    assert h["hedges"][0]["to_worker"] == 1
+    assert h["cancels"] and h["cancels"][0]["site"] == "router"
+    kinds = {g["kind"] for g in h["gaps"]}
+    assert "hedged" in kinds and "cancelled" in kinds
+    # a hedged request is EXCLUDED from the clean-sum gate, exactly
+    # like a replayed one: two server segments are expected, not an
+    # inconsistency
+    assert h["clean"] is False
+
+    x1 = tls["x1"]
+    assert x1["expiries"] and x1["expiries"][0]["where"] == "worker"
+    assert any(g["kind"] == "deadline-expired" for g in x1["gaps"])
+    assert x1["clean"] is False
+    x2 = tls["x2"]
+    assert any(g["kind"] == "deadline-infeasible"
+               for g in x2["gaps"])
+
+
+# ---------------------------------------------------------------- #
+# e2e: dequeue-time expiry on a live daemon                        #
+# ---------------------------------------------------------------- #
+
+def test_daemon_expires_dead_budget_before_dispatch(tmp_path):
+    """A budget that dies in the queue is answered ``expired`` — the
+    pad/dispatch phases are skipped entirely (zero post-expiry
+    dispatch), the client raises ServeExpired, and the SAME
+    connection immediately serves a fresh-budget request."""
+    from tpukernels.serve import client as serve_client
+
+    with _daemon(tmp_path, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+    }) as (sock, journal, _proc):
+        x, want = _scan_case()
+        with serve_client.ServeClient(sock, timeout_s=60) as c:
+            c.next_request_id = "exp-1"
+            c.next_deadline_ms = 0.01    # dead on arrival
+            with pytest.raises(serve_client.ServeExpired):
+                c.dispatch("scan", x)
+            # deliberately NOT a ServeRejected: backpressure retries
+            # must not absorb a doomed budget
+            c.next_request_id = "ok-1"
+            c.next_deadline_ms = 60_000.0
+            out = c.dispatch("scan", x)
+            np.testing.assert_array_equal(out, want)
+
+    events = _events(journal)
+    exp = [e for e in events if e.get("kind") == "serve_request_expired"]
+    assert any(e["request_id"] == "exp-1" and e["site"] == "server"
+               for e in exp)
+    served = [e for e in events if e.get("kind") == "serve_request"]
+    # zero post-expiry dispatch: the expired id never reached a worker
+    assert not any(e.get("request_id") == "exp-1" for e in served)
+    assert any(e.get("request_id") == "ok-1" and e.get("ok")
+               for e in served)
+
+
+# ---------------------------------------------------------------- #
+# e2e headline: hedged tail tolerance on a live fleet              #
+# ---------------------------------------------------------------- #
+
+def test_fleet_hedge_rides_out_slow_worker_100pct_goodput(tmp_path):
+    """The ISSUE 19 acceptance headline: ``delay_response`` holds the
+    scan home worker's COMPLETED response for 5 s (dispatch done,
+    delivery late — the slow-but-alive tail), the router hedges to
+    the sibling at its own forward-wall percentile, first response
+    wins, and EVERY deadline-carrying request in the run meets its
+    budget — with zero duplicate (non-replay) dispatches and zero
+    post-expiry dispatches in the journal. Also the admission
+    triage: a budget that is already dust is refused at the front
+    door (``serve_deadline_infeasible``) without a WAL fsync or a
+    worker queue slot."""
+    from tpukernels.serve import client as serve_client
+    from tpukernels.serve import router as router_mod
+
+    primary, sibling = router_mod.ring_order("scan|8192|-", 2)[:2]
+    # every=2 + times=1: the direct compile-warming scan below is
+    # response call 1 (no fault) so the fault fires on exactly the
+    # headline dispatch — a WARM worker held 5 s at the response,
+    # not a cold compile the hedge would cancel mid-flight
+    plan = json.dumps({"delay_response": {
+        "kernel": "scan", "delay_s": 5.0, "every": 2, "times": 1,
+        "env": {"TPK_SERVE_WORKER_ID": str(primary)},
+    }})
+    goodput = []  # (deadline_ms, wall_ms) per deadline-carrying req
+    with _fleet(tmp_path, n=2, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_SHM": "0",
+        # roomy hedge budget: a stray hedge on a priming request
+        # (cold-compile wall vs a warm-walls percentile) must not
+        # starve the headline dispatch of its hedge
+        "TPK_ROUTE_HEDGE_MAX_FRAC": "0.5",
+        "TPK_FAULT_PLAN": plan,
+    }) as (front, journal, _env):
+        x, want = _scan_case()
+        va_x = np.arange(1024, dtype=np.float32)
+        va_y = np.ones(1024, dtype=np.float32)
+        # warm the scan compile on BOTH workers directly (the fleet
+        # spawner's per-worker sockets), so the headline measures the
+        # delayed RESPONSE of a healthy worker — the slow-but-alive
+        # tail — not compile latency
+        fleet_d = os.path.dirname(front)
+        for i in (0, 1):
+            wsock = os.path.join(fleet_d, f"worker{i}", "serve.sock")
+            with serve_client.ServeClient(wsock, timeout_s=120) as w:
+                # explicit ids: the default mint is pid-scoped with a
+                # per-CLIENT sequence, and these two clients share
+                # this test's pid
+                w.next_request_id = f"warm-{i}"
+                np.testing.assert_array_equal(
+                    w.dispatch("scan", x), want)
+        with serve_client.ServeClient(front, timeout_s=120) as c:
+            # admission triage: a dust budget is refused, not queued
+            c.next_request_id = "inf-1"
+            c.next_deadline_ms = 0.001
+            with pytest.raises(serve_client.ServeExpired):
+                c.dispatch("scan", x)
+
+            # prime the router's forward-wall histogram past
+            # HEDGE_MIN_SAMPLES with a kernel the fault ignores
+            for i in range(router_mod.HEDGE_MIN_SAMPLES + 5):
+                c.next_request_id = f"prime-{i}"
+                c.next_deadline_ms = 60_000.0
+                t0 = time.perf_counter()
+                out = c.dispatch("vector_add", np.float32(2.0),
+                                 va_x, va_y)
+                goodput.append(
+                    (60_000.0, (time.perf_counter() - t0) * 1e3))
+                np.testing.assert_allclose(out, 2.0 * va_x + va_y,
+                                           rtol=1e-6)
+
+            # the headline dispatch: the home worker's response is
+            # held 5 s; the hedge must land the answer well inside
+            # the 60 s budget (and well under the fault delay —
+            # first response wins, nobody waited for the loser)
+            c.next_request_id = "hedge-1"
+            c.next_deadline_ms = 60_000.0
+            t0 = time.perf_counter()
+            out = c.dispatch("scan", x)
+            wall = time.perf_counter() - t0
+            goodput.append((60_000.0, wall * 1e3))
+            np.testing.assert_array_equal(out, want)
+            assert wall < 5.0, (
+                "the hedge winner must not wait out the delayed "
+                f"primary (wall {wall:.2f}s)")
+
+    # 100% goodput: every completed deadline-carrying request met
+    # its budget
+    assert goodput and all(w <= dl for dl, w in goodput)
+
+    events = _events(journal)
+    hedges = [e for e in events if e.get("kind") == "serve_hedged"]
+    assert any(e["from_worker"] == primary
+               and e["to_worker"] == sibling
+               and e["request_id"] == "hedge-1" for e in hedges)
+    # the loser was cancelled best-effort (router side always
+    # journals; the worker side may also record its phase)
+    assert any(e.get("kind") == "serve_cancelled" for e in events)
+
+    served = [e for e in events if e.get("kind") == "serve_request"]
+    # zero duplicate side effects: at most one NON-replay dispatch
+    # per request_id, fleet-wide (the hedge leg rides the replay
+    # idempotency contract)
+    by_id: dict = {}
+    for e in served:
+        if e.get("request_id"):
+            by_id.setdefault(e["request_id"], []).append(e)
+    for rid, recs in by_id.items():
+        assert sum(1 for e in recs if not e.get("replayed")) <= 1, (
+            f"duplicate non-replay dispatch for {rid}")
+    # the hedged request has both legs: one primary, one replay
+    legs = by_id.get("hedge-1", [])
+    assert len(legs) == 2
+    assert sorted(bool(e.get("replayed")) for e in legs) == [
+        False, True]
+    # zero post-expiry dispatch: the refused id never reached a worker
+    assert "inf-1" not in by_id
+    assert any(e.get("kind") == "serve_deadline_infeasible"
+               and e.get("request_id") == "inf-1" for e in events)

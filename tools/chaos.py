@@ -14,6 +14,15 @@ invariants after every single event:
 - **no accepted-request drops** — every client dispatch either
   succeeds with a correct result or was honestly shed/throttled and
   retried to success; a hard failure fails the campaign.
+- **goodput** — every load request carries a generous deadline
+  (docs/SERVING.md §deadlines) and the campaign asserts 100% of
+  completed requests met it after every event: a fault may slow the
+  fleet, never silently starve a budget.
+- **no duplicate dispatch** — after every event, any request_id with
+  more than one ``serve_request`` record must carry the honest
+  ``replayed`` marker (a WAL replay or a hedge riding the replay
+  idempotency header); two unmarked records are a silent double
+  dispatch.
 - **convergence** — the fleet returns to all-members-live
   (``serve_ctl health``) within the recovery wait after each fault.
 - **journal evidence** — every fault leaves its expected kinds
@@ -35,7 +44,12 @@ place, byte-for-byte half a valid payload — the pre-atomic crash
 shape every reader must reject loudly and rebuild), and
 ``wedge_dispatch`` (armed at fleet start via ``TPK_FAULT_PLAN`` with
 a ``once_file``, worker 0 wedges one dispatch mid-campaign — the
-watchdog + requeue path; scheduled at most once per campaign).
+watchdog + requeue path; scheduled at most once per campaign), and
+``delay_response`` (armed the same way: worker 0 holds one completed
+scan response on the floor — the slow-but-alive worker the deadline/
+hedging layer exists for; the event observes the ``fault_injected``
+``site=response`` evidence and the goodput + duplicate-dispatch
+invariants hold through it; at most once per campaign).
 
 Same seed, same schedule, same request ids: a failing campaign
 replays exactly. Exit 0 = every invariant held after every event;
@@ -117,6 +131,11 @@ class _Load:
         self.seed = seed
         self.clients = clients
         self.ok = 0
+        # every request carries a deadline generous enough to ride
+        # out a router respawn (reconnect budget 60 s); met counts
+        # completions within it — the campaign's goodput invariant
+        self.deadline_ms = 90_000.0
+        self.met = 0
         self.failures: list = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -148,6 +167,8 @@ class _Load:
                 x = (np.arange(n) % 7).astype(np.int32)
                 want = np.cumsum(x, dtype=np.int64).astype(np.int32)
                 cli.next_request_id = f"chaos-{self.seed}-{tid}-{seq}"
+                cli.next_deadline_ms = self.deadline_ms
+                d0 = time.perf_counter()
                 try:
                     out = serve_client.dispatch_with_backpressure(
                         cli, "scan", (x,), {}, jitter=rng)
@@ -163,8 +184,11 @@ class _Load:
                         self.failures.append(
                             (cli.last_request_id, "WRONG RESULT"))
                     return
+                wall = time.perf_counter() - d0
                 with self._lock:
                     self.ok += 1
+                    if wall * 1000.0 <= self.deadline_ms:
+                        self.met += 1
                 time.sleep(0.05 + rng.random() * 0.1)
 
 
@@ -242,6 +266,20 @@ def _do_torn_write(rng, counts):
     return {"path": path}
 
 
+def _delay_response_armed(rng, counts):
+    """delay_response is armed via TPK_FAULT_PLAN at fleet start
+    (worker 0 holds one completed scan response on the floor); the
+    'event' observes that it FIRED — the per-event goodput and
+    duplicate-dispatch invariants then prove the fleet absorbed the
+    slow-but-alive worker honestly."""
+    _wait_for(
+        lambda: any(e.get("kind") == "fault_injected"
+                    and e.get("site") == "response"
+                    for e in _journal_events()),
+        RECOVER_WAIT_S, "armed delay_response to fire")
+    return {}
+
+
 def _wedge_armed(once_file: str):
     """wedge_dispatch is armed via TPK_FAULT_PLAN at fleet start; the
     'event' is simply observing that it FIRED (once_file exists) and
@@ -286,6 +324,35 @@ def _assert_artifacts_readable():
                     f"artifact {p} is torn after recovery: {e}")
 
 
+def _assert_no_duplicate_dispatch():
+    """At-most-once dispatch per request_id: a request_id may appear
+    on more than one serve_request record only via the honest replay
+    markers — a WAL replay or a hedge rides the replay idempotency
+    header and journals ``replayed=True``. Two UNMARKED records for
+    one id mean the same work ran twice silently."""
+    by_id: dict = {}
+    for e in _journal_events():
+        if e.get("kind") != "serve_request" \
+                or not e.get("request_id"):
+            continue
+        by_id.setdefault(e["request_id"], []).append(e)
+    for rid, evs in sorted(by_id.items()):
+        plain = [e for e in evs if not e.get("replayed")]
+        if len(plain) > 1:
+            raise CampaignFailure(
+                f"request {rid} dispatched {len(plain)} time(s) with "
+                "no replay/hedge marker (silent duplicate dispatch)")
+
+
+def _assert_goodput(load):
+    with load._lock:
+        ok, met = load.ok, load.met
+    if met < ok:
+        raise CampaignFailure(
+            f"goodput violated: only {met}/{ok} completed request(s) "
+            f"met the {load.deadline_ms:.0f}ms deadline")
+
+
 def _assert_no_leaks(n_workers: int):
     leaked = [f for f in os.listdir(serve_protocol.SHM_DIR)
               if serve_protocol._SHM_NAME_RE.match(f)]
@@ -311,23 +378,34 @@ def run_campaign(seed: int, n_events: int, n_workers: int) -> int:
     rng = random.Random(seed)
     schedule = [EVENTS[rng.randrange(len(EVENTS))]
                 for _ in range(n_events)]
-    # at most one armed wedge per campaign: splice it over a
-    # non-router slot when the seed allows (plans load at import, so
-    # it must be decided before the fleet starts)
-    wedge_slot = None
+    # at most one armed wedge and one armed delay_response per
+    # campaign: splice them over non-router slots when the seed
+    # allows (plans load at import, so both must be decided before
+    # the fleet starts)
+    wedge_slot = delay_slot = None
     for i, ev in enumerate(schedule):
-        if ev != "kill_router":
+        if ev == "kill_router":
+            continue
+        if wedge_slot is None:
             wedge_slot = i
+        elif delay_slot is None:
+            delay_slot = i
             break
     once_file = os.path.join(serve_fleet.fleet_dir(), "wedge.once")
+    plan: dict = {}
     if wedge_slot is not None:
         schedule[wedge_slot] = "wedge_dispatch"
+        plan["wedge_dispatch"] = {
+            "kernel": "scan", "times": 1, "once_file": once_file,
+            "env": {"TPK_SERVE_WORKER_ID": "0"}}
+    if delay_slot is not None:
+        schedule[delay_slot] = "delay_response"
+        plan["delay_response"] = {
+            "kernel": "scan", "delay_s": 2.0, "times": 1,
+            "env": {"TPK_SERVE_WORKER_ID": "0"}}
+    if plan:
         os.makedirs(serve_fleet.fleet_dir(), exist_ok=True)
-        os.environ["TPK_FAULT_PLAN"] = json.dumps({
-            "wedge_dispatch": {"kernel": "scan", "times": 1,
-                               "once_file": once_file,
-                               "env": {"TPK_SERVE_WORKER_ID": "0"}},
-        })
+        os.environ["TPK_FAULT_PLAN"] = json.dumps(plan)
     print(f"# chaos: seed {seed}, schedule: {', '.join(schedule)}",
           file=sys.stderr)
 
@@ -363,7 +441,8 @@ def run_campaign(seed: int, n_events: int, n_workers: int) -> int:
     handlers = {"kill_router": _do_kill_router,
                 "kill_worker": _do_kill_worker,
                 "torn_write": _do_torn_write,
-                "wedge_dispatch": _wedge_armed(once_file)}
+                "wedge_dispatch": _wedge_armed(once_file),
+                "delay_response": _delay_response_armed}
     rc = 0
     try:
         load.start()
@@ -375,6 +454,8 @@ def run_campaign(seed: int, n_events: int, n_workers: int) -> int:
             detail = handlers[ev](rng, counts)
             _assert_converged()
             _assert_artifacts_readable()
+            _assert_no_duplicate_dispatch()
+            _assert_goodput(load)
             if load.failures:
                 raise CampaignFailure(
                     f"client drops after {ev}: {load.failures}")
@@ -411,7 +492,8 @@ def run_campaign(seed: int, n_events: int, n_workers: int) -> int:
         rc = 1
     verdict = "SURVIVED" if rc == 0 else "FAILED"
     print(f"chaos: campaign {verdict} - seed {seed}, "
-          f"{len(schedule)} event(s), {load.ok} request(s) ok, "
+          f"{len(schedule)} event(s), {load.ok} request(s) ok "
+          f"({load.met} within deadline), "
           f"{len(load.failures)} dropped")
     return rc
 

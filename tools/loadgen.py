@@ -76,6 +76,18 @@ ride every request header for the fleet router's per-tenant
 admission point (docs/SERVING.md §fleet); a tenant run's series
 record as ``<kernel>@<tenant>`` so its p99 verdicts earn their own
 ``slo.json`` rows under the unchanged gating contract.
+``--deadline-ms DIST`` (serve-only; ``250`` fixed or ``200:400``
+seeded-uniform per request) stamps a deadline on every scheduled
+request (warms ride deadline-free — a cold compile is not a tail
+sample) and adds a **goodput** (deadline-met fraction) column beside
+the latency columns in the SLO summary: a request counts as met when
+it completed ok within its budget, measured from dispatch — the
+moment the client stamped the budget (docs/SERVING.md §deadlines).
+Expired requests (the daemon's honest ``expired`` /
+``deadline_infeasible`` replies) are dropped loudly under their own
+``slo.expired.<kernel>`` counter, and low goodput downgrades an
+``ok`` verdict to the NON-gating ``goodput_low``
+(``tpukernels/obs/slo.py``, the below_roofline pattern).
 
 ``--serve`` runs are request-TRACED (docs/OBSERVABILITY.md §request
 tracing): every request carries a seeded-deterministic
@@ -366,7 +378,8 @@ def _load_replay(path):
 
 
 def run_serve(schedule, shape_class: str, socket_path: str, echo,
-              seed: int = 0, tenant=None, priority=None, replay=None):
+              seed: int = 0, tenant=None, priority=None, replay=None,
+              deadline=None):
     """Drive the serving daemon through the schedule, open-loop — the
     ``run_real`` arithmetic with the daemon in place of
     ``registry.dispatch``. Latency stays completion minus SCHEDULED
@@ -385,14 +398,21 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
     observed shapes while dispatch and metrics use its real kernel
     name, so two entries of one kernel merge into one latency
     histogram — the canary compares POPULATIONS, not entries.
-    Returns the daemon's ping stats (device_kind, jax version) for
-    the verdict record."""
+    ``deadline`` is a ``(lo_ms, hi_ms)`` range: each scheduled
+    request samples a per-request deadline from its own seeded stream
+    and the dispatch header carries it end to end (docs/SERVING.md
+    §deadlines); warms stay deadline-free. Returns ``(stats,
+    goodput)`` — the daemon's ping stats (device_kind, jax version)
+    for the verdict record, plus ``{series: [met, total]}``
+    deadline-met counts (empty without ``deadline``)."""
     import random as random_mod
 
     from tpukernels.serve import client as serve_client
     from tpukernels.serve import protocol as serve_protocol
 
     jitter = random_mod.Random(seed ^ 0x7E57ED)
+    dl_rng = random_mod.Random(seed ^ 0xDEAD11)
+    goodput: dict = {}
 
     def _mk(kernel):
         return f"{kernel}@{tenant}" if tenant else kernel
@@ -412,7 +432,7 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
         return rid
 
     def dispatch_patiently(cli, kernel, args, statics, rid,
-                           warm=False) -> bool:
+                           warm=False, deadline_ms=None) -> bool:
         """One request, honoring backpressure (the shared
         ``dispatch_with_backpressure`` policy; the retry waits count
         in the caller's latency clock): ten rejections, a
@@ -424,12 +444,21 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
         the client-observed wall the timeline assembler anchors
         phase coverage against."""
         cli.next_request_id = rid
+        cli.next_deadline_ms = deadline_ms
         c0 = time.perf_counter()
         ok, err = True, None
         try:
             serve_client.dispatch_with_backpressure(
                 cli, kernel, args, statics, jitter=jitter
             )
+        except serve_client.ServeExpired as e:
+            # the daemon's honest expiry/infeasibility reply: the
+            # request missed its deadline — its own counter, NOT a
+            # generic drop (goodput accounting below reads it)
+            ok, err = False, "expired"
+            obs_metrics.inc(f"slo.expired.{_mk(kernel)}")
+            print(f"# {kernel} request missed its deadline: {e}",
+                  file=sys.stderr)
         except serve_client.ServeRejected:
             ok, err = False, "rejected"
             obs_metrics.inc(f"slo.dropped.{_mk(kernel)}")
@@ -449,7 +478,7 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
             "serve_client_request", request_id=rid, kernel=kernel,
             tenant=tenant, warm=warm,
             wall_s=round(time.perf_counter() - c0, 6),
-            ok=ok, error=err,
+            ok=ok, error=err, deadline_ms=deadline_ms,
         )
         return ok
 
@@ -487,15 +516,26 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
         if t > now:
             time.sleep(t - now)
         kname, args, statics = prepared[key]
+        dl = None
+        if deadline is not None:
+            # per-request deadline off its own seeded stream; met =
+            # completed ok within budget, measured from DISPATCH (the
+            # moment the client stamps the budget), not the scheduled
+            # arrival — open-loop schedule lag is the generator's
+            # debt, not the service's
+            dl = dl_rng.uniform(deadline[0], deadline[1])
+            goodput.setdefault(_mk(kname), [0, 0])[1] += 1
         s0 = time.perf_counter()
         if dispatch_patiently(cli, kname, args, statics,
-                              _rid(f"{i:05d}")):
+                              _rid(f"{i:05d}"), deadline_ms=dl):
             s1 = time.perf_counter()
             obs_metrics.inc(f"slo.requests.{_mk(kname)}")
             obs_metrics.observe(f"slo.latency_s.{_mk(kname)}",
                                 (s1 - t0) - t)
             obs_metrics.observe(f"slo.service_s.{_mk(kname)}",
                                 s1 - s0)
+            if dl is not None and (s1 - s0) * 1000.0 <= dl:
+                goodput[_mk(kname)][0] += 1
     # re-ping AFTER the dispatches: the daemon resolves device_kind /
     # jax lazily on its first dispatch, and the verdict record should
     # carry them when available — but a daemon that died at the very
@@ -572,7 +612,23 @@ def run_serve(schedule, shape_class: str, socket_path: str, echo,
                 **budget,
             )
     cli.close()
-    return stats
+    return stats, goodput
+
+
+def _parse_deadline_ms(spec: str) -> tuple:
+    """``--deadline-ms`` value -> a ``(lo_ms, hi_ms)`` range:
+    ``250`` fixed, ``200:400`` uniform-in-range (sampled per request
+    from a stream seeded off the run seed)."""
+    if ":" in spec:
+        lo_raw, hi_raw = spec.split(":", 1)
+        lo, hi = float(lo_raw), float(hi_raw)
+    else:
+        lo = hi = float(spec)
+    if lo <= 0 or hi < lo:
+        raise ValueError(
+            f"{spec!r}: want MS > 0 or LO:HI with 0 < LO <= HI"
+        )
+    return lo, hi
 
 
 def _parse_mix(raw: str | None, kernel: str | None) -> dict:
@@ -604,7 +660,7 @@ def main(argv=None):
     kernel = mix_raw = None
     arrivals, rate, requests = "poisson", DEFAULT_RATE, 200
     duration = simulate_ms = serve_sock = None
-    tenant = priority = None
+    tenant = priority = deadline = None
     seed = None
     shape_class, period = "probe", 60.0
     print_schedule = check = False
@@ -619,6 +675,8 @@ def main(argv=None):
                 tenant = next(it)
             elif a == "--priority":
                 priority = next(it)
+            elif a == "--deadline-ms":
+                deadline = _parse_deadline_ms(next(it))
             elif a == "--mix":
                 mix_raw = next(it)
             elif a == "--arrivals":
@@ -688,6 +746,11 @@ def main(argv=None):
               "runs (the router's admission point reads them)",
               file=sys.stderr)
         return 2
+    if deadline is not None and serve_sock is None:
+        print("loadgen: --deadline-ms only applies to --serve runs "
+              "(the dispatch header carries the budget)",
+              file=sys.stderr)
+        return 2
     if tenant is not None and ("@" in tenant or "|" in tenant
                                or not tenant):
         print(f"loadgen: bad --tenant {tenant!r} (non-empty, no '@' "
@@ -737,6 +800,7 @@ def main(argv=None):
 
     echo = lambda line: print(line)  # noqa: E731
     serve_stats = None
+    goodput: dict = {}
     t_run0 = time.perf_counter()
     with trace.span("loadgen/run", arrivals=arrivals, seed=seed):
         if simulate_ms is not None:
@@ -746,11 +810,11 @@ def main(argv=None):
             from tpukernels.serve import protocol as serve_protocol
 
             try:
-                serve_stats = run_serve(schedule, shape_class,
-                                        serve_sock, echo, seed=seed,
-                                        tenant=tenant,
-                                        priority=priority,
-                                        replay=replay)
+                serve_stats, goodput = run_serve(
+                    schedule, shape_class, serve_sock, echo,
+                    seed=seed, tenant=tenant, priority=priority,
+                    replay=replay, deadline=deadline,
+                )
             except (OSError, serve_protocol.ProtocolError) as e:
                 print(f"loadgen: serve daemon at {serve_sock} "
                       f"unreachable: {e}", file=sys.stderr)
@@ -771,6 +835,7 @@ def main(argv=None):
     verdicts = slo.judge(
         per_kernel, kind, shape_class,
         simulated=simulate_ms is not None,
+        goodput=goodput or None,
     )
     jax_version = None
     if serve_stats is not None:
@@ -788,6 +853,9 @@ def main(argv=None):
     if tenant:
         run_info["tenant"] = tenant
         run_info["priority"] = priority or "interactive"
+    if deadline is not None:
+        run_info["deadline_ms"] = list(deadline)
+        run_info["goodput"] = {k: list(v) for k, v in goodput.items()}
     artifact = slo.record(verdicts, run_info, jax_version=jax_version)
     journal.emit(
         "slo_probe", **run_info, device_kind=kind,
@@ -801,27 +869,46 @@ def main(argv=None):
         },
     )
 
+    # the goodput column exists only on deadline-carrying runs: with
+    # --deadline-ms unset the table (and every other stdout byte) is
+    # identical to a pre-deadline run
+    gp_col = deadline is not None
     hdr = (f"{'kernel':<16} {'n':>5} {'p50_ms':>9} {'p95_ms':>9} "
-           f"{'p99_ms':>9} {'max_ms':>9} {'target':>9}  verdict")
+           f"{'p99_ms':>9} {'max_ms':>9} {'target':>9} "
+           + (f"{'goodput':>8} " if gp_col else "")
+           + " verdict")
     print(hdr)
     print("-" * len(hdr))
 
     def _ms(v):
         return slo.fmt_ms(v, 9)
 
+    def _gp(v):
+        frac = v.get("goodput_frac")
+        if frac is None:
+            return f"{'-':>8} "
+        return f"{frac:>8.1%} "
+
     breached = []
     for k, v in verdicts.items():
         print(f"{k:<16} {v['count']:>5} {_ms(v['p50_s'])} "
               f"{_ms(v['p95_s'])} {_ms(v['p99_s'])} {_ms(v['max_s'])} "
-              f"{_ms(v['target_p99_s'])}  {v['verdict']}"
+              f"{_ms(v['target_p99_s'])} "
+              + (_gp(v) if gp_col else "")
+              + f" {v['verdict']}"
               + (f" ({v['why']})" if v.get("why") else ""))
         if v["verdict"] == "slo_breach" and not v["simulated"]:
             breached.append(k)
+    met = sum(v[0] for v in goodput.values())
+    total = sum(v[1] for v in goodput.values())
     print(
         f"loadgen: {len(schedule)} request(s), {arrivals} arrivals, "
         f"seed {seed}, {shape_class} shapes on {kind}"
         + (" (SIMULATED)" if simulate_ms is not None else "")
         + (" (SERVED)" if serve_sock is not None else "")
+        + (f", goodput {met}/{total}"
+           + (f" ({met / total:.1%})" if total else "")
+           if gp_col else "")
         + f", wall {wall:.1f}s -> {os.path.relpath(artifact)}"
         + (f"; BREACH: {','.join(breached)}" if breached else "")
     )

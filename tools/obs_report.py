@@ -52,7 +52,14 @@ Sections (docs/OBSERVABILITY.md):
 11. **Shapes seen** — requested (pre-pad) shape mix per (kernel,
     bucket) with pad waste, from the per-request shape-mix records
     on ``serve_request`` — ROADMAP item 5's optimizer input.
-12. **Metric snapshots** — per-process metric state reconstructed by
+12. **Deadlines** — expiry / infeasibility / hedge / cancel traffic
+    from the journal (``serve_request_expired`` /
+    ``serve_deadline_infeasible`` / ``serve_hedged`` /
+    ``serve_cancelled``; docs/SERVING.md §deadlines) plus the goodput
+    counts deadline-carrying ``loadgen --deadline-ms`` runs stamp on
+    their ``slo_probe`` events. Absent any deadline evidence the
+    section does not render.
+13. **Metric snapshots** — per-process metric state reconstructed by
     the one shared ``metrics.merge_journal_metrics`` fold
     (docs/OBSERVABILITY.md §live telemetry): a process's final
     ``metrics`` event is authoritative; a process that died without
@@ -61,7 +68,7 @@ Sections (docs/OBSERVABILITY.md):
     kills, tuning-cache traffic), gauges, latency histograms
     (count-weighted p50/p95/p99 + exact max). The two encodings are
     never summed.
-13. **Daily rollups** — the long-horizon series
+14. **Daily rollups** — the long-horizon series
     (``tpukernels/obs/rollup.py``): validated ``rollup_<date>.json``
     artifacts with per-kernel request counts and daily p99s, judged
     by the NON-GATING ``p99_creep`` trend verdict (latest day's p99
@@ -584,6 +591,73 @@ def shapes_section(events, out):
         )
 
 
+def deadlines_section(events, out):
+    """Deadline evidence (docs/SERVING.md §deadlines): where budgets
+    died (expiry site/where counts), admission refusals, hedge pairs
+    and cancel phases from the journal, plus the goodput counts
+    deadline-carrying loadgen runs stamp on ``slo_probe``. Renders
+    only when a run carried deadlines — without them the report stays
+    byte-identical to a pre-deadline one."""
+    kinds: dict = {"serve_request_expired": [],
+                   "serve_deadline_infeasible": [],
+                   "serve_hedged": [], "serve_cancelled": []}
+    for e in events:
+        k = e.get("kind")
+        if k in kinds:
+            kinds[k].append(e)
+    probes = [e for e in events
+              if e.get("kind") == "slo_probe" and e.get("goodput")]
+    if not any(kinds.values()) and not probes:
+        return
+    out.append("")
+    out.append("== deadlines (docs/SERVING.md §deadlines) ==")
+    exp = kinds["serve_request_expired"]
+    if exp:
+        where: dict = {}
+        for e in exp:
+            key = f"{e.get('site')}/{e.get('where')}"
+            where[key] = where.get(key, 0) + 1
+        out.append(
+            f"  {len(exp)} request(s) expired before dispatch: "
+            + ", ".join(f"{k}={n}" for k, n in sorted(where.items()))
+        )
+    inf = kinds["serve_deadline_infeasible"]
+    if inf:
+        out.append(f"  {len(inf)} refused at admission (budget "
+                   "already infeasible on arrival)")
+    hed = kinds["serve_hedged"]
+    if hed:
+        pairs: dict = {}
+        for e in hed:
+            key = f"{e.get('from_worker')}->{e.get('to_worker')}"
+            pairs[key] = pairs.get(key, 0) + 1
+        out.append(
+            f"  {len(hed)} hedged dispatch(es), first-response-wins: "
+            + ", ".join(f"worker {k} x{n}"
+                        for k, n in sorted(pairs.items()))
+        )
+    can = kinds["serve_cancelled"]
+    if can:
+        sites: dict = {}
+        for e in can:
+            key = str(e.get("site"))
+            sites[key] = sites.get(key, 0) + 1
+        out.append(
+            f"  {len(can)} cancel(s): "
+            + ", ".join(f"{k}={n}" for k, n in sorted(sites.items()))
+        )
+    for e in probes:
+        gp = e.get("goodput") or {}
+        met = sum(int(v[0]) for v in gp.values())
+        total = sum(int(v[1]) for v in gp.values())
+        frac = f" ({met / total:.1%})" if total else ""
+        out.append(
+            f"  goodput {met}/{total}{frac} deadline(s) met "
+            f"(seed {e.get('seed')}, "
+            f"deadline_ms {e.get('deadline_ms')})"
+        )
+
+
 def metrics_section(events, out):
     # the one shared reconstruction (docs/OBSERVABILITY.md §live
     # telemetry): a pid's atexit `metrics` event is authoritative; a
@@ -891,6 +965,7 @@ def main(argv=None):
     adapt_section(events, out)
     reqtrace_section(events, out)
     shapes_section(events, out)
+    deadlines_section(events, out)
     metrics_section(events, out)
     rollup_section(out)
     out.append("")

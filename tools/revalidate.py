@@ -123,7 +123,12 @@ fi
     #      seed) so every healthy window also banks real request
     #      timelines for trace_report/obs_report (docs/OBSERVABILITY
     #      .md §request tracing) at no extra chip cost; the second
-    #      half keeps an untraced tail sample. Non-gating (obs_check
+    #      half keeps an untraced tail sample. The traced half also
+    #      CARRIES DEADLINES (--deadline-ms, generous enough that a
+    #      clean run meets 100% — docs/SERVING.md §deadlines), so
+    #      every window banks goodput evidence and exercises the
+    #      budget propagation end to end, again at no extra chip
+    #      cost. Non-gating (obs_check
     #      picks a confirmed breach OR trace_inconsistent up as rc 1
     #      WARN), never stamped, after prewarm_all so the daemon
     #      opens onto a warm manifest; the stop runs whatever the
@@ -137,7 +142,8 @@ serve_probe_body() {
       || return $?
   timeout -k 10 70 env TPK_TRACE=1 python tools/loadgen.py \\
       --serve default --mix all --arrivals poisson --duration 30 \\
-      --rate 8 --requests 0 --shapes record --seed 5
+      --rate 8 --requests 0 --shapes record --seed 5 \\
+      --deadline-ms 30000
   rc_traced=$?
   timeout -k 10 70 python tools/loadgen.py --serve default \\
       --mix all --arrivals poisson --duration 30 --rate 8 \\

@@ -20,8 +20,11 @@ request's time go" across the whole fleet:
   integrity, unaccounted.
 - **waterfalls** — per-request lanes (client / router / worker pids)
   with per-process offsets anchored to each process's own
-  ``serve_start`` (clock-skew rule), spill hops, explicit GAP lines
-  for abandoned workers, and the request's critical path.
+  ``serve_start`` (clock-skew rule), spill hops, hedge hops (home and
+  sibling attempts joined on the shared request_id, the loser's
+  cancel as an explicit line), deadline expiries rendered as GAP
+  lines that say where the budget went, explicit GAP lines for
+  abandoned workers, and the request's critical path.
 
 Degrades loudly: ``serve_request`` events without a request_id (an
 old server, tracing off) are counted and announced, never silently
@@ -100,6 +103,13 @@ def waterfall(t: dict) -> list:
             f"  [router pid {ev.get('pid')}] SPILL worker "
             f"{ev.get('from_worker')} -> {ev.get('to_worker')} "
             f"({ev.get('reason')})"
+        )
+    for ev in t["hedges"]:
+        out.append(
+            f"  [router pid {ev.get('pid')}] HEDGE worker "
+            f"{ev.get('from_worker')} -> {ev.get('to_worker')} "
+            f"(elapsed > {ev.get('threshold_s')}s, "
+            "first response wins)"
         )
     # one lane per process, offsets anchored to that process's own
     # serve_start; scale = the widest lane so bars stay comparable
@@ -191,9 +201,14 @@ def main(argv=None):
           + ", ".join(os.path.relpath(p) for p in paths))
     traced = [t for t in tls.values() if t["segments"]]
     gaps = sum(len(t["gaps"]) for t in tls.values())
+    hedged = sum(1 for t in tls.values() if t["hedged"])
+    expired = sum(1 for t in tls.values() if t["expiries"])
     print(
         f"{len(tls)} request timeline(s) assembled, {len(traced)} "
         f"with span evidence, {gaps} gap(s)"
+        + (f", {hedged} hedged" if hedged else "")
+        + (f", {expired} expired/refused on deadline"
+           if expired else "")
         + (f", {bad} unparseable line(s)" if bad else "")
     )
     if untraced:

@@ -34,7 +34,16 @@ Assembly rules:
   process, so the sibling's timeline is the whole surviving story
   and says so. A client-confirmed request with no ``serve_request``
   record at all gets a ``missing-server-record`` gap (the worker
-  died between dispatch and journal).
+  died between dispatch and journal). A ``serve_hedged`` marker joins
+  the home and sibling attempts of one hedged dispatch the way spills
+  join (first-response-wins — docs/SERVING.md §hedged dispatch), and
+  ``serve_cancelled`` / ``serve_request_expired`` /
+  ``serve_deadline_infeasible`` land as explicit ``cancelled`` /
+  ``deadline-expired`` / ``deadline-infeasible`` entries, so an
+  expired request's timeline says where its budget went. Hedged
+  timelines are exempt from the clean/``trace_inconsistent`` gate
+  like replays: two server records is the DESIGNED shape of a hedge,
+  not an inconsistency.
 - **Degrade loudly, never crash** — a pre-request_id journal (old
   server, tracing off) assembles to zero timelines;
   :func:`untraced_serve_requests` counts what could not be joined so
@@ -138,7 +147,8 @@ def _new_timeline(rid) -> dict:
         "tenant": None, "worker_id": None,
         "client": None, "server": [], "route": [], "spills": [],
         "rejections": 0, "throttles": 0, "requeued": False,
-        "replayed": False,
+        "replayed": False, "hedged": False,
+        "hedges": [], "cancels": [], "expiries": [],
         "segments": [], "gaps": [],
     }
 
@@ -185,6 +195,53 @@ def assemble(events) -> dict:
             t["bucket"] = t["bucket"] or ev.get("bucket")
         elif kind == "serve_spill":
             tl(rid)["spills"].append(ev)
+        elif kind == "serve_hedged":
+            t = tl(rid)
+            t["hedged"] = True
+            t["hedges"].append(ev)
+            t["gaps"].append({
+                "kind": "hedged", "pid": ev.get("pid"),
+                "t": ev.get("t"),
+                "detail": (f"worker {ev.get('from_worker')} outlived "
+                           "the hedge threshold "
+                           f"({ev.get('threshold_s')}s); same "
+                           "request_id re-issued to sibling "
+                           f"{ev.get('to_worker')} — first response "
+                           "wins, loser cancelled"),
+            })
+        elif kind == "serve_cancelled":
+            t = tl(rid)
+            t["cancels"].append(ev)
+            where = (f"worker {ev.get('to_worker')}"
+                     if ev.get("to_worker") is not None
+                     else f"phase {ev.get('phase')}")
+            t["gaps"].append({
+                "kind": "cancelled", "pid": ev.get("pid"),
+                "t": ev.get("t"),
+                "detail": (f"hedge loser cancelled at "
+                           f"{ev.get('site')} ({where}) — its work "
+                           "was dropped or its reply suppressed"),
+            })
+        elif kind == "serve_request_expired":
+            t = tl(rid)
+            t["expiries"].append(ev)
+            t["gaps"].append({
+                "kind": "deadline-expired", "pid": ev.get("pid"),
+                "t": ev.get("t"),
+                "detail": (f"budget ran out at {ev.get('site')}"
+                           f"/{ev.get('where')} before dispatch — "
+                           "the wait phases above are where the "
+                           "budget went"),
+            })
+        elif kind == "serve_deadline_infeasible":
+            t = tl(rid)
+            t["expiries"].append(ev)
+            t["gaps"].append({
+                "kind": "deadline-infeasible", "pid": ev.get("pid"),
+                "t": ev.get("t"),
+                "detail": ("refused at router admission: the budget "
+                           "was already spent before arrival"),
+            })
         elif kind == "serve_rejected":
             tl(rid)["rejections"] += 1
         elif kind == "serve_tenant_throttled":
@@ -330,7 +387,8 @@ def _finalize(t: dict, anchors: dict):
     t["clean"] = bool(
         final is not None and final.get("ok")
         and not t["requeued"] and not t["spills"]
-        and not t["replayed"]
+        and not t["replayed"] and not t["hedged"]
+        and not t["expiries"]
         and t["rejections"] == 0 and t["throttles"] == 0
         and len(t["server"]) == 1
     )
@@ -409,6 +467,8 @@ def run_budget(events, request_ids=None) -> dict | None:
         "traced": len(traced),
         "clean": len(clean),
         "gaps": sum(len(t["gaps"]) for t in tls.values()),
+        "hedged": sum(1 for t in tls.values() if t["hedged"]),
+        "expired": sum(1 for t in tls.values() if t["expiries"]),
         "untraced_serve_requests": untraced_serve_requests(events),
         "coverage_floor": coverage_min(),
         "sum_tol": SUM_TOL,

@@ -24,7 +24,14 @@ p99. This module is the judging half of the latency-SLO layer
   into per-kernel verdicts: ``ok`` / ``slo_breach`` (count-weighted
   p99 over target) / ``no_data`` (fewer than
   ``TPK_SLO_MIN_REQUESTS`` samples — a thin tail is no tail). A
-  confirmed breach emits an ``slo_breach`` journal event.
+  confirmed breach emits an ``slo_breach`` journal event. When the
+  caller supplies per-kernel deadline-met counts (loadgen
+  ``--deadline-ms`` runs — docs/SERVING.md §deadlines), an ``ok``
+  verdict whose goodput fraction sits under
+  :data:`DEFAULT_GOODPUT_MIN_FRAC` becomes ``goodput_low`` — NON-
+  gating, the ``below_roofline`` pattern: it only ever replaces an
+  ``ok``, never masks ``no_data``, never outranks a breach, and
+  :func:`breaches` never selects it.
 - :func:`record` / :func:`load_entries` — the persisted ``slo.json``
   verdict artifact (path via ``TPK_SLO_DIR``, beside tuning.json/
   aot.json/integrity.json), entries keyed
@@ -56,6 +63,10 @@ from tpukernels.obs import metrics as obs_metrics
 from tpukernels.resilience import journal
 
 DEFAULT_MIN_REQUESTS = 20
+
+# Deadline-met fraction below which an ok verdict downgrades to the
+# non-gating goodput_low (judge(goodput=...) callers only).
+DEFAULT_GOODPUT_MIN_FRAC = 0.95
 
 # The device rows every kernel must state (contract-lint floor):
 # the chip evidence row and the any-host CPU proof row.
@@ -199,7 +210,7 @@ def histograms_by_kernel(hists: dict) -> dict:
 
 
 def judge(per_kernel: dict, kind: str, shape_class: str,
-          simulated: bool = False) -> dict:
+          simulated: bool = False, goodput: dict | None = None) -> dict:
     """Per-kernel verdict rows over captured latency histograms.
 
     ``per_kernel`` is :func:`histograms_by_kernel` output. Each row
@@ -207,7 +218,13 @@ def judge(per_kernel: dict, kind: str, shape_class: str,
     resolved target and one of the three verdicts. A confirmed breach
     (enough samples, p99 over target) emits an ``slo_breach`` journal
     event and bumps ``slo.breaches`` — the journal twin of the
-    persisted artifact row."""
+    persisted artifact row.
+
+    ``goodput`` maps kernel -> ``(deadline_met, deadline_total)``
+    from a deadline-carrying loadgen run; an ``ok`` row with enough
+    deadline samples and a met fraction under
+    :data:`DEFAULT_GOODPUT_MIN_FRAC` downgrades to the non-gating
+    ``goodput_low``."""
     floor = min_requests()
     out = {}
     for kernel in sorted(per_kernel):
@@ -247,6 +264,21 @@ def judge(per_kernel: dict, kind: str, shape_class: str,
             )
         else:
             row["verdict"] = "ok"
+        gp = (goodput or {}).get(kernel)
+        if gp:
+            met, total = int(gp[0]), int(gp[1])
+            row["goodput_met"] = met
+            row["goodput_total"] = total
+            row["goodput_frac"] = (met / total) if total else None
+            if (row["verdict"] == "ok" and total >= floor
+                    and row["goodput_frac"] is not None
+                    and row["goodput_frac"]
+                    < DEFAULT_GOODPUT_MIN_FRAC):
+                # the below_roofline rule: only ever REPLACES an ok —
+                # never masks no_data, never outranks a breach, and
+                # breaches() (verdict == "slo_breach") never gates on
+                # it.
+                row["verdict"] = "goodput_low"
         out[kernel] = row
     return out
 

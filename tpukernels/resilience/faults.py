@@ -47,6 +47,18 @@ contract and examples):
   ``tests/test_slo.py``. ``kernel`` omitted matches any; ``every``
   defaults to 1; a bare string is ``{"kernel": ...}`` sugar; the
   same ``"env"`` clause as wedge_metric narrows the match.
+- ``"delay_response": {"kernel": "scan", "delay_s": 0.6, "every": 1,
+  "times": 0}`` — a matching serve WORKER holds its COMPLETED
+  response on the floor for ``delay_s`` before sending: the
+  slow-but-alive tail worker (dispatch done, delivery late) that the
+  router's hedged dispatch exists to tolerate — the deterministic
+  hedging chaos proof (docs/SERVING.md §hedged dispatch). Unlike
+  ``slow_dispatch`` this fires AFTER the kernel ran, so a hedge that
+  wins against it proves first-response-wins without duplicate side
+  effects. ``kernel`` omitted matches any; ``every`` defaults to 1;
+  ``times`` caps total firings (0 = unlimited, the default); a bare
+  string is ``{"kernel": ...}`` sugar; the same ``"env"`` clause
+  narrows to ONE fleet worker via its ``TPK_SERVE_WORKER_ID``.
 - ``"wedge_dispatch": {"kernel": "scan", "times": 1}`` — the first
   ``times`` matching ``registry.dispatch`` calls WEDGE (the same
   SIGALRM-immune hang as ``wedge_metric``, but at the serving
@@ -150,6 +162,7 @@ _PLAN = _load_plan()
 _PROBE_IDX = 0       # probe attempts consumed (per process)
 _CURRENT_METRIC = None  # set by bench's --one/--prewarm child entry
 _DISPATCH_CALLS: dict = {}  # kernel -> dispatches seen (slow_dispatch)
+_RESPONSE_CALLS: dict = {}  # kernel -> responses seen (delay_response)
 _WEDGE_CALLS: dict = {}     # kernel -> dispatches seen (wedge_dispatch)
 _KILL_CALLS: dict = {}      # kernel -> dispatches seen (kill_worker)
 _ROUTE_CALLS = 0            # router admissions seen (kill_router)
@@ -168,6 +181,7 @@ def reload_plan():
     _PROBE_IDX = 0
     _CURRENT_METRIC = None
     _DISPATCH_CALLS.clear()
+    _RESPONSE_CALLS.clear()
     _WEDGE_CALLS.clear()
     _KILL_CALLS.clear()
     _ROUTE_CALLS = 0
@@ -380,6 +394,44 @@ def dispatch_fault(kernel: str):
         "fault_injected", site="dispatch", kernel=kernel,
         fault="slow_dispatch", delay_s=delay, call=n,
     )
+    time.sleep(delay)
+
+
+def response_fault(kernel: str):
+    """Injection point for the serve daemon's response path
+    (``server._finish``, AFTER the dispatch completed, BEFORE the
+    send): a ``delay_response`` plan key holds a matching worker's
+    finished response for ``delay_s`` — the slow-but-alive tail
+    worker the hedged-dispatch chaos proof pins (the kernel already
+    ran, so a winning hedge proves first-response-wins with zero
+    duplicate side effects). Counting is per (process, kernel);
+    ``times`` caps total firings (0 = unlimited)."""
+    if _PLAN is None:
+        return
+    spec = _PLAN.get("delay_response")
+    if not spec:
+        return
+    if isinstance(spec, str):
+        spec = {"kernel": spec}
+    want = spec.get("kernel")
+    if want is not None and want != kernel:
+        return
+    if not _env_match(spec):
+        return
+    n = _RESPONSE_CALLS[kernel] = _RESPONSE_CALLS.get(kernel, 0) + 1
+    every = int(spec.get("every", 1))
+    if every > 1 and n % every:
+        return
+    times = int(spec.get("times", 0))
+    if times > 0 and n > times * every:
+        return
+    delay = float(spec.get("delay_s", 0.1))
+    journal.emit(
+        "fault_injected", site="response", kernel=kernel,
+        fault="delay_response", delay_s=delay, call=n,
+    )
+    print(f"# fault: delaying {kernel} response {delay}s (call {n})",
+          file=sys.stderr, flush=True)
     time.sleep(delay)
 
 

@@ -89,10 +89,44 @@ class ServeRejected(ServeError):
         self.retry_after_s = retry_after_s
 
 
+class ServeExpired(ServeError):
+    """The request's deadline ran out before the fleet could (or
+    would) serve it — the router refused an infeasible budget
+    (``serve_deadline_infeasible``) or a layer expired it in flight
+    (``serve_request_expired``). Deliberately NOT a
+    :class:`ServeRejected`: retrying the same ever-shrinking budget
+    is doomed, so backpressure retries must not absorb it —
+    ``retry_after_s`` is the honest hint for a FRESH deadline."""
+
+    def __init__(self, msg, retry_after_s=0.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 def default_socket_path() -> str:
     """``TPK_SERVE_SOCKET`` when set (also the capi routing switch),
     else the serve dir's ``serve.sock`` (``tpukernels/_cachedir.py``)."""
     return _cachedir.serve_socket_path()
+
+
+def default_deadline_ms():
+    """``TPK_DEADLINE_DEFAULT_MS`` (docs/SERVING.md §deadlines): the
+    deadline stamped on every dispatch when neither the client nor
+    the caller set one. Unset/0 = off — requests carry no deadline
+    and every layer's expiry check stays a single ``is None`` test,
+    the fault-plan hot-path discipline. Fail-loud parse, >= 0."""
+    raw = os.environ.get("TPK_DEADLINE_DEFAULT_MS")
+    if raw is None or not raw.strip():
+        return None
+    try:
+        val = float(raw)
+    except ValueError:
+        val = -1.0
+    if val < 0.0:
+        raise ValueError(
+            f"TPK_DEADLINE_DEFAULT_MS={raw!r}: expected a number >= 0"
+        )
+    return val or None
 
 
 def dispatch_with_backpressure(cli, kernel, args, statics,
@@ -145,6 +179,19 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
     tries = 0
     reconnected = False
     deadline = None  # first transport failure starts the budget clock
+
+    def _re_arm():
+        # one logical request, one id AND one deadline: a retry must
+        # not restart the budget clock any more than it may mint a
+        # fresh request_id — the remaining budget keeps shrinking
+        # across admission/reconnect retries (docs/SERVING.md
+        # §deadlines)
+        if rid is not None:
+            cli.next_request_id = rid
+        dl_at = getattr(cli, "last_deadline_at", None)
+        if dl_at is not None:
+            cli.next_deadline_at = dl_at
+
     while True:
         try:
             return cli.dispatch(kernel, *args, **statics)
@@ -156,8 +203,7 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
             if jitter is not None:
                 wait *= 0.5 + jitter.random()
             time.sleep(wait)
-            if rid is not None:
-                cli.next_request_id = rid
+            _re_arm()
         except _ABSORBABLE as e:
             # dispatch() already closed the poisoned socket; the next
             # call reconnects on the same path
@@ -177,8 +223,7 @@ def dispatch_with_backpressure(cli, kernel, args, statics,
                 if jitter is not None:
                     step *= 0.5 + jitter.random()
                 time.sleep(min(step, max(0.0, deadline - now)))
-            if rid is not None:
-                cli.next_request_id = rid
+            _re_arm()
 
 
 class ServeClient:
@@ -201,7 +246,7 @@ class ServeClient:
     copy-budget evidence."""
 
     def __init__(self, socket_path=None, timeout_s=None,
-                 tenant=None, priority=None):
+                 tenant=None, priority=None, deadline_ms=None):
         # tenant/priority ride every dispatch header: the fleet
         # router's admission point (per-tenant token buckets,
         # priority classes — docs/SERVING.md §fleet) reads them; the
@@ -227,6 +272,17 @@ class ServeClient:
         self.last_request_id = None
         self.request_trace = None   # from the pong; None = unknown
         self._trace_seq = 0
+        # deadlines (docs/SERVING.md §deadlines): per-client default
+        # total budget in ms (falls back to TPK_DEADLINE_DEFAULT_MS;
+        # None/0 = no deadline). next_deadline_ms overrides ONE
+        # dispatch; next_deadline_at (a local monotonic absolute)
+        # CONTINUES an in-flight logical request's budget across
+        # retries instead of restarting it — last_deadline_at is what
+        # dispatch_with_backpressure restores from.
+        self.deadline_ms = deadline_ms
+        self.next_deadline_ms = None
+        self.next_deadline_at = None
+        self.last_deadline_at = None
 
     # ---------------------------------------------------------- #
     # transport                                                  #
@@ -344,6 +400,27 @@ class ServeClient:
             req["tenant"] = self.tenant
         if self.priority is not None:
             req["priority"] = self.priority
+        # deadline stamping: the total budget (deadline_ms) plus the
+        # monotonic-safe remaining budget at THIS send (budget_ms,
+        # recomputed per hop — docs/SERVING.md §deadlines). A retry of
+        # the same logical request arrives with next_deadline_at set
+        # and keeps the original clock.
+        dl_ms = self.next_deadline_ms
+        self.next_deadline_ms = None
+        if dl_ms is None:
+            dl_ms = self.deadline_ms
+            if dl_ms is None:
+                dl_ms = default_deadline_ms()
+        dl_at = self.next_deadline_at
+        self.next_deadline_at = None
+        if dl_ms:
+            if dl_at is None:
+                dl_at = time.monotonic() + dl_ms / 1000.0
+            req["deadline_ms"] = dl_ms
+            req = protocol.stamp_budget(req, dl_at)
+        else:
+            dl_at = None
+        self.last_deadline_at = dl_at
         segs: list = []
         if use_shm:
             req["shm_ok"] = True  # the server may answer via shm too
@@ -373,6 +450,10 @@ class ServeClient:
             if header.get("kind") == "overloaded":
                 raise ServeRejected(
                     msg, float(header.get("retry_after_s") or 0.1)
+                )
+            if header.get("kind") in ("expired", "deadline_infeasible"):
+                raise ServeExpired(
+                    msg, float(header.get("retry_after_s") or 0.0)
                 )
             raise ServeError(msg)
         resp_descs = [d for d in (header.get("_shm") or ()) if d]

@@ -17,6 +17,19 @@ The same framing serves both directions. Requests:
 
     {"v": 1, "op": "ping"}        # liveness / stats, no payload
 
+A dispatch header may also carry a client-set DEADLINE
+(docs/SERVING.md §deadlines): ``deadline_ms`` is the request's total
+budget (informational — it never crosses a clock boundary), and
+``budget_ms`` is the REMAINING budget at the moment the frame was
+sent, recomputed at every hop (client send, router forward). Absolute
+wall-clock deadlines are meaningless across skewed processes, so no
+absolute time ever rides the wire: each receiver converts the budget
+into its OWN local monotonic deadline at receive time
+(:func:`deadline_from_header`) and each forwarder re-stamps the
+remainder (:func:`stamp_budget`) — the reqtrace skew rule (durations
+only, never cross-pid clock comparison) applied to admission. Old
+servers ignore both fields, like any other unknown header field.
+
 A dispatch header may also carry ``replay`` (int, set by the fleet
 router, never by clients): the count of prior delivery attempts this
 request already survived — the router re-forwards an accepted request
@@ -86,6 +99,7 @@ import mmap
 import os
 import re
 import struct
+import time
 
 import numpy as np
 
@@ -108,6 +122,53 @@ DTYPES = {
     "float32": np.float32,
     "int32": np.int32,
 }
+
+# ------------------------------------------------------------------ #
+# request deadlines (docs/SERVING.md §deadlines)                     #
+# ------------------------------------------------------------------ #
+
+def deadline_from_header(header, now=None):
+    """The frame's remaining budget converted into THIS process's own
+    local monotonic deadline, or ``None`` when the request carries no
+    deadline. ``budget_ms`` (the per-hop remainder) wins; a header
+    with only ``deadline_ms`` (a minimal client that never recomputes)
+    falls back to it. Malformed values read as no-deadline — a wire
+    field from an arbitrary client is tolerated like any unknown
+    field, never a crash surface."""
+    raw = header.get("budget_ms")
+    if raw is None:
+        raw = header.get("deadline_ms")
+    if (not isinstance(raw, (int, float)) or isinstance(raw, bool)
+            or raw < 0):
+        return None
+    if now is None:
+        now = time.monotonic()
+    return now + raw / 1000.0
+
+
+def budget_ms_remaining(deadline_at, now=None) -> float:
+    """Milliseconds left until a local monotonic deadline, clamped at
+    0 — the one subtraction every layer's expiry check shares. Only
+    ever called with a deadline THIS process derived from a received
+    budget, so no cross-process clock comparison can occur."""
+    if now is None:
+        now = time.monotonic()
+    return max(0.0, (deadline_at - now) * 1000.0)
+
+
+def stamp_budget(header, deadline_at, now=None) -> dict:
+    """A copy of ``header`` with ``budget_ms`` recomputed from a local
+    monotonic deadline — the per-hop re-stamp a forwarder (client
+    retry, router forward/hedge) applies so the downstream process
+    sees the budget net of time already spent here. ``deadline_at``
+    None returns the header unchanged (no deadline, nothing to
+    stamp)."""
+    if deadline_at is None:
+        return header
+    out = dict(header)
+    out["budget_ms"] = round(budget_ms_remaining(deadline_at, now), 3)
+    return out
+
 
 # ------------------------------------------------------------------ #
 # shm lane plumbing                                                  #

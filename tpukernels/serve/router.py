@@ -71,6 +71,18 @@ The routing disciplines, each CPU-chaos-proven (tests/test_fleet.py):
   retains a reserve (1 + burst/2 tokens) kept for ``"interactive"``
   traffic, so background load yields first.
 
+- **Deadlines + hedged dispatch** (docs/SERVING.md §deadlines,
+  §hedged dispatch) — a request whose wire ``budget_ms`` is already
+  gone is refused at the front door (``serve_deadline_infeasible``)
+  and a WAL entry whose budget died across a crash is expired at
+  dequeue time (``serve_request_expired``) instead of dispatched as
+  doomed work; a forward that outlives the fleet's own forward-wall
+  percentile (``TPK_ROUTE_HEDGE_PCTL``, default p95, 0 = off)
+  re-issues the SAME request_id to the ring sibling as an idempotent
+  replay — first response wins, the loser is cancelled best-effort
+  (``serve_hedged`` / ``serve_cancelled``), at most one hedge per
+  request, hedged fraction capped by ``TPK_ROUTE_HEDGE_MAX_FRAC``.
+
 The router is deliberately **jax-free** (stdlib + numpy + the
 bucket table): it computes bucket keys from the request header's arg
 SPECS alone (``bucketing.spec_stubs`` — it never reads a payload
@@ -112,6 +124,14 @@ from tpukernels.serve.server import (  # the daemon's shared fail-loud
 DEFAULT_TENANT_RATE = 0.0     # tokens/s; 0 = per-tenant quotas OFF
 DEFAULT_TENANT_BURST = 8.0    # token-bucket capacity per tenant
 DEFAULT_COOLDOWN_S = 30.0     # wedged-worker routing cooldown
+
+# hedged dispatch (docs/SERVING.md §hedged dispatch): a request whose
+# forward outlives the fleet's own p-th forward-wall percentile is
+# re-issued to its ring sibling as an idempotent replay — the
+# tail-at-scale tolerance move. 0 disables hedging entirely.
+DEFAULT_HEDGE_PCTL = 95.0     # TPK_ROUTE_HEDGE_PCTL
+DEFAULT_HEDGE_MAX_FRAC = 0.1  # TPK_ROUTE_HEDGE_MAX_FRAC: hedges/routed
+HEDGE_MIN_SAMPLES = 20        # forward walls before the pctl is trusted
 
 PRIORITIES = ("interactive", "batch")
 
@@ -200,6 +220,41 @@ class _Conn:
             return protocol.send_frame(self.sock, header, payloads)
 
 
+class _Attempt:
+    """One racing upstream forward of a hedged dispatch. ``done`` is
+    guarded by the shared race condition variable; ``alock`` guards
+    the socket handoff so ``abort`` (the loser's fast exit) can never
+    close a socket the pool already owns again."""
+
+    __slots__ = ("idx", "resp", "payloads", "exc", "done", "cond",
+                 "sock", "alock", "aborted")
+
+    def __init__(self, idx: int, cond):
+        self.idx = idx
+        self.resp = None
+        self.payloads = ()
+        self.exc = None
+        self.done = False
+        self.cond = cond
+        self.sock = None
+        self.alock = threading.Lock()
+        self.aborted = False
+
+    def abort(self):
+        """Close the attempt's live socket from outside: a loser whose
+        reply the worker suppressed (in-flight cancel) would otherwise
+        sit in recv until the pool timeout — the close errors it out
+        NOW, and the release path poisons the connection."""
+        with self.alock:
+            self.aborted = True
+            s = self.sock
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
 class Router:
     def __init__(self, socket_path: str, workers,
                  tenant_rate=None, tenant_burst=None, cooldown_s=None):
@@ -217,6 +272,13 @@ class Router:
         self.cooldown_s = (cooldown_s if cooldown_s is not None
                            else _float_knob("TPK_ROUTE_COOLDOWN_S",
                                             DEFAULT_COOLDOWN_S))
+        # hedged dispatch knobs (fail-loud, the _float_knob contract):
+        # pctl 0 = off; max_frac caps the hedged fraction of routed
+        # traffic so a fleet-wide slowdown cannot double its own load
+        self.hedge_pctl = _float_knob("TPK_ROUTE_HEDGE_PCTL",
+                                      DEFAULT_HEDGE_PCTL)
+        self.hedge_max_frac = _float_knob("TPK_ROUTE_HEDGE_MAX_FRAC",
+                                          DEFAULT_HEDGE_MAX_FRAC)
         # upstream patience: the worker's own watchdog bounds a
         # request (slow-grace + requeue-once + wedged-twice), so the
         # router waits comfortably past that before calling transport
@@ -243,6 +305,13 @@ class Router:
         self._spilled = 0
         self._throttled = 0
         self._rejected = 0
+        self._hedged = 0
+        self._expired = 0      # deadline died at router/WAL dequeue
+        self._infeasible = 0   # refused at admission: budget already 0
+        # forward-wall log-bucket histogram (obs/metrics.py buckets —
+        # the hedge threshold is its p-th percentile): [count, max,
+        # {bucket_index: n}], guarded by self._lock
+        self._fwd_walls = [0, 0.0, {}]
         self._tenants: dict = {}             # tenant -> [tokens, last]
         self._meta = {"device_kind": None, "jax": None}
         self._meta_next_try = 0.0            # unresolved-meta rate limit
@@ -334,7 +403,8 @@ class Router:
             journal.emit(
                 "serve_stop", role="router", routed=self._routed,
                 spilled=self._spilled, throttled=self._throttled,
-                rejected=self._rejected,
+                rejected=self._rejected, hedged=self._hedged,
+                expired=self._expired, infeasible=self._infeasible,
                 uptime_s=round(time.time() - self._t0, 3),
             )
 
@@ -364,8 +434,12 @@ class Router:
             self._wal_seq += 1
             seq = self._wal_seq
         key = f"{os.getpid()}-{seq}"
+        # epoch wall time, not monotonic: replay happens in a FRESH
+        # process after a crash, and epoch time is the only clock
+        # that bridges incarnations on one host — it turns the
+        # entry's budget_ms into a remaining budget at dequeue time
         entry = {"header": dict(header), "kernel": kernel,
-                 "bucket": bucket}
+                 "bucket": bucket, "t_wal": round(time.time(), 6)}
         total = sum(len(p) for p in payloads)
         if total <= WAL_MAX_PAYLOAD_B:
             entry["p64"] = [base64.b64encode(bytes(p)).decode("ascii")
@@ -428,6 +502,28 @@ class Router:
                         os.path.join(protocol.SHM_DIR, name)):
                     return skip("shm-gone")
         payloads = [base64.b64decode(s) for s in p64]
+        # dequeue-time expiry (docs/SERVING.md §deadlines): the budget
+        # kept draining while this entry sat in the WAL across the
+        # crash — a dead budget is skipped as doomed work, a live one
+        # is re-stamped with what actually remains for the forward hop
+        budget = header.get("budget_ms")
+        t_wal = entry.get("t_wal")
+        if (isinstance(budget, (int, float))
+                and not isinstance(budget, bool)
+                and isinstance(t_wal, (int, float))):
+            rem_ms = float(budget) - (time.time() - float(t_wal)) * 1e3
+            if rem_ms <= 0.0:
+                with self._lock:
+                    self._expired += 1
+                obs_metrics.inc("serve.expired")
+                journal.emit(
+                    "serve_request_expired", site="router",
+                    where="wal_replay", kernel=kernel, bucket=bucket,
+                    request=rid, request_id=req_id, tenant=tenant,
+                )
+                return skip("expired")
+            header = protocol.stamp_budget(
+                header, time.monotonic() + rem_ms / 1000.0)
         order = self._order(bucket)
         if not order:
             return skip("no-live-worker")
@@ -583,6 +679,50 @@ class Router:
                       f"{retry}s"),
         })
 
+    def _refuse_infeasible(self, conn_reply, rid, req_id, kernel,
+                           bucket, tenant, priority):
+        """Admission-time deadline triage (docs/SERVING.md
+        §deadlines): a request whose remaining budget is already zero
+        cannot possibly make it — refuse it at the front door instead
+        of spending a WAL fsync and a worker queue slot on doomed
+        work. The hint is honest: 0.0, because a retry is welcome
+        immediately — but only with a FRESH budget (the client maps
+        this to ``ServeExpired``, which deliberately does not
+        auto-retry the same shrinking one)."""
+        with self._lock:
+            self._infeasible += 1
+        obs_metrics.inc("serve.deadline_infeasible")
+        journal.emit(
+            "serve_deadline_infeasible", kernel=kernel, bucket=bucket,
+            request=rid, request_id=req_id, tenant=tenant,
+            priority=priority, retry_after_s=0.0,
+        )
+        conn_reply({
+            "v": protocol.VERSION, "id": rid, "ok": False,
+            "kind": "deadline_infeasible", "retry_after_s": 0.0,
+            "error": ("deadline infeasible: request budget already "
+                      "spent before admission"),
+        })
+
+    def _expire_route(self, conn_reply, rid, req_id, kernel, bucket,
+                      tenant, where):
+        """Dequeue-time expiry (docs/SERVING.md §deadlines): the
+        budget died while the request waited inside the router —
+        answer ``expired`` instead of dispatching doomed work."""
+        with self._lock:
+            self._expired += 1
+        obs_metrics.inc("serve.expired")
+        journal.emit(
+            "serve_request_expired", site="router", where=where,
+            kernel=kernel, bucket=bucket, request=rid,
+            request_id=req_id, tenant=tenant,
+        )
+        conn_reply({
+            "v": protocol.VERSION, "id": rid, "ok": False,
+            "kind": "expired",
+            "error": f"deadline expired before forward ({where})",
+        })
+
     # -------------------------------------------------------------- #
     # front side                                                     #
     # -------------------------------------------------------------- #
@@ -650,6 +790,8 @@ class Router:
                 "routed": self._routed, "spilled": self._spilled,
                 "throttled": self._throttled,
                 "rejected": self._rejected,
+                "hedged": self._hedged, "expired": self._expired,
+                "infeasible": self._infeasible,
                 # lane negotiation happens against the FRONT socket:
                 # relay what the workers advertised (None until one
                 # answered = clients stay inline, the safe default)
@@ -909,6 +1051,7 @@ class Router:
         pool = self._pools[idx]
         sock = None
         ok = False
+        t0 = time.perf_counter()
         try:
             sock = pool.acquire()
             protocol.send_frame(sock, header, payloads)
@@ -918,12 +1061,222 @@ class Router:
                     "worker hung up mid-request"
                 )
             ok = True
+            self._note_fwd_wall(time.perf_counter() - t0)
             return frame
         finally:
             if sock is not None:
                 pool.release(sock, poisoned=not ok)
             with self._lock:
                 self._inflight[idx] -= 1
+
+    # -------------------------------------------------------------- #
+    # hedged dispatch (docs/SERVING.md §hedged dispatch)             #
+    # -------------------------------------------------------------- #
+
+    def _note_fwd_wall(self, wall: float):
+        """One completed forward's wall into the hedge histogram (and
+        the metrics snapshot, where operators read the same tail)."""
+        obs_metrics.observe("serve.forward_wall_s", wall)
+        b = obs_metrics.bucket_index(wall)
+        with self._lock:
+            h = self._fwd_walls
+            h[0] += 1
+            if wall > h[1]:
+                h[1] = wall
+            h[2][b] = h[2].get(b, 0) + 1
+
+    def _hedge_threshold_s(self):
+        """The elapsed time past which a forward is hedged: the
+        ``TPK_ROUTE_HEDGE_PCTL``-th percentile of this router's OWN
+        completed forward walls (count-weighted over the shared
+        log buckets). None = hedging off, a one-worker fleet (no
+        sibling to hedge to), or not enough samples to trust a tail
+        estimate yet."""
+        if self.hedge_pctl <= 0 or len(self.workers) < 2:
+            return None
+        with self._lock:
+            count, mx, buckets = self._fwd_walls
+            if count < HEDGE_MIN_SAMPLES:
+                return None
+            buckets = dict(buckets)
+        return obs_metrics.percentiles(
+            count, mx, buckets,
+            qs=(min(self.hedge_pctl, 100.0) / 100.0,),
+        )[0]
+
+    def _hedge_frac_ok(self) -> bool:
+        """The hedge-budget cap: hedging past
+        ``TPK_ROUTE_HEDGE_MAX_FRAC`` of routed traffic would let a
+        fleet-wide slowdown double its own load — exactly when extra
+        load hurts most."""
+        with self._lock:
+            return (self._hedged + 1
+                    <= self.hedge_max_frac * max(1, self._routed))
+
+    def _start_attempt(self, idx: int, header, payloads, cond):
+        att = _Attempt(idx, cond)
+
+        def run():
+            with self._lock:
+                self._inflight[idx] += 1
+            pool = self._pools[idx]
+            sock = None
+            ok = False
+            t0 = time.perf_counter()
+            try:
+                with att.alock:
+                    if att.aborted:
+                        raise OSError("attempt aborted before start")
+                    sock = pool.acquire()
+                    att.sock = sock
+                protocol.send_frame(sock, header, payloads)
+                frame = protocol.recv_frame(sock)
+                if frame is None:
+                    raise protocol.ProtocolError(
+                        "worker hung up mid-request"
+                    )
+                att.resp, att.payloads = frame
+                ok = True
+                self._note_fwd_wall(time.perf_counter() - t0)
+            except (OSError, protocol.ProtocolError) as e:
+                att.exc = e
+            finally:
+                with att.alock:
+                    att.sock = None
+                    if sock is not None:
+                        pool.release(sock,
+                                     poisoned=not ok or att.aborted)
+                with self._lock:
+                    self._inflight[idx] -= 1
+                with cond:
+                    att.done = True
+                    cond.notify_all()
+
+        threading.Thread(target=run, daemon=True,
+                         name="route-attempt").start()
+        return att
+
+    def _cancel_upstream(self, idx: int, req_id, kernel=None):
+        """Issue the best-effort ``cancel`` op for the hedge loser
+        (docs/SERVING.md §hedged dispatch): a queued loser is dropped
+        before it wastes a dispatch, an in-flight one has its send
+        suppressed. Failure is fine — cancel is advisory, the replay
+        idempotency contract already makes the duplicate safe."""
+        if req_id is None:
+            return
+        obs_metrics.inc("serve.cancels_sent")
+        journal.emit(
+            "serve_cancelled", site="router", to_worker=idx,
+            kernel=kernel, request_id=req_id,
+        )
+        pool = self._pools[idx]
+        sock = None
+        ok = False
+        try:
+            sock = pool.acquire()
+            protocol.send_frame(sock, {
+                "v": protocol.VERSION, "op": "cancel",
+                "request_id": req_id,
+            })
+            ok = protocol.recv_frame(sock) is not None
+        except (OSError, protocol.ProtocolError):
+            pass
+        finally:
+            if sock is not None:
+                pool.release(sock, poisoned=not ok)
+
+    def _forward_hedged(self, idx: int, order, header, payloads,
+                        deadline_at, kernel, bucket, rid, req_id,
+                        tenant):
+        """The primary forward with tail-tolerant hedging: if the
+        primary outlives the fleet's own forward-wall percentile
+        (``_hedge_threshold_s``) and budget remains, the SAME
+        request_id is re-issued to the ring sibling stamped as a
+        replay (the PR-14 idempotency contract — kernels are pure),
+        first response wins, the loser is cancelled best-effort.
+        Returns ``(resp, payloads, winner_idx, hedged)``; raises like
+        ``_forward`` only when no hedge was launched."""
+        hdr = protocol.stamp_budget(header, deadline_at)
+        threshold = self._hedge_threshold_s()
+        sibling = next((j for j in order if j != idx), None)
+        if (threshold is None or sibling is None or req_id is None
+                or not self._hedge_frac_ok()):
+            resp, out_payloads = self._forward(idx, hdr, payloads)
+            return resp, out_payloads, idx, False
+        cond = threading.Condition()
+        primary = self._start_attempt(idx, hdr, payloads, cond)
+        with cond:
+            end = time.perf_counter() + threshold
+            while not primary.done:
+                rem = end - time.perf_counter()
+                if rem <= 0:
+                    break
+                cond.wait(rem)
+        hedge = None
+        if not primary.done and (
+                deadline_at is None
+                or protocol.budget_ms_remaining(deadline_at) > 0.0):
+            h_hdr = dict(header)
+            try:
+                prior = int(h_hdr.get("replay") or 0)
+            except (TypeError, ValueError):
+                prior = 0
+            h_hdr["replay"] = prior + 1
+            h_hdr = protocol.stamp_budget(h_hdr, deadline_at)
+            with self._lock:
+                self._hedged += 1
+            obs_metrics.inc("serve.hedges")
+            journal.emit(
+                "serve_hedged", kernel=kernel, bucket=bucket,
+                request=rid, request_id=req_id, from_worker=idx,
+                to_worker=sibling, tenant=tenant,
+                threshold_s=round(threshold, 6),
+            )
+            hedge = self._start_attempt(sibling, h_hdr, payloads, cond)
+        attempts = [primary] + ([hedge] if hedge is not None else [])
+
+        def _settled():
+            done = [a for a in attempts if a.done]
+            if any(a.exc is None and (a.resp or {}).get("ok")
+                   for a in done):
+                # first OK response wins outright; an early honest
+                # error waits for the race mate — it might still win
+                return True
+            return len(done) == len(attempts)
+
+        with cond:
+            while not _settled():
+                cond.wait(1.0)
+            done = [a for a in attempts if a.done]
+        winner = next((a for a in done
+                       if a.exc is None and (a.resp or {}).get("ok")),
+                      None)
+        if winner is None:
+            winner = next((a for a in done if a.exc is None), done[0])
+        for a in attempts:
+            if a is winner:
+                continue
+            if not a.done:
+                # cancel FIRST (a queued loser is dropped before it
+                # wastes a dispatch), then abort the blocked recv so
+                # its suppressed reply cannot hold the thread until
+                # the pool timeout
+                self._cancel_upstream(a.idx, req_id, kernel=kernel)
+                a.abort()
+            elif a.exc is None:
+                # the loser finished anyway: its response segments
+                # will never be mapped by anyone — free them now
+                self._drop_stashed({"resp": a.resp})
+        if winner.exc is not None:
+            if hedge is None:
+                raise winner.exc
+            resp = {"v": protocol.VERSION, "id": rid, "ok": False,
+                    "kind": "error",
+                    "error": (f"workers {idx},{sibling} unreachable "
+                              f"after hedge: {winner.exc!r}")}
+            return resp, (), winner.idx, True
+        return winner.resp, winner.payloads, winner.idx, \
+            hedge is not None
 
     def _count_copied(self, kernel: str, nbytes: int):
         """Relayed inline payload bytes — the router's share of the
@@ -983,6 +1336,16 @@ class Router:
             # worker never sees a request the router could not hash
             reply({"v": protocol.VERSION, "id": rid, "ok": False,
                    "kind": "error", "error": f"bad request: {e}"})
+            return
+        # deadline triage at admission (docs/SERVING.md §deadlines):
+        # the wire budget becomes a router-local monotonic instant; a
+        # request that already cannot make it is refused NOW — before
+        # it burns tenant tokens, a WAL fsync, or a worker queue slot
+        deadline_at = protocol.deadline_from_header(header)
+        if (deadline_at is not None
+                and protocol.budget_ms_remaining(deadline_at) <= 0.0):
+            self._refuse_infeasible(reply, rid, req_id, kernel,
+                                    bucket, tenant, priority)
             return
         if req_id is not None and self._stash:
             # a reconnecting client retrying a request the WAL replay
@@ -1052,11 +1415,32 @@ class Router:
             spilled_from = None
             reason = None
             dead = False
+            hedged = False
             for hop in range(2):
                 dead = False
+                if (deadline_at is not None
+                        and protocol.budget_ms_remaining(
+                            deadline_at) <= 0.0):
+                    # dequeue-time expiry: the budget died while this
+                    # request waited (spill pacing, a slow first hop)
+                    # — expire it instead of dispatching doomed work
+                    self._expire_route(reply, rid, req_id, kernel,
+                                       bucket, tenant, where="route")
+                    return
                 try:
-                    resp, out_payloads = self._forward(idx, header,
-                                                       payloads)
+                    if hop == 0:
+                        resp, out_payloads, idx, hedged = \
+                            self._forward_hedged(
+                                idx, order, header, payloads,
+                                deadline_at, kernel=kernel,
+                                bucket=bucket, rid=rid,
+                                req_id=req_id, tenant=tenant)
+                    else:
+                        resp, out_payloads = self._forward(
+                            idx,
+                            protocol.stamp_budget(header,
+                                                  deadline_at),
+                            payloads)
                 except (OSError, protocol.ProtocolError) as e:
                     resp, out_payloads = None, ()
                     reason = "transport"
@@ -1083,6 +1467,11 @@ class Router:
                               f"{self.cooldown_s:.0f}s", file=sys.stderr)
                     else:
                         reason = None  # an honest dispatch error: relay it
+                    if hedged:
+                        # the hedge already delivered this request_id
+                        # to the ring sibling — first-response-wins IS
+                        # the failover; never dispatch a third copy
+                        reason = None
                 if reason is None:
                     break
                 sibling = next((j for j in order if j != idx), None)

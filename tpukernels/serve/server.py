@@ -42,6 +42,15 @@ The service disciplines, each CPU-chaos-proven (tests/test_serve.py):
   immediately with a ``retry_after_s`` hint (``serve_rejected``)
   instead of queueing into unbounded latency — the client sees the
   overload, the p99 of admitted requests stays honest.
+- **Request deadlines** (docs/SERVING.md §deadlines) — a client-set
+  budget rides every hop (``budget_ms``, recomputed per hop so no
+  absolute clock crosses processes); doomed work is EXPIRED at the
+  worker instead of dispatched (``serve_request_expired``), the
+  coalescing window never widens past half the tightest remaining
+  budget in the batch, and a best-effort ``cancel`` op lets the
+  router's hedged dispatch drop the losing attempt
+  (``serve_cancelled``) — pre-dispatch cancel removes the queue
+  entry, in-flight cancel just suppresses the send.
 - **Worker watchdog** — an in-flight request stuck past
   ``TPK_SERVE_REQUEST_TIMEOUT_S`` gets the bench treatment: its
   worker thread is abandoned (a wedged PJRT call cannot be cancelled
@@ -83,7 +92,7 @@ import time
 from tpukernels import _cachedir
 from tpukernels.obs import metrics as obs_metrics
 from tpukernels.obs import trace
-from tpukernels.resilience import journal, watchdog
+from tpukernels.resilience import faults, journal, watchdog
 from tpukernels.serve import bucketing, protocol
 
 DEFAULT_QUEUE_MAX = 64
@@ -159,11 +168,11 @@ class _Request:
                  "spec", "pad_frac", "bucket", "conn", "t_enq",
                  "t_start", "requeues", "patience", "done", "lock",
                  "worker_ident", "tenant", "shm_ok", "request_id",
-                 "shapes", "dtypes", "replayed")
+                 "shapes", "dtypes", "replayed", "deadline_at")
 
     def __init__(self, serial, rid, kernel, statics, arrays, spec,
                  pad_frac, bucket, conn, tenant=None, shm_ok=False,
-                 request_id=None, replayed=None):
+                 request_id=None, replayed=None, deadline_at=None):
         self.serial = serial  # server-side key: client ids can collide
         self.rid = rid
         # the client-minted causal id (docs/OBSERVABILITY.md §request
@@ -186,6 +195,11 @@ class _Request:
         # — safe (kernels are pure), recorded on the serve_request
         # evidence so postmortems see the delivery history
         self.replayed = replayed
+        # this process's monotonic instant the client's budget runs
+        # out (protocol.deadline_from_header) — no absolute client
+        # time ever crosses the wire, so clock skew cannot expire (or
+        # resurrect) a request; None means no deadline
+        self.deadline_at = deadline_at
         self.shm_ok = shm_ok       # client negotiated the shm lane
         self.t_enq = time.perf_counter()
         self.t_start = None
@@ -262,6 +276,17 @@ class _BoundedQueue:
             self._d = keep
             return taken
 
+    def remove_request(self, request_id: str):
+        """Pull ONE queued entry by its client-minted request_id — the
+        pre-dispatch half of the best-effort ``cancel`` op. Returns
+        the removed request or None (already dispatched / unknown)."""
+        with self._cv:
+            for item in self._d:
+                if item.request_id == request_id:
+                    self._d.remove(item)
+                    return item
+            return None
+
     def depth(self) -> int:
         with self._cv:
             return len(self._d)
@@ -299,6 +324,8 @@ class Server:
         self._served = 0
         self._rejected = 0
         self._requeued = 0
+        self._expired = 0
+        self._cancelled = 0
         self._t0 = time.time()
         self._service_ewma = 0.05           # retry-after hint basis
         # continuous batching: the admission path tracks an
@@ -432,6 +459,8 @@ class Server:
                                 inline_bytes=inline_bytes)
                 elif op == "stats":
                     conn.send(self._stats_full())
+                elif op == "cancel":
+                    conn.send(self._cancel(header))
                 elif op == "undrain":
                     conn.send(self._undrain())
                 else:
@@ -482,7 +511,8 @@ class Server:
         return {
             "op": "pong", "pid": os.getpid(),
             "served": self._served, "rejected": self._rejected,
-            "requeued": self._requeued, "depth": self._q.depth(),
+            "requeued": self._requeued, "expired": self._expired,
+            "cancelled": self._cancelled, "depth": self._q.depth(),
             "inflight": inflight, "buckets": buckets,
             "worker_id": os.environ.get("TPK_SERVE_WORKER_ID"),
             "queue_max": self.queue_max, "workers": self.workers,
@@ -612,7 +642,9 @@ class Server:
                        replayed=(int(replay)
                                  if isinstance(replay, int)
                                  and not isinstance(replay, bool)
-                                 and replay > 0 else None))
+                                 and replay > 0 else None),
+                       deadline_at=protocol.deadline_from_header(
+                           header))
         try:
             self._q.put_nowait(req)
         except _queue_mod.Full:
@@ -639,6 +671,75 @@ class Server:
         except OSError:
             pass
 
+    def _expire(self, req: _Request, where: str, queue_wait=None):
+        """Answer a request whose budget died before dispatch — the
+        doomed-work refusal (docs/SERVING.md §deadlines): the pad and
+        dispatch phases are skipped entirely, the expiry is journaled
+        where the budget went, and the client sees ``expired`` (NOT
+        ``overloaded`` — retrying the same shrinking budget is
+        doomed, so no retry_after_s choreography)."""
+        if not req.claim_done():
+            return
+        with self._lock:
+            self._expired += 1
+        obs_metrics.inc("serve.expired")
+        journal.emit(
+            "serve_request_expired", site="server", where=where,
+            kernel=req.kernel, request=req.rid,
+            request_id=req.request_id, bucket=req.bucket,
+            worker_id=os.environ.get("TPK_SERVE_WORKER_ID"),
+            queue_wait_s=(round(queue_wait, 6)
+                          if queue_wait is not None else None),
+        )
+        try:
+            req.conn.send({
+                "v": protocol.VERSION, "id": req.rid, "ok": False,
+                "kind": "expired",
+                "error": (f"deadline expired before dispatch "
+                          f"({where})"),
+            })
+        except (OSError, protocol.ProtocolError):
+            pass
+
+    def _cancel(self, header: dict) -> dict:
+        """The best-effort ``cancel`` op (docs/SERVING.md §deadlines):
+        a pre-dispatch cancel drops the queued entry outright; an
+        in-flight (or batch-pending) cancel just claims the request's
+        done flag so its eventual result is discarded instead of sent
+        — a running PJRT dispatch cannot be interrupted, only its
+        answer suppressed. A miss (already answered, unknown id) is
+        success too: cancel is advisory, never load-bearing."""
+        req_id = header.get("request_id")
+        rid = str(req_id) if req_id is not None else None
+        phase, kernel = "miss", None
+        if rid is not None:
+            dropped = self._q.remove_request(rid)
+            if dropped is not None and dropped.claim_done():
+                phase, kernel = "queued", dropped.kernel
+            else:
+                with self._lock:
+                    cands = [r for r in self._inflight.values()
+                             if r.request_id == rid]
+                    for pend in self._worker_pending.values():
+                        cands.extend(r for r in pend
+                                     if r.request_id == rid)
+                for r in cands:
+                    if r.claim_done():
+                        phase, kernel = "inflight", r.kernel
+                        break
+        if phase != "miss":
+            with self._lock:
+                self._cancelled += 1
+            obs_metrics.inc("serve.cancelled")
+            journal.emit(
+                "serve_cancelled", site="server", phase=phase,
+                kernel=kernel, request_id=rid,
+                worker_id=os.environ.get("TPK_SERVE_WORKER_ID"),
+            )
+        return {"v": protocol.VERSION, "op": "cancel", "ok": True,
+                "id": header.get("id"),
+                "cancelled": phase != "miss", "phase": phase}
+
     # -------------------------------------------------------------- #
     # worker side: coalesce, dispatch, respond                       #
     # -------------------------------------------------------------- #
@@ -651,17 +752,25 @@ class Server:
             if first is None:
                 continue
             window = self._window_s(self._q.depth())
+            window = self._clamp_window(window, (first,))
             self._last_window_ms = round(window * 1e3, 3)
             obs_metrics.gauge("serve.batch_window_ms",
                               self._last_window_ms)
             batch = [first]
             if window > 0:
-                deadline = time.perf_counter() + window
+                end = time.perf_counter() + window
                 while True:
-                    batch.extend(self._q.take_matching(
+                    taken = self._q.take_matching(
                         first.bucket, self.queue_max - len(batch)
-                    ))
-                    rem = deadline - time.perf_counter()
+                    )
+                    if taken:
+                        batch.extend(taken)
+                        # a tighter-deadline member joining the batch
+                        # pulls the window in — coalescing must never
+                        # spend budget the tightest member lacks
+                        end = min(end, time.perf_counter()
+                                  + self._clamp_window(window, taken))
+                    rem = end - time.perf_counter()
                     if rem <= 0:
                         break
                     time.sleep(min(rem, 0.001))
@@ -727,6 +836,23 @@ class Server:
         if gap is None or gap >= self.batch_window_s:
             return 0.0
         return min(self.batch_window_s, gap * (BATCH_TARGET - 1))
+
+    @staticmethod
+    def _clamp_window(window: float, reqs) -> float:
+        """The deadline clamp on the coalescing window: never widen
+        past HALF the tightest remaining budget among ``reqs`` — the
+        other half is left for the dispatch itself, so coalescing can
+        delay a deadline-carrying request but never doom it.
+        Deadline-free members leave the window alone."""
+        if window <= 0:
+            return window
+        now = time.monotonic()
+        for r in reqs:
+            if r.deadline_at is not None:
+                window = min(
+                    window, max(0.0, (r.deadline_at - now) / 2)
+                )
+        return window
 
     def _retire_if_abandoned(self) -> bool:
         """True when the watchdog abandoned THIS worker — and forget
@@ -799,6 +925,12 @@ class Server:
 
         from tpukernels import registry
 
+        with req.lock:
+            if req.done:
+                # cancelled while queued behind this batch (the
+                # in-flight cancel path claimed the done flag): the
+                # work was never started — skip it entirely
+                return
         req.worker_ident = threading.get_ident()
         # local t_start: the watchdog nulls req.t_start on a requeue,
         # and this attempt may be the abandoned original unwinding
@@ -816,6 +948,16 @@ class Server:
                         kernel=req.kernel, bucket=req.bucket,
                         batch_size=batch_size,
                         window_ms=self._last_window_ms)
+        if (req.deadline_at is not None
+                and time.monotonic() >= req.deadline_at):
+            # the budget died in the queue/coalescing window — skip
+            # the pad/dispatch phases entirely (the wait span above
+            # shows where it went) and answer the expiry now
+            with self._lock:
+                if self._inflight.get(req.serial) is req:
+                    self._inflight.pop(req.serial, None)
+            self._expire(req, where="worker", queue_wait=queue_wait)
+            return
         if req.spec is not None and req.requeues == 0:
             # once per request, not per attempt: a retry would count
             # the same padding waste twice
@@ -960,6 +1102,10 @@ class Server:
             replayed=req.replayed,
             ok=error is None, error=error,
         )
+        # delay_response fault point (docs/RESILIENCE.md): holds THIS
+        # completed response on the floor for N s — the deterministic
+        # slow-but-alive worker the hedged-dispatch chaos proof pins
+        faults.response_fault(req.kernel)
         try:
             sent = req.conn.send(header, payloads)
         except (OSError, protocol.ProtocolError):
