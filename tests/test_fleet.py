@@ -603,3 +603,47 @@ def test_loadgen_fleet_tenants_fairness_slo_e2e(tmp_path):
         env=chk_env,
     )
     assert chk.returncode == 0, chk.stdout + chk.stderr
+
+
+def test_fleet_mesh_tier_oversized_request(tmp_path):
+    """ISSUE 20 acceptance: client -> router -> worker -> sharded
+    dispatch -> traced response. An oversized scan (4x the avatar)
+    through the FLEET front door lands on a worker whose fake 4-device
+    inventory admits the mesh tier: the serve_request carries
+    mesh_shape [4], the bucket is the mesh bucket, and with tracing on
+    the worker's journal holds the dispatch span stamped with the mesh
+    geometry."""
+    from tpukernels.serve import client as serve_client
+
+    with _fleet(tmp_path, n=2, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_BATCH_WINDOW_MS": "0",
+        "TPK_TRACE": "1",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }) as (front, journal, _env):
+        x = (np.arange(32768) % 31).astype(np.int32)
+        want = np.cumsum(x, dtype=np.int64).astype(np.int32)
+        with serve_client.ServeClient(front, timeout_s=180) as c:
+            np.testing.assert_array_equal(c.dispatch("scan", x), want)
+            # same mesh bucket again: the executable memo serves it
+            np.testing.assert_array_equal(c.dispatch("scan", x), want)
+    events = _events(journal)
+    served = [e for e in events if e.get("kind") == "serve_request"
+              and e.get("kernel") == "scan"]
+    assert len(served) == 2, served
+    for e in served:
+        assert e["ok"], e
+        assert e["mesh_shape"] == [4], e
+        assert e["bucket"].endswith("|mesh4"), e["bucket"]
+        assert not e["bucketed"], e
+    # traced response: the worker's dispatch span carries the mesh
+    # geometry inside the serve span
+    spans = [e for e in events if e.get("kind") == "span"
+             and e.get("name", "").endswith("dispatch/scan")]
+    assert any(e.get("mesh") == "4" for e in spans), spans
+    # exactly one compile for the mesh bucket across the whole fleet
+    # (the one-compile-per-bucket fleet rule extends to mesh buckets)
+    aot = _aot_bucket_events(events, "scan", "32768")
+    assert len([e for e in aot if e["kind"] == "aot_miss"]) == 1, aot

@@ -148,3 +148,39 @@ def test_smoke_sweep_reaches_pipeline_depth():
     ]
     fuse_cands, _ = registry.tunables("scan_histogram").candidates()
     assert fuse_cands == [{"fuse": "off"}, {"fuse": "on"}]
+
+
+def test_weak_series_programs_declare_overlap_capability():
+    """ISSUE 20 satellite: every distributed program in
+    scaling.WEAK_SERIES must carry an OVERLAP_CAPS row — either
+    depth-searchable (rides TPK_DIST_DEPTH) or documented-exempt with
+    a stated why — so a future distributed program can't ship
+    sync-only silently."""
+    from tpukernels.obs import scaling
+
+    assert scaling.WEAK_SERIES, "weak-scaling catalog is empty"
+    for prog in scaling.WEAK_SERIES:
+        row = scaling.OVERLAP_CAPS.get(prog)
+        assert row is not None, (
+            f"{prog} is in scaling.WEAK_SERIES but has no OVERLAP_CAPS "
+            "row (declare mode='depth' or mode='exempt' with a why)"
+        )
+        assert row.get("mode") in ("depth", "exempt"), (prog, row)
+        assert isinstance(row.get("why"), str) and row["why"].strip(), (
+            f"{prog}: OVERLAP_CAPS row needs a non-empty why"
+        )
+    # no orphan rows: a cap for a program the catalog dropped is stale
+    assert set(scaling.OVERLAP_CAPS) <= set(scaling.WEAK_SERIES), (
+        set(scaling.OVERLAP_CAPS) - set(scaling.WEAK_SERIES)
+    )
+
+
+def test_mesh_kernels_are_registered():
+    """Every serve-over-mesh capable kernel must be a registered
+    kernel — the admission tier (bucketing.mesh_tier_for) and the
+    dispatch layer (registry.dispatch_mesh) both key off this list."""
+    names = registry.names()
+    assert registry.MESH_KERNELS, "mesh capability list is empty"
+    for name in registry.MESH_KERNELS:
+        assert name in names, f"MESH_KERNELS entry {name} unregistered"
+    assert len(set(registry.MESH_KERNELS)) == len(registry.MESH_KERNELS)

@@ -502,7 +502,7 @@ def test_weak_scaling_tool_end_to_end(tmp_path):
     env["TPK_HEALTH_JOURNAL"] = str(j)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "weak_scaling.py"),
-         "--sizes", "1 2", "--quick", "--reps", "1"],
+         "--sizes", "1 4", "--quick", "--reps", "1"],
         env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -511,8 +511,15 @@ def test_weak_scaling_tool_end_to_end(tmp_path):
     assert len(arts) == 1 and arts[0]["fake"] is True
     progs = {p["program"] for p in arts[0]["points"]}
     assert progs == set(scaling.WEAK_SERIES)
+    # allreduce2d has no 2-D factorization at n=1: skipped (not
+    # failed, no phantom point), while n=4 sweeps it on a (2, 2)
+    # mesh and stamps the geometry on the point
+    assert "skipped (no mesh shape at this size)" in proc.stdout
+    ar2 = [p for p in arts[0]["points"] if p["program"] == "allreduce2d"]
+    assert len(ar2) == 1
+    assert ar2[0]["n_devices"] == 4 and ar2[0]["mesh_shape"] == [2, 2]
     pts = _events(j, "weak_scaling_point")
-    assert len(pts) == 2 * len(scaling.WEAK_SERIES)
+    assert len(pts) == 2 * len(scaling.WEAK_SERIES) - 1
     assert all(p["fake"] for p in pts)
     invs = _events(j, "device_inventory")
     sites = {e["site"] for e in invs}
@@ -606,3 +613,92 @@ def test_busbw_sweep_stdout_byte_identical_without_journal(
     assert "{" not in out_off  # no structured payload leaks to stdout
     pts = _events(j, "busbw_point")
     assert len(pts) == 2  # only the journaled run left evidence
+
+
+# ---------------------------------------------------------------- #
+# comm/compute overlap verdicts (ISSUE 20)                          #
+# ---------------------------------------------------------------- #
+
+def test_overlap_artifact_schema_roundtrip_and_verdicts(tmp_path,
+                                                        monkeypatch):
+    """Writer -> loader -> analyze_overlap: a validated non-fake point
+    under the TPK_OVERLAP_MIN_FRAC floor earns overlap_low, one above
+    earns ok — and NEITHER gates (the below_roofline pattern)."""
+    root = tmp_path / "repo"
+    out = root / "docs" / "logs"
+    out.mkdir(parents=True)
+    inv = {"source": "jax", "platform": "tpu",
+           "device_kind": "tpu_v5_lite", "n_devices": 8, "fake": False}
+    pts = [
+        {"op": "nbody_ring", "n_devices": 8, "mesh_shape": None,
+         "depth": 2, "t_comm_s": 0.010, "t_compute_s": 0.010,
+         "t_full_s": 0.019, "overlap_frac": 0.1},
+        {"op": "stencil2d", "n_devices": 8, "mesh_shape": None,
+         "depth": 2, "t_comm_s": 0.004, "t_compute_s": 0.010,
+         "t_full_s": 0.011, "overlap_frac": 0.75},
+    ]
+    p = scaling.write_overlap_artifact(pts, inv, out_dir=str(out))
+    assert os.path.basename(p).startswith("scaling_overlap_")
+    rec = json.load(open(p))
+    assert rec["family"] == "overlap" and rec["fake"] is False
+
+    arts = scaling.load_artifacts(str(root))
+    v = scaling.analyze_overlap(arts)
+    low = v["overlap/nbody_ring/n8/d2"]
+    assert low["verdict"] == "overlap_low"
+    assert any("OVERLAP LOW" in f for f in low["flags"])
+    assert v["overlap/stencil2d/n8/d2"]["verdict"] == "ok"
+    # non-gating by construction: the full-repo analysis carries the
+    # overlap section but gating_findings never returns it
+    analysis = scaling.analyze_repo(str(root))
+    assert "overlap/nbody_ring/n8/d2" in analysis["overlap"]
+    assert scaling.gating_findings(analysis) == {}
+
+    # the floor is a knob with the fail-loud TPK_* parse contract
+    monkeypatch.setenv("TPK_OVERLAP_MIN_FRAC", "0.05")
+    v2 = scaling.analyze_overlap(arts)
+    assert v2["overlap/nbody_ring/n8/d2"]["verdict"] == "ok"
+    monkeypatch.setenv("TPK_OVERLAP_MIN_FRAC", "bogus")
+    with pytest.raises(ValueError, match="TPK_OVERLAP_MIN_FRAC"):
+        scaling.analyze_overlap(arts)
+    monkeypatch.setenv("TPK_OVERLAP_MIN_FRAC", "1.5")
+    with pytest.raises(ValueError, match="TPK_OVERLAP_MIN_FRAC"):
+        scaling.analyze_overlap(arts)
+
+
+def test_overlap_fake_evidence_never_verdicted(tmp_path):
+    """CPU gloo rehearsals prove the measurement plumbing only: a
+    fake-flagged artifact's points verdict no_data, never
+    overlap_low."""
+    out = tmp_path / "repo" / "docs" / "logs"
+    out.mkdir(parents=True)
+    inv = {"source": "env", "platform": "cpu", "fake": True}
+    scaling.write_overlap_artifact(
+        [{"op": "nbody_ring", "n_devices": 8, "mesh_shape": None,
+          "depth": 2, "t_comm_s": 0.01, "t_compute_s": 0.01,
+          "t_full_s": 0.02, "overlap_frac": 0.0}],
+        inv, out_dir=str(out))
+    arts = scaling.load_artifacts(str(tmp_path / "repo"))
+    v = scaling.analyze_overlap(arts)["overlap/nbody_ring/n8/d2"]
+    assert v["verdict"] == "no_data"
+    assert any("fake-device" in f for f in v["flags"])
+
+
+def test_obs_report_prints_overlap_low_without_gating(tmp_path):
+    """obs_report full + --check surface overlap_low findings while
+    the rc contract stays 0 — the satellite's exact wording."""
+    root = _root_with(tmp_path, {})
+    inv = {"source": "jax", "platform": "tpu",
+           "device_kind": "tpu_v5_lite", "n_devices": 8, "fake": False}
+    scaling.write_overlap_artifact(
+        [{"op": "nbody_ring", "n_devices": 8, "mesh_shape": None,
+          "depth": 2, "t_comm_s": 0.010, "t_compute_s": 0.010,
+          "t_full_s": 0.019, "overlap_frac": 0.1}],
+        inv, out_dir=os.path.join(root, "docs", "logs"))
+    r = _run_tool("obs_report.py", "--root", root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "overlap_low" in r.stdout
+    assert "overlap/nbody_ring/n8/d2" in r.stdout
+    r = _run_tool("obs_report.py", "--check", "--root", root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "overlap_low (non-gating)" in r.stdout

@@ -843,3 +843,75 @@ def test_serve_ctl_start_status_stop(tmp_path):
     events = _events(str(tmp_path / "health.jsonl"))
     assert any(e.get("kind") == "serve_start" for e in events)
     assert any(e.get("kind") == "serve_stop" for e in events)
+
+
+# ---------------------------------------------------------------- #
+# serve-over-mesh tier (ISSUE 20)                                   #
+# ---------------------------------------------------------------- #
+
+def test_mesh_tier_admission_rules(monkeypatch):
+    """bucketing.mesh_tier_for is env-only (admission must never init
+    a backend): it offers the mesh tier exactly when the kernel is
+    mesh-capable, the env inventory shows > 1 device, and the leading
+    dim divides across them."""
+    from tpukernels.serve import bucketing
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    big = np.zeros(1 << 15, np.int32)
+    assert bucketing.mesh_tier_for("scan", [big], {}) == (4,)
+    # non-mesh kernel: no tier, however big the request
+    assert bucketing.mesh_tier_for(
+        "sgemm", [np.zeros((512, 512), np.float32)] * 2, {}) is None
+    # leading dim must divide across the inventory
+    assert bucketing.mesh_tier_for(
+        "scan", [np.zeros((1 << 15) + 1, np.int32)], {}) is None
+    # nbody needs its full 7-array state, every array the same (N,)
+    assert bucketing.mesh_tier_for(
+        "nbody", [np.zeros(64, np.float32)] * 7, {}) == (4,)
+    assert bucketing.mesh_tier_for(
+        "nbody", [np.zeros(64, np.float32)] * 6, {}) is None
+    # no usable device inventory -> no tier (a real pod admits only
+    # after the worker-side probe, never from env guesswork)
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert bucketing.mesh_tier_for("scan", [big], {}) is None
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    assert bucketing.mesh_tier_for("scan", [big], {}) is None
+
+
+def test_serve_mesh_tier_end_to_end(tmp_path):
+    """ISSUE 20 acceptance: an oversized request (4x the scan avatar)
+    is not rejected — it routes to the mesh tier, dispatches through
+    the mesh-backed executable, and its serve_request carries the mesh
+    shape; an in-avatar request on the same daemon still buckets
+    normally with no mesh stamp."""
+    from tpukernels.serve import client as serve_client
+
+    with _daemon(tmp_path, env_extra={
+        "TPK_SERVE_BUCKETS": SCAN_BUCKET,
+        "TPK_SERVE_MAX_PAD_FRAC": "0.9",
+        "TPK_SERVE_BATCH_WINDOW_MS": "0",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }) as (sock, journal, _proc):
+        x = (np.arange(32768) % 29).astype(np.int32)
+        small = (np.arange(4096) % 29).astype(np.int32)
+        with serve_client.ServeClient(sock, timeout_s=120) as c:
+            out = c.dispatch("scan", x)
+            np.testing.assert_array_equal(
+                out, np.cumsum(x, dtype=np.int64).astype(np.int32))
+            out2 = c.dispatch("scan", small)
+            np.testing.assert_array_equal(
+                out2,
+                np.cumsum(small, dtype=np.int64).astype(np.int32))
+    served = [e for e in _events(journal)
+              if e.get("kind") == "serve_request"]
+    assert len(served) == 2, served
+    big = next(e for e in served if e["shapes"] == [[32768]])
+    sml = next(e for e in served if e["shapes"] == [[4096]])
+    assert big["mesh_shape"] == [4], big
+    assert big["bucket"].endswith("|mesh4"), big["bucket"]
+    assert big["ok"] and not big["bucketed"], big
+    assert sml["mesh_shape"] is None and sml["bucketed"], sml

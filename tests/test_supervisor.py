@@ -813,3 +813,10 @@ def test_production_plan_order_reproduces_next_md(tmp_path,
     assert not rollup_spec.gating
     assert rollup_spec.stamp == "daily"
     assert "tpukernels.obs.rollup" in rollup_spec.shell
+    # busbw_sweep banks one 2-D mesh point per healthy window when
+    # >= 4 devices are probed (ISSUE 20 satellite) without moving in
+    # the density schedule — the 2-D leg rides the same step
+    busbw_spec = next(s for s in cli.PRODUCTION_QUEUE
+                      if s.name == "busbw_sweep")
+    assert "--mesh=2x" in busbw_spec.shell
+    assert "device_count()" in busbw_spec.shell

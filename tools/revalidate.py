@@ -265,7 +265,24 @@ else
   echo "WARN: busbw sweep failed rc=$? (non-gating) - $busbw_log"
   exit 1
 fi
-""", gating=False, stamp="never", timeout_s=300, cost_min=3, value=11,
+# one 2-D mesh point per healthy window (docs/DISTRIBUTED.md §2-D
+# meshes): a short 2 x (n/2) allreduce sweep so the torus
+# decomposition banks real-topology evidence beside the ring's —
+# the mesh_shape-stamped artifact obs_report's per-shape bus-bw
+# series reads. Probed in a child so a dead backend costs a WARN
+# here, never a wedged supervisor.
+ndev=$(python -c "import jax; print(jax.device_count())" 2>/dev/null)
+if [ "${ndev:-0}" -ge 4 ]; then
+  if timeout -k 10 240 python -m tpukernels.parallel.busbw \\
+      --mesh=2x$((ndev / 2)) --max=4M --reps=5 \\
+      >>"$busbw_log" 2>&1; then
+    tail -2 "$busbw_log"
+  else
+    echo "WARN: 2-D busbw sweep failed rc=$? (non-gating) - $busbw_log"
+    exit 1
+  fi
+fi
+""", gating=False, stamp="never", timeout_s=540, cost_min=3, value=11,
       after=("prewarm_all",),
       inputs=("tpukernels/parallel", "tpukernels/obs/scaling.py")),
     # 1. headline metrics + the 15% self-regression gate; the JSON

@@ -407,10 +407,16 @@ def invalidate_kernel(name: str, prefixes=()) -> dict:
             base.startswith(p) for p in prefixes
         )
 
-    memo_keys = [k for k in _EXEC_MEMO if k[0] == name]
+    # base-name match splits on "@" so a kernel's mesh-tier variants
+    # (registry.dispatch_mesh memoizes under "<name>@mesh<n>") drop
+    # with it — in the in-process memos here exactly as in the
+    # manifest rows below
+    memo_keys = [k for k in _EXEC_MEMO
+                 if k[0].split("@", 1)[0] == name]
     for k in memo_keys:
         _EXEC_MEMO.pop(k, None)
-    for k in [k for k in _JIT_MEMO if k[0] == name]:
+    for k in [k for k in _JIT_MEMO
+              if k[0].split("@", 1)[0] == name]:
         _JIT_MEMO.pop(k, None)
     dropped: list = []
     if enabled():

@@ -59,6 +59,7 @@ from tpukernels.resilience import journal
 
 SCHEMA = "tpk_scaling_v1"
 DEFAULT_MIN_EFF = 0.5
+DEFAULT_OVERLAP_MIN_FRAC = 0.3
 
 _ROUND_RE = re.compile(r"SCALING_r(\d+)\.json$")
 _MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
@@ -104,6 +105,46 @@ WEAK_SERIES = {
         "series": "weak/allreduce",
         "work_unit": "f32 elements/chip in the psum message",
     },
+    "allreduce2d": {
+        "series": "weak/allreduce2d",
+        "work_unit": "f32 elements/chip, two-phase over an (r, c) mesh "
+                     "(reduce-scatter along x, allgather along y)",
+    },
+}
+
+# Overlap capability catalog — the registry-contract lint surface
+# (tests/test_registry_contract.py): every WEAK_SERIES program must
+# declare whether its comm/compute overlap is depth-searchable
+# ("depth": TPK_DIST_DEPTH pipelines it) or documented-exempt
+# ("exempt" + why), so a future distributed program cannot ship
+# sync-only silently.
+OVERLAP_CAPS = {
+    "stencil2d": {
+        "mode": "depth",
+        "why": "k-deep halo bands double-buffer at depth 2 "
+               "(_jacobi_dist; docs/DISTRIBUTED.md §overlap)",
+    },
+    "nbody_ring": {
+        "mode": "depth",
+        "why": "j-block ring pipelines depth hops of ppermute ahead "
+               "of the force block (docs/DISTRIBUTED.md §overlap)",
+    },
+    "scan_hist": {
+        "mode": "exempt",
+        "why": "one all_gather/psum phase after all local compute — "
+               "there is no second hop to overlap with",
+    },
+    "allreduce": {
+        "mode": "exempt",
+        "why": "a single fused psum; overlap is XLA's to schedule, "
+               "not expressible at this layer",
+    },
+    "allreduce2d": {
+        "mode": "exempt",
+        "why": "two back-to-back psum phases with a data dependency "
+               "(phase 2 consumes phase 1's partials); nothing "
+               "independent to overlap",
+    },
 }
 
 
@@ -122,6 +163,25 @@ def min_eff() -> float:
     if not 0.0 <= val <= 1.0:
         raise ValueError(
             f"TPK_SCALING_MIN_EFF={raw!r}: expected a float in [0, 1]"
+        )
+    return val
+
+
+def overlap_min_frac() -> float:
+    """The comm/compute overlap floor (``TPK_OVERLAP_MIN_FRAC``,
+    default 0.3) under which a validated non-fake overlap point earns
+    the non-gating ``overlap_low`` verdict. Fail-loud parse, the TPK_*
+    knob contract."""
+    raw = os.environ.get("TPK_OVERLAP_MIN_FRAC")
+    if raw is None:
+        return DEFAULT_OVERLAP_MIN_FRAC
+    try:
+        val = float(raw)
+    except ValueError:
+        val = -1.0
+    if not 0.0 <= val <= 1.0:
+        raise ValueError(
+            f"TPK_OVERLAP_MIN_FRAC={raw!r}: expected a float in [0, 1]"
         )
     return val
 
@@ -278,10 +338,12 @@ def _write(prefix: str, payload: dict, out_dir=None) -> str:
 
 
 def write_busbw_artifact(points, op: str, n_devices: int, inv: dict,
-                         out_dir=None) -> str:
+                         out_dir=None, mesh_shape=None) -> str:
     """Persist one bus-bw sweep: ``points`` is the ``sweep()`` result
-    ``[(size_bytes, seconds, gb_s), ...]``."""
-    return _write(f"scaling_busbw_{op}", {
+    ``[(size_bytes, seconds, gb_s), ...]``. ``mesh_shape`` is the
+    ``(rows, cols)`` of a 2-D sweep (None = the 1-D ring of record —
+    omitted from the payload so existing artifacts stay byte-shaped)."""
+    payload = {
         "family": "busbw",
         "op": op,
         "n_devices": int(n_devices),
@@ -291,6 +353,22 @@ def write_busbw_artifact(points, op: str, n_devices: int, inv: dict,
             {"size_bytes": int(s), "seconds": sec, "gb_s": bw}
             for s, sec, bw in points
         ],
+    }
+    if mesh_shape is not None:
+        payload["mesh_shape"] = [int(d) for d in mesh_shape]
+    return _write(f"scaling_busbw_{op}", payload, out_dir)
+
+
+def write_overlap_artifact(points, inv: dict, out_dir=None) -> str:
+    """Persist one comm/compute overlap measurement sweep
+    (``tpukernels.parallel.overlap``): ``points`` is a list of dicts
+    ``{op, n_devices, mesh_shape, depth, t_comm_s, t_compute_s,
+    t_full_s, overlap_frac}``."""
+    return _write("scaling_overlap", {
+        "family": "overlap",
+        "fake": bool(inv.get("fake", True)),
+        "device_inventory": inv,
+        "points": list(points),
     }, out_dir)
 
 
@@ -418,8 +496,10 @@ def load_multichip(root) -> list:
 # ------------------------------------------------------------------ #
 
 def busbw_series(artifacts) -> dict:
-    """``{(op, size_bytes, n_devices): [point, ...]}`` in artifact
-    order; each point carries value/fake/source."""
+    """``{(op, size_bytes, n_devices, mesh_shape): [point, ...]}`` in
+    artifact order; each point carries value/fake/source. 1-D sweeps
+    carry ``mesh_shape=None`` so their series keys (and report names)
+    are unchanged from before 2-D meshes existed."""
     out: dict = {}
     for art in artifacts:
         if art.get("family") != "busbw":
@@ -427,21 +507,29 @@ def busbw_series(artifacts) -> dict:
         fake = bool(art.get("fake", True))
         op = art.get("op") or "?"
         nd = art.get("n_devices")
+        ms = art.get("mesh_shape")
+        mesh_shape = tuple(int(d) for d in ms) \
+            if isinstance(ms, (list, tuple)) and len(ms) == 2 else None
         inv = art.get("device_inventory") or {}
         kind = inv.get("device_kind")
         inv_source = inv.get("source")
+        # multi-host sweeps cross DCN, not ICI: the ceiling such a
+        # point is judged against must be the network one
+        pc = inv.get("process_count")
+        dcn = isinstance(pc, int) and pc > 1
         for pt in art["points"]:
             if not isinstance(pt, dict):
                 continue
             gbs = pt.get("gb_s")
             if not isinstance(gbs, (int, float)) or isinstance(gbs, bool):
                 continue
-            key = (op, pt.get("size_bytes"), nd)
+            key = (op, pt.get("size_bytes"), nd, mesh_shape)
             out.setdefault(key, []).append({
                 "value": gbs,
                 "fake": fake,
                 "device_kind": kind,
                 "inv_source": inv_source,
+                "dcn": dcn,
                 "source": art.get("_source", "?"),
                 # the trend-parser escape hatch: a point marked
                 # invalidated at source (truthy value = the reason)
@@ -459,11 +547,14 @@ def analyze_busbw(artifacts, eps: float) -> dict:
     ``no_data`` with an explanatory flag, never a regression and never
     impossible — exactly how simulated SLO entries never gate."""
     verdicts = {}
-    for (op, size, nd), pts in sorted(
+    for (op, size, nd, mesh_shape), pts in sorted(
         busbw_series(artifacts).items(),
-        key=lambda kv: (kv[0][0], kv[0][2] or 0, kv[0][1] or 0),
+        key=lambda kv: (kv[0][0], kv[0][2] or 0, kv[0][1] or 0,
+                        kv[0][3] or ()),
     ):
         name = f"busbw/{op}/n{nd}/{size}B"
+        if mesh_shape is not None:
+            name += f"/mesh{mesh_shape[0]}x{mesh_shape[1]}"
         flags = []
         impossible = False
         valid = []
@@ -483,7 +574,9 @@ def analyze_busbw(artifacts, eps: float) -> dict:
                     "from gating"
                 )
                 continue
-            ceil, kind, basis = ceiling_gb_s(op, p["device_kind"])
+            ceil, kind, basis = ceiling_gb_s(
+                op, p["device_kind"], dcn=p.get("dcn", False)
+            )
             over = p["value"] > ceil * (1.0 + eps)
             if p.get("invalidated"):
                 # already caught at the source (the trend-parser
@@ -507,6 +600,7 @@ def analyze_busbw(artifacts, eps: float) -> dict:
             valid.append(p)
         info = {
             "op": op, "size_bytes": size, "n_devices": nd,
+            "mesh_shape": list(mesh_shape) if mesh_shape else None,
             "points": len(pts), "valid_points": len(valid),
             "latest": valid[-1]["value"] if valid else None,
             "latest_source": valid[-1]["source"] if valid else None,
@@ -612,6 +706,73 @@ def analyze_weak(artifacts) -> dict:
     return verdicts
 
 
+def analyze_overlap(artifacts) -> dict:
+    """Per-(op, n_devices, depth) overlap verdicts over the NEWEST
+    artifact carrying each key (superseded-evidence rule, like
+    :func:`analyze_weak`). ``overlap_low`` is NON-GATING — the
+    ``below_roofline`` pattern: a validated non-fake point whose
+    ``overlap_frac`` sits under the ``TPK_OVERLAP_MIN_FRAC`` floor is
+    headroom to reclaim, not a broken build. Fake evidence (the CPU
+    gloo rehearsals) proves the measurement plumbing and is reported
+    as ``no_data``."""
+    floor = overlap_min_frac()
+    latest: dict = {}
+    for art in artifacts:
+        if art.get("family") != "overlap":
+            continue
+        fake = bool(art.get("fake", True))
+        for pt in art["points"]:
+            if not isinstance(pt, dict):
+                continue
+            frac = pt.get("overlap_frac")
+            if not isinstance(frac, (int, float)) or isinstance(frac, bool):
+                continue
+            key = (pt.get("op") or "?", pt.get("n_devices"),
+                   pt.get("depth"))
+            latest[key] = {
+                "point": pt, "fake": fake,
+                "source": art.get("_source", "?"),
+            }
+    verdicts = {}
+    for (op, nd, depth) in sorted(
+        latest, key=lambda k: (k[0], k[1] or 0, k[2] or 0)
+    ):
+        ent = latest[(op, nd, depth)]
+        pt = ent["point"]
+        frac = pt["overlap_frac"]
+        ms = pt.get("mesh_shape")
+        name = f"overlap/{op}/n{nd}/d{depth}"
+        info = {
+            "op": op, "n_devices": nd, "depth": depth,
+            "mesh_shape": list(ms) if ms else None,
+            "overlap_frac": round(float(frac), 4),
+            "t_comm_s": pt.get("t_comm_s"),
+            "t_compute_s": pt.get("t_compute_s"),
+            "t_full_s": pt.get("t_full_s"),
+            "fake": ent["fake"],
+            "source": ent["source"],
+            "flags": [],
+        }
+        if ent["fake"]:
+            info["verdict"] = "no_data"
+            info["flags"].append(
+                "fake-device evidence only (overlap plumbing proven; "
+                "the fraction itself never verdict-ed)"
+            )
+        elif frac < floor:
+            info["verdict"] = "overlap_low"
+            info["flags"].append(
+                f"OVERLAP LOW: measured comm/compute overlap "
+                f"{frac:.1%} under the TPK_OVERLAP_MIN_FRAC floor "
+                f"{floor:.0%} at depth {depth} (non-gating headroom "
+                "signal)"
+            )
+        else:
+            info["verdict"] = "ok"
+        verdicts[name] = info
+    return verdicts
+
+
 def analyze_dryrun(root) -> dict:
     """Per-program dryrun-wall series over the MULTICHIP rounds —
     informational only: the rounds run on fake CPU devices by
@@ -651,6 +812,7 @@ def analyze_repo(root, eps: float = 0.01) -> dict:
     out = {
         "busbw": analyze_busbw(artifacts, eps),
         "weak": analyze_weak(artifacts),
+        "overlap": analyze_overlap(artifacts),
         "dryrun": analyze_dryrun(root),
         "artifacts": len(artifacts),
     }
@@ -660,6 +822,7 @@ def analyze_repo(root, eps: float = 0.01) -> dict:
         min_eff=min_eff(),
         busbw={k: v["verdict"] for k, v in out["busbw"].items()},
         weak={k: v["verdict"] for k, v in out["weak"].items()},
+        overlap={k: v["verdict"] for k, v in out["overlap"].items()},
         dryrun_programs=sorted(out["dryrun"]),
     )
     return out

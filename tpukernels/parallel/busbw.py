@@ -82,8 +82,23 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
         raise ValueError(f"op={op!r}: expected allreduce or ppermute")
     if mesh is None:
         mesh = make_mesh()  # joins the multi-host job when configured
-    nranks = mesh.shape["x"]
-    sharding = row_sharding(mesh)
+    axes = mesh.axis_names
+    mesh_shape = tuple(int(mesh.shape[a]) for a in axes) \
+        if len(axes) == 2 else None
+    if mesh_shape is not None and op != "allreduce":
+        raise ValueError(
+            f"op={op!r} has no 2-D decomposition; only allreduce "
+            "sweeps 2-D meshes"
+        )
+    nranks = 1
+    for a in axes:
+        nranks *= int(mesh.shape[a])
+    if mesh_shape is None:
+        sharding = row_sharding(mesh)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, PartitionSpec(tuple(axes), None))
     fake = scaling.inventory(probe=True).get("fake", True)
     results = []
     size = min_bytes
@@ -128,16 +143,24 @@ def sweep(min_bytes: int = 1 << 10, max_bytes: int = 64 << 20,
         # §scaling): no I/O when journaling is off, nothing on stdout
         # either way — the clean-path byte-identity proof covers this
         obs_metrics.inc("scaling.busbw_points")
+        # mesh_shape rides the event only on 2-D sweeps so the 1-D
+        # ring's journal payload stays byte-shaped
+        extra = {"mesh_shape": list(mesh_shape)} if mesh_shape else {}
         journal.emit(
             "busbw_point", op=op, n_devices=nranks,
             size_bytes=size, seconds=round(best, 6),
-            gb_s=round(bw, 4), fake=bool(fake),
+            gb_s=round(bw, 4), fake=bool(fake), **extra,
         )
         if verbose:
-            print(
+            line = (
                 f"{op} n={nranks} size={size:>10d}B "
                 f"time={best * 1e3:9.3f}ms bw={bw:8.3f} GB/s"
             )
+            if mesh_shape:
+                # appended, never inserted: the 1-D line prefix is the
+                # byte-stable surface the C driver greps
+                line += f" mesh={mesh_shape[0]}x{mesh_shape[1]}"
+            print(line)
         size *= 4
     return results
 
@@ -175,6 +198,7 @@ if __name__ == "__main__":
     import sys
 
     kw = {}
+    mesh_arg = None
     for a in sys.argv[1:]:
         if a.startswith("--min="):
             kw["min_bytes"] = _parse_size(a[6:])
@@ -184,6 +208,9 @@ if __name__ == "__main__":
             kw["reps"] = int(a[7:])
         elif a.startswith("--op="):
             kw["op"] = a[5:]
+        elif a.startswith("--mesh="):
+            r, _, c = a[7:].partition("x")
+            mesh_arg = (int(r), int(c))
     # CLI journal default (the bench.py/revalidate.py/loadgen.py
     # contract): an unattended sweep's evidence lands in the day's
     # health journal unless the operator chose otherwise
@@ -196,11 +223,15 @@ if __name__ == "__main__":
     # host (and on jaxes without the guard would silently mesh only
     # this host's chips). tests/test_distributed.py
     # test_multiprocess_busbw_cli pins this ordering.
-    mesh = make_mesh()
+    mesh = make_mesh(mesh_arg)
     inv = scaling.emit_inventory("busbw", probe=True)
     res = sweep(mesh=mesh, **kw)
+    nranks = 1
+    for ax in mesh.axis_names:
+        nranks *= int(mesh.shape[ax])
     artifact = scaling.write_busbw_artifact(
-        res, kw.get("op", "allreduce"), mesh.shape["x"], inv
+        res, kw.get("op", "allreduce"), nranks, inv,
+        mesh_shape=mesh_arg,
     )
     # stderr, not stdout: the sweep table above is the byte-stable
     # surface the C driver (and the byte-identity proof) reads

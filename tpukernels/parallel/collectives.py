@@ -59,6 +59,30 @@ def _ring_perm(n: int, shift: int = 1):
     return [(i, (i + shift) % n) for i in range(n)]
 
 
+def _dist_depth() -> int:
+    """TPK_DIST_DEPTH: comm/compute pipeline depth for the distributed
+    kernels (docs/DISTRIBUTED.md §overlap). 1 = the synchronous path of
+    record; >= 2 issues that many hops' `ppermute`s before the compute
+    that consumes them, so the shift for hop k+1 is in flight while hop
+    k's sweep/force block runs. Results are bitwise identical at every
+    depth (same accumulation order, same fp ops — only the *issue*
+    order of independent comm moves). Fail-loud parse per the TPK_*
+    contract: a malformed or < 1 value must never silently degrade a
+    measured run to the sync path."""
+    raw = os.environ.get("TPK_DIST_DEPTH")
+    if raw is None:
+        return 1
+    try:
+        depth = int(raw)
+    except ValueError:
+        depth = 0
+    if depth < 1:
+        raise ValueError(
+            f"TPK_DIST_DEPTH={raw!r}: expected an int >= 1"
+        )
+    return depth
+
+
 # ------------------------------------------------------------ allreduce
 
 @functools.lru_cache(maxsize=None)
@@ -73,9 +97,54 @@ def _allreduce_build(mesh: Mesh, axis: str):
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _allreduce2d_build(mesh: Mesh, axes, scatter: bool):
+    ax0, ax1 = axes
+
+    def local_fn(xl):  # (rows/(r*c), S) local slab
+        if scatter:
+            # two-phase decomposition over the (r, c) torus: reduce-
+            # scatter along x leaves each x-rank a distinct 1/r of the
+            # columns (summed over its y-column group), the small psum
+            # along y completes the reduction on 1/r of the bytes, and
+            # the allgather along x restores full rows — 2(r-1)/r + ...
+            # of the flat ring's per-link volume split across both
+            # mesh dimensions' links.
+            s = jax.lax.psum_scatter(
+                xl, ax0, scatter_dimension=1, tiled=True
+            )
+            s = jax.lax.psum(s, ax1)
+            return jax.lax.all_gather(s, ax0, axis=1, tiled=True)
+        # columns not divisible by r: hierarchical two-phase reduce
+        # (sum along x, then along y) — same wire pattern class,
+        # no scatter tiling constraint
+        return jax.lax.psum(jax.lax.psum(xl, ax0), ax1)
+
+    spec = P((ax0, ax1), None)
+    return jax.jit(
+        shard_map(local_fn, mesh=mesh, in_specs=spec, out_specs=spec)
+    )
+
+
 def allreduce_sum(x, mesh: Mesh, axis: str = "x"):
     """MPI_Allreduce(SUM): x is (P, S) with row r = rank r's
-    contribution; every row of the result is the elementwise sum."""
+    contribution; every row of the result is the elementwise sum.
+
+    On a 2-D mesh (make_mesh((r, c))) the reduction decomposes into
+    the two-phase reduce-scatter-along-x / reduce-along-y /
+    allgather-along-x program (`axis` is ignored; both mesh axes
+    participate); rows must divide r*c."""
+    axes = mesh.axis_names
+    if len(axes) == 2:
+        r, c = mesh.shape[axes[0]], mesh.shape[axes[1]]
+        if x.shape[0] % (r * c):
+            raise ValueError(
+                f"rows={x.shape[0]} must divide across {r}x{c} ranks"
+            )
+        scatter = x.shape[-1] % r == 0
+        with trace.span("collective/allreduce", n=r * c,
+                        mesh_shape=f"{r}x{c}"):
+            return _allreduce2d_build(mesh, tuple(axes), scatter)(x)
     with trace.span("collective/allreduce", n=mesh.shape[axis]):
         return _allreduce_build(mesh, axis)(x)
 
@@ -174,15 +243,27 @@ def _jacobi_dist(x, iters: int, mesh: Mesh, axis: str, k: int,
     # clamp BEFORE the cache lookup so raw k values with the same
     # effective depth share one compiled program
     k = max(1, min(int(k), x.shape[0] // nranks))
-    with trace.span(f"collective/jacobi{len(x.shape)}d", n=nranks, k=k):
+    # Pipeline depth saturates at 2 here: a round's outgoing halos are
+    # its own first/last k rows, so at most ONE round's ppermutes can
+    # be in flight ahead of the sweep that needs them. The 2-deep path
+    # sweeps the k-wide edge bands first (each needs only 2k owned rows
+    # plus the in-hand halo), ships them, then does the full sweep —
+    # which requires 2k <= l0 or the bands would wrap; smaller blocks
+    # fall back to the sync path. Clamped before the cache lookup for
+    # the same sharing reason as k.
+    depth = min(_dist_depth(), 2)
+    if depth > 1 and 2 * k > x.shape[0] // nranks:
+        depth = 1
+    with trace.span(f"collective/jacobi{len(x.shape)}d", n=nranks, k=k,
+                    depth=depth):
         return _jacobi_dist_build(
-            x.shape, int(iters), mesh, axis, k, bool(residual)
+            x.shape, int(iters), mesh, axis, k, bool(residual), depth
         )(x)
 
 
 @functools.lru_cache(maxsize=None)
 def _jacobi_dist_build(dims, iters: int, mesh: Mesh, axis: str, k: int,
-                       residual: bool = False):
+                       residual: bool = False, depth: int = 1):
     nranks = mesh.shape[axis]
     nd = len(dims)
     l0 = dims[0] // nranks
@@ -193,20 +274,23 @@ def _jacobi_dist_build(dims, iters: int, mesh: Mesh, axis: str, k: int,
 
     def local_fn(xl):  # (l0, *dims[1:]) local block
         rank = jax.lax.axis_index(axis)
+        base = rank * l0  # global row index of the local block's row 0
 
-        def rounds(v, kk):
-            top = jax.lax.ppermute(v[-kk:], axis, up_perm)
-            bot = jax.lax.ppermute(v[:kk], axis, down_perm)
-            p = jnp.concatenate([top, v, bot], axis=0)
-            shape = (l0 + 2 * kk,) + dims[1:]
+        def sweep_band(band, kk, start):
+            """kk masked sweeps over a band whose row 0 sits at global
+            dim-0 index `start` (traced). Band-edge replication (from
+            _edge_shift) contaminates one row inward per sweep; callers
+            slice out the rows that stayed exact."""
+            shape = band.shape
             iota = lambda a: jax.lax.broadcasted_iota(  # noqa: E731
                 jnp.int32, shape, a
             )
-            g0 = rank * l0 - kk + iota(0)
+            g0 = start + iota(0)
             interior = (g0 > 0) & (g0 < dims[0] - 1)
             for a in range(1, nd):
                 ga = iota(a)
                 interior &= (ga > 0) & (ga < dims[a] - 1)
+            p = band
             for _ in range(kk):
                 out = scale * sum(
                     _edge_shift(p, a, fwd)
@@ -214,12 +298,64 @@ def _jacobi_dist_build(dims, iters: int, mesh: Mesh, axis: str, k: int,
                     for fwd in (True, False)
                 )
                 p = jnp.where(interior, out, p)
+            return p
+
+        def rounds(v, kk):
+            top = jax.lax.ppermute(v[-kk:], axis, up_perm)
+            bot = jax.lax.ppermute(v[:kk], axis, down_perm)
+            p = sweep_band(
+                jnp.concatenate([top, v, bot], axis=0), kk, base - kk
+            )
             return p[kk : kk + l0]
 
         passes, rem = divmod(iters, k)
-        v = jax.lax.fori_loop(0, passes, lambda _, v: rounds(v, k), xl)
-        if rem:
-            v = rounds(v, rem)
+        if depth == 1:
+            v = jax.lax.fori_loop(
+                0, passes, lambda _, v: rounds(v, k), xl
+            )
+            if rem:
+                v = rounds(v, rem)
+        else:
+            # Double-buffered rounds: each round receives its k-deep
+            # halos from the PREVIOUS round's tail ppermutes, sweeps
+            # just the k-wide edge bands it must export (3k-row bands:
+            # after k sweeps the middle k rows are exact, matching the
+            # full sweep bitwise), ships them for the NEXT round, and
+            # only then runs the full local sweep — so the next hop's
+            # halo bytes ride the wire under this round's bulk compute.
+            def round_db(_, carry):
+                v, top, bot = carry
+                head = sweep_band(
+                    jnp.concatenate([top, v[: 2 * k]], axis=0),
+                    k, base - k,
+                )[k : 2 * k]  # == v_new[:k], bitwise
+                tail = sweep_band(
+                    jnp.concatenate([v[-2 * k :], bot], axis=0),
+                    k, base + l0 - 2 * k,
+                )[k : 2 * k]  # == v_new[-k:], bitwise
+                # next round's halos leave before the bulk sweep starts
+                nt = jax.lax.ppermute(tail, axis, up_perm)
+                nb = jax.lax.ppermute(head, axis, down_perm)
+                p = sweep_band(
+                    jnp.concatenate([top, v, bot], axis=0), k, base - k
+                )
+                return p[k : k + l0], nt, nb
+
+            top0 = jax.lax.ppermute(xl[-k:], axis, up_perm)
+            bot0 = jax.lax.ppermute(xl[:k], axis, down_perm)
+            v, top, bot = jax.lax.fori_loop(
+                0, passes, round_db, (xl, top0, bot0)
+            )
+            if rem:
+                # the k-deep halos from the last ppermute pair are in
+                # hand; a rem-round needs only their innermost rem rows
+                p = sweep_band(
+                    jnp.concatenate(
+                        [top[k - rem :], v, bot[:rem]], axis=0
+                    ),
+                    rem, base - rem,
+                )
+                v = p[rem : rem + l0]
         if residual:
             # the reference's periodic residual MPI_Allreduce
             # (SURVEY.md §3(b)): the Jacobi convergence monitor
@@ -478,17 +614,25 @@ def nbody_dist_ring(state, steps: int, mesh: Mesh, axis: str = "x",
     # pass drops BOTH directions' dead rotations). Default stays off
     # until the pod A/B (docs/NEXT.md) measures it.
     bidir = os.environ.get("TPK_NBODY_RING_BIDIR") == "1"
-    with trace.span("collective/nbody_ring", n=mesh.shape[axis]):
+    # TPK_DIST_DEPTH >= 2: pipeline the ring. The prologue pre-rotates
+    # depth-1 j-block groups, and each loop pass issues the NEXT hop's
+    # ppermute before computing forces from the oldest in-hand group —
+    # the shift rides the wire under the force block. Bitwise identical
+    # at every depth (same accel order 0..P-1, same accumulation);
+    # depth > P buys nothing, so clamp to the ring length.
+    depth = min(_dist_depth(), mesh.shape[axis])
+    with trace.span("collective/nbody_ring", n=mesh.shape[axis],
+                    depth=depth):
         return _nbody_ring_build(
             int(steps), mesh, axis, float(dt), float(eps), skip_last,
-            bidir
+            bidir, depth
         )(*state)
 
 
 @functools.lru_cache(maxsize=None)
 def _nbody_ring_build(steps: int, mesh: Mesh, axis: str,
                       dt: float, eps: float, skip_last: bool = False,
-                      bidir: bool = False):
+                      bidir: bool = False, depth: int = 1):
     dt = jnp.float32(dt)
     eps2 = jnp.float32(eps * eps)
     nranks = mesh.shape[axis]
@@ -539,18 +683,59 @@ def _nbody_ring_build(steps: int, mesh: Mesh, axis: str,
             else:
                 init_blocks = tuple(a[:h] for a in (pxl, pyl, pzl, ml)) + \
                     tuple(a[h:] for a in (pxl, pyl, pzl, ml))
-            nloops = nranks - 1 if skip_last else nranks
-            out = jax.lax.fori_loop(
-                0, nloops, ring, (zero, zero, zero) + init_blocks
-            )
-            ax, ay, az = out[:3]
-            if skip_last:
-                # the peeled final pass: accumulate the last j-data's
-                # contribution without rotating it onward. Same accel
-                # op sequence as the uniform loop -> bitwise-identical
-                # trajectories (per formulation).
-                dax, day, daz = accel_pair(out[3:])
-                ax, ay, az = ax + dax, ay + day, az + daz
+            if depth == 1:
+                nloops = nranks - 1 if skip_last else nranks
+                out = jax.lax.fori_loop(
+                    0, nloops, ring, (zero, zero, zero) + init_blocks
+                )
+                ax, ay, az = out[:3]
+                if skip_last:
+                    # the peeled final pass: accumulate the last
+                    # j-data's contribution without rotating it onward.
+                    # Same accel op sequence as the uniform loop ->
+                    # bitwise-identical trajectories (per formulation).
+                    dax, day, daz = accel_pair(out[3:])
+                    ax, ay, az = ax + dax, ay + day, az + daz
+            else:
+                # Pipelined ring: hold a `depth`-entry queue of j-block
+                # groups (queue[i] = hop base+i's data). Each pass
+                # issues the rotate producing the NEXT group before the
+                # force block on the oldest, then shifts the queue. The
+                # epilogue drains the queue without rotating — total
+                # rotations = P-1, so the dead last-hop shift is gone
+                # and SKIP_LAST is subsumed at depth >= 2. Forces still
+                # accumulate in hop order 0..P-1: bitwise identical.
+                g = len(init_blocks)
+                queue = [init_blocks]
+                for _d in range(depth - 1):
+                    queue.append(rotate(queue[-1]))
+
+                def ring_deep(k, carry):
+                    ax, ay, az = carry[:3]
+                    qs = carry[3:]
+                    q = [
+                        qs[i * g : (i + 1) * g] for i in range(depth)
+                    ]
+                    # next hop's shift leaves before this hop's forces
+                    newest = rotate(q[-1])
+                    dax, day, daz = accel_pair(q[0])
+                    flat = tuple(
+                        b for grp in q[1:] for b in grp
+                    ) + newest
+                    return (ax + dax, ay + day, az + daz) + flat
+
+                flat0 = tuple(b for grp in queue for b in grp)
+                out = jax.lax.fori_loop(
+                    0, nranks - depth, ring_deep,
+                    (zero, zero, zero) + flat0
+                )
+                ax, ay, az = out[:3]
+                qs = out[3:]
+                for i in range(depth):
+                    dax, day, daz = accel_pair(
+                        qs[i * g : (i + 1) * g]
+                    )
+                    ax, ay, az = ax + dax, ay + day, az + daz
             vxl = vxl + ax * dt
             vyl = vyl + ay * dt
             vzl = vzl + az * dt

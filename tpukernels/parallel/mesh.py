@@ -52,16 +52,36 @@ def maybe_distributed_init() -> None:
         kw["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
     if "JAX_PROCESS_ID" in os.environ:
         kw["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    # the rest of the multi-host coordinator contract
+    # (docs/DISTRIBUTED.md §multi-host): pin which local devices this
+    # process owns (hosts sharing chips across processes), and bound
+    # the coordinator rendezvous so a dead peer fails the job instead
+    # of hanging it
+    if "JAX_LOCAL_DEVICE_IDS" in os.environ:
+        kw["local_device_ids"] = [
+            int(t) for t in
+            os.environ["JAX_LOCAL_DEVICE_IDS"].split(",") if t.strip()
+        ]
+    if "JAX_COORDINATOR_TIMEOUT_S" in os.environ:
+        kw["initialization_timeout"] = int(
+            os.environ["JAX_COORDINATOR_TIMEOUT_S"]
+        )
     jax.distributed.initialize(coordinator_address=addr, **kw)
 
 
-def make_mesh(n_devices: int | None = None, axis: str = "x") -> Mesh:
-    """A 1-D ring mesh over the first `n_devices` devices (default all).
+def make_mesh(n_devices=None, axis: str = "x",
+              axes=("x", "y")) -> Mesh:
+    """A mesh over the first devices (default: all, 1-D).
 
-    All the reference's communication patterns (halo sendrecv, ring
-    body rotation, allreduce) are 1-D ring patterns, so a 1-D mesh is
-    the faithful topology; ICI ring ordering is what
-    `jax.lax.ppermute` rides on.
+    ``n_devices`` as an int (or None) builds the 1-D ring of record —
+    all the reference's communication patterns (halo sendrecv, ring
+    body rotation, allreduce) are 1-D ring patterns, and ICI ring
+    ordering is what `jax.lax.ppermute` rides on. ``n_devices`` as an
+    ``(r, c)`` tuple builds a 2-D ``axes``-named mesh over the first
+    ``r*c`` devices — the torus topology real pods expose, on which
+    ``allreduce_sum`` decomposes into reduce-scatter-along-x /
+    allgather-along-y (collectives.py) and 2-D shardings split both
+    leading dims.
 
     Joins the multi-host job first when a coordinator is configured:
     EVERY pod-capable path (all C-shim adapters, busbw, the dryrun)
@@ -71,6 +91,15 @@ def make_mesh(n_devices: int | None = None, axis: str = "x") -> Mesh:
     """
     maybe_distributed_init()
     devs = jax.devices()
+    if isinstance(n_devices, (tuple, list)):
+        r, c = (int(d) for d in n_devices)
+        if r * c > len(devs):
+            raise ValueError(
+                f"requested {r}x{c}={r * c} devices, have {len(devs)}"
+            )
+        return Mesh(
+            np.array(devs[: r * c]).reshape(r, c), tuple(axes)
+        )
     if n_devices is None:
         n_devices = len(devs)
     if n_devices > len(devs):
@@ -109,10 +138,17 @@ def host_to_global(a, sharding: NamedSharding):
 def global_to_host(o) -> np.ndarray:
     """Full host value of a shard_map output. Replicated outputs are
     fetchable from any local shard; sharded outputs on a multi-process
-    run live partly on other hosts and are all-gathered first so every
-    host's driver sees (and checks) the whole result."""
+    run live partly on other hosts and are gathered first so every
+    host's driver sees (and checks) the whole result. The gather is a
+    jit identity resharded to replicated: `process_allgather(tiled=
+    True)` concatenates host shards along axis 0 — correct only for
+    the 1-D row sharding, silently interleaved garbage for a 2-D
+    ``P("x","y")`` output — while an out_shardings respec follows the
+    array's OWN sharding whatever its rank."""
     if jax.process_count() > 1 and not o.is_fully_replicated:
-        from jax.experimental import multihost_utils
+        from jax import jit
+        from jax.sharding import NamedSharding, PartitionSpec
 
-        o = multihost_utils.process_allgather(o, tiled=True)
+        rep = NamedSharding(o.sharding.mesh, PartitionSpec())
+        o = jit(lambda v: v, out_shardings=rep)(o)
     return np.asarray(o)

@@ -1,0 +1,313 @@
+"""Comm/compute overlap measurement (docs/DISTRIBUTED.md §overlap).
+
+The depth-pipelined kernels (TPK_DIST_DEPTH, collectives.py) claim to
+hide ppermute hops under compute. This module makes that claim a
+measured, artifact-backed figure the obs stack can judge — CPU-provable
+under the 2-process gloo harness, no chip window needed.
+
+Per op it times three warm jitted programs, best-of-reps:
+
+- ``comm``    — only the op's wire pattern (the ring rotations / halo
+                ppermutes), chained so hops serialize like the real
+                program's;
+- ``compute`` — only the op's arithmetic (force blocks / sweeps), no
+                collectives;
+- ``full``    — the real kernel at the configured pipeline depth.
+
+If the runtime truly overlaps, ``t_full < t_comm + t_compute``; the
+headline figure is
+
+    overlap_frac = clamp01((t_comm + t_compute - t_full)
+                           / min(t_comm, t_compute))
+
+i.e. the fraction of the SMALLER phase that the full program hid (1.0 =
+the cheaper side rode entirely under the other). Each op's measurement
+runs inside an ``overlap/<op>`` span with pre-measured ``comm/<op>``
+and ``compute/<op>`` child spans (docs/OBSERVABILITY.md §span names),
+emits one ``overlap_point`` journal event, and the CLI persists the
+sweep as a ``docs/logs/scaling_overlap_*.json`` artifact that
+``tools/obs_report.py`` judges: a validated non-fake point under
+``TPK_OVERLAP_MIN_FRAC`` earns the NON-GATING ``overlap_low`` verdict.
+
+CLI:  python -m tpukernels.parallel.overlap [--ops=nbody_ring,stencil2d]
+          [--reps=5] [--quick] [--depth=D]
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpukernels.obs import metrics as obs_metrics
+from tpukernels.obs import scaling, trace
+from tpukernels.parallel import collectives
+from tpukernels.parallel.mesh import host_to_global, make_mesh, row_sharding
+from tpukernels.resilience import journal
+
+DEFAULT_OPS = ("nbody_ring", "stencil2d")
+
+# per-op working-set knobs: (default, --quick)
+_WORK = {
+    "nbody_bodies": (4096, 256),   # per rank
+    "nbody_steps": (2, 1),
+    "stencil_rows": (1024, 64),    # per rank
+    "stencil_cols": (2048, 256),
+    "stencil_iters": (16, 8),
+    "stencil_k": (4, 4),
+}
+
+
+def _work(name: str, quick: bool) -> int:
+    return _WORK[name][1 if quick else 0]
+
+
+def _probe(fn):
+    """Wrap a program so it returns one fully-replicated scalar — the
+    busbw timed_program rule: fetchable on every host, and the full
+    output stays live so XLA cannot narrow the collective."""
+    return jax.jit(
+        lambda *a: sum(
+            jnp.sum(o) for o in jax.tree_util.tree_leaves(fn(*a))
+        )
+    )
+
+
+def _nbody_programs(mesh, axis, depth, quick):
+    """(full, comm, compute, args) for the ring N-body op."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpukernels.compat import shard_map
+
+    nranks = mesh.shape[axis]
+    steps = _work("nbody_steps", quick)
+    n = _work("nbody_bodies", quick) * nranks
+    rng = np.random.default_rng(0)
+    state = tuple(
+        host_to_global(
+            rng.standard_normal(n).astype(np.float32)
+            if i < 6 else
+            rng.uniform(0.5, 1.5, n).astype(np.float32),
+            row_sharding(mesh, axis),
+        )
+        for i in range(7)
+    )
+    full = _probe(
+        collectives._nbody_ring_build(
+            steps, mesh, axis, 1e-3, 1e-2, False, False, depth
+        )
+    )
+    fwd = collectives._ring_perm(nranks, 1)
+    eps2 = jnp.float32(1e-4)
+
+    def comm_local(jx, jy, jz, jm):
+        # the ring's wire pattern alone: steps x (nranks-1) chained
+        # block rotations (chained through the carry, so hops
+        # serialize exactly like the pipeline's critical path)
+        def body(_, bs):
+            return tuple(
+                jax.lax.ppermute(b, axis, fwd) for b in bs
+            )
+
+        return jax.lax.fori_loop(
+            0, steps * max(nranks - 1, 1), body, (jx, jy, jz, jm)
+        )
+
+    def compute_local(px, py, pz, m):
+        # the arithmetic alone: steps x nranks force blocks on the
+        # local i-bodies, no collective anywhere
+        def body(_, acc):
+            ax, ay, az = acc
+            dax, day, daz = collectives._pairwise_accel(
+                px, py, pz, px, py, pz, m, eps2
+            )
+            return (ax + dax, ay + day, az + daz)
+
+        zero = jnp.zeros_like(px)
+        return jax.lax.fori_loop(
+            0, steps * nranks, body, (zero, zero, zero)
+        )
+
+    shard = P(axis)
+    comm = _probe(jax.jit(shard_map(
+        comm_local, mesh=mesh, in_specs=(shard,) * 4,
+        out_specs=(shard,) * 4,
+    )))
+    compute = _probe(jax.jit(shard_map(
+        compute_local, mesh=mesh, in_specs=(shard,) * 4,
+        out_specs=(shard,) * 3,
+    )))
+    xyzm = (state[0], state[1], state[2], state[6])
+    return {"full": (full, state), "comm": (comm, xyzm),
+            "compute": (compute, xyzm)}
+
+
+def _stencil_programs(mesh, axis, depth, quick):
+    """(full, comm, compute, args) for the 2-D Jacobi halo op."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpukernels.compat import shard_map
+
+    nranks = mesh.shape[axis]
+    rows = _work("stencil_rows", quick) * nranks
+    cols = _work("stencil_cols", quick)
+    iters = _work("stencil_iters", quick)
+    k = _work("stencil_k", quick)
+    l0 = rows // nranks
+    passes = max(iters // k, 1)
+    rng = np.random.default_rng(1)
+    x = host_to_global(
+        rng.standard_normal((rows, cols)).astype(np.float32),
+        row_sharding(mesh, axis),
+    )
+    full = _probe(
+        collectives._jacobi_dist_build(
+            (rows, cols), iters, mesh, axis, k, False, depth
+        )
+    )
+    up = collectives._ring_perm(nranks, 1)
+    down = collectives._ring_perm(nranks, -1)
+
+    def comm_local(v):
+        # the halo wire pattern alone: one k-deep top+bottom exchange
+        # per round, received bands written back into the carry so
+        # rounds serialize like the real halo dependency chain
+        def body(_, v):
+            top = jax.lax.ppermute(v[-k:], axis, up)
+            bot = jax.lax.ppermute(v[:k], axis, down)
+            return jnp.concatenate([top, v[k : l0 - k], bot], axis=0)
+
+        return jax.lax.fori_loop(0, passes, body, v)
+
+    def compute_local(v):
+        # the sweeps alone: k fused local sweeps per round, no halos
+        def body(_, v):
+            for _s in range(k):
+                v = 0.25 * sum(
+                    collectives._edge_shift(v, a, f)
+                    for a in (0, 1) for f in (True, False)
+                )
+            return v
+
+        return jax.lax.fori_loop(0, passes, body, v)
+
+    shard = P(axis, None)
+    comm = _probe(jax.jit(shard_map(
+        comm_local, mesh=mesh, in_specs=shard, out_specs=shard,
+    )))
+    compute = _probe(jax.jit(shard_map(
+        compute_local, mesh=mesh, in_specs=shard, out_specs=shard,
+    )))
+    return {"full": (full, (x,)), "comm": (comm, (x,)),
+            "compute": (compute, (x,))}
+
+
+_BUILDERS = {
+    "nbody_ring": _nbody_programs,
+    "stencil2d": _stencil_programs,
+}
+
+
+def _time_best(fn, args, reps: int) -> float:
+    """Warm (compile + first run, untimed), then best-of-reps wall.
+    The probe output is a replicated scalar; np.asarray inside the
+    timed window forces real completion (the busbw materialization
+    rule), the barrier after catches straggler local devices."""
+    w = fn(*args)
+    np.asarray(w)
+    jax.block_until_ready(w)
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        np.asarray(r)
+        best = min(best, time.perf_counter() - t0)
+        jax.block_until_ready(r)
+    return best
+
+
+def overlap_frac(t_comm: float, t_compute: float,
+                 t_full: float) -> float:
+    """clamp01((t_comm + t_compute - t_full) / min(t_comm, t_compute)):
+    the fraction of the cheaper phase the full program hid."""
+    denom = min(t_comm, t_compute)
+    if denom <= 0:
+        return 0.0
+    return max(0.0, min(1.0, (t_comm + t_compute - t_full) / denom))
+
+
+def measure(ops=None, mesh=None, axis: str = "x", depth=None,
+            reps: int = 5, quick: bool = False, verbose: bool = True,
+            fake=None):
+    """Measure comm/compute overlap for each op; returns the artifact
+    ``points`` list. ``depth`` defaults to the TPK_DIST_DEPTH knob —
+    measuring the configured path of record, not a hypothetical."""
+    if mesh is None:
+        mesh = make_mesh()  # joins the multi-host job when configured
+    nranks = mesh.shape[axis]
+    if depth is None:
+        depth = collectives._dist_depth()
+    if fake is None:
+        fake = scaling.inventory(probe=True).get("fake", True)
+    points = []
+    for op in ops or DEFAULT_OPS:
+        if op not in _BUILDERS:
+            raise ValueError(
+                f"op={op!r}: expected one of {sorted(_BUILDERS)}"
+            )
+        progs = _BUILDERS[op](mesh, axis, int(depth), quick)
+        with trace.span(f"overlap/{op}", n=nranks, depth=int(depth)):
+            t_comm = _time_best(*progs["comm"], reps)
+            trace.emit_span(f"comm/{op}", t_comm, n=nranks)
+            t_compute = _time_best(*progs["compute"], reps)
+            trace.emit_span(f"compute/{op}", t_compute, n=nranks)
+            t_full = _time_best(*progs["full"], reps)
+        frac = overlap_frac(t_comm, t_compute, t_full)
+        point = {
+            "op": op, "n_devices": int(nranks), "mesh_shape": None,
+            "depth": int(depth), "t_comm_s": round(t_comm, 6),
+            "t_compute_s": round(t_compute, 6),
+            "t_full_s": round(t_full, 6),
+            "overlap_frac": round(frac, 4),
+        }
+        points.append(point)
+        obs_metrics.inc("scaling.overlap_points")
+        journal.emit("overlap_point", fake=bool(fake), **point)
+        if verbose:
+            print(
+                f"overlap {op:<12} n={nranks} depth={depth} "
+                f"comm={t_comm * 1e3:8.3f}ms "
+                f"compute={t_compute * 1e3:8.3f}ms "
+                f"full={t_full * 1e3:8.3f}ms frac={frac:5.3f}"
+            )
+    return points
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    kw = {}
+    for a in sys.argv[1:]:
+        if a.startswith("--ops="):
+            kw["ops"] = tuple(
+                t for t in a[6:].split(",") if t.strip()
+            )
+        elif a.startswith("--reps="):
+            kw["reps"] = int(a[7:])
+        elif a == "--quick":
+            kw["quick"] = True
+        elif a.startswith("--depth="):
+            kw["depth"] = int(a[8:])
+    # CLI journal default (the bench/busbw/loadgen contract)
+    if os.environ.get("TPK_HEALTH_JOURNAL") is None:
+        os.environ["TPK_HEALTH_JOURNAL"] = journal.default_path()
+    # mesh FIRST, probe second (the busbw CLI ordering rule:
+    # jax.distributed.initialize must precede any backend init)
+    mesh = make_mesh()
+    inv = scaling.emit_inventory("overlap", probe=True)
+    pts = measure(mesh=mesh, fake=inv.get("fake", True), **kw)
+    artifact = scaling.write_overlap_artifact(pts, inv)
+    print(f"# overlap artifact: {artifact}", file=sys.stderr)

@@ -58,6 +58,16 @@ _POPULATED = False
 # its base, so a new kernel can't silently skip one.
 DERIVED_KERNELS = {"scan_exclusive": "scan"}
 
+# Kernels with a mesh-backed distributed twin (parallel/collectives.py)
+# — the serve tier's over-avatar escape hatch (docs/SERVING.md §mesh
+# tier): a request too big for every single-device avatar routes to
+# :func:`dispatch_mesh` instead of being rejected, but only for
+# kernels that actually have a sharded formulation. The admission side
+# (serve/bucketing.mesh_tier_for) reads this tuple lazily so the
+# capability list has ONE home.
+MESH_KERNELS = ("histogram", "nbody", "scan", "scan_exclusive",
+                "stencil2d", "stencil3d")
+
 
 def lookup(name: str) -> Callable:
     _populate()
@@ -145,6 +155,114 @@ def dispatch(name: str, *args, **statics):
             out = _aot.run_cached(name, fn, args, statics)
         out = _integrity.guard("registry", name, out, statics=statics)
     _obs_metrics.inc(f"dispatch.calls.{name}")
+    _obs_metrics.observe(
+        f"dispatch.wall_s.{name}", _time.perf_counter() - t0
+    )
+    return out
+
+
+# (kernel, ring size) -> the mesh-twin wrapper callable. Cached so the
+# AOT memo key and the executable behind it are stable across calls:
+# the wrapper's identity never matters (run_cached keys on the name
+# string), but rebuilding the mesh per call would re-run make_mesh's
+# device enumeration on every request.
+_MESH_FNS: Dict[tuple, Callable] = {}
+
+
+def _mesh_callable(name: str, n: int) -> Callable:
+    key = (name, n)
+    fn = _MESH_FNS.get(key)
+    if fn is not None:
+        return fn
+    from tpukernels.parallel import collectives as _coll
+    from tpukernels.parallel.mesh import make_mesh as _make_mesh
+
+    # the 1-D ring of record: every dist kernel's comm pattern
+    # (halo sendrecv, ring body rotation, two-level scan) rides it.
+    # make_mesh raises ValueError when fewer than n devices exist —
+    # the honest answer when the admission env promised more chips
+    # than the backend has (the env inventory is a promise, not a
+    # measurement), surfaced to the client as an error reply.
+    mesh = _make_mesh(n)
+    if name == "scan":
+        fn = lambda x: _coll.scan_dist(x, mesh)  # noqa: E731
+    elif name == "scan_exclusive":
+        fn = lambda x: _coll.scan_dist(  # noqa: E731
+            x, mesh, exclusive=True)
+    elif name == "histogram":
+        fn = lambda x, nbins=256: _coll.histogram_dist(  # noqa: E731
+            x, int(nbins), mesh)
+    elif name == "stencil2d":
+        fn = lambda x, iters=8: _coll.jacobi2d_dist(  # noqa: E731
+            x, int(iters), mesh)
+    elif name == "stencil3d":
+        fn = lambda x, iters=8: _coll.jacobi3d_dist(  # noqa: E731
+            x, int(iters), mesh)
+    elif name == "nbody":
+        def fn(px, py, pz, vx, vy, vz, m, dt=1e-3, eps=1e-2, steps=1):
+            return _coll.nbody_dist_ring(
+                (px, py, pz, vx, vy, vz, m), int(steps), mesh,
+                dt=dt, eps=eps,
+            )
+    else:
+        raise KeyError(
+            f"kernel {name!r} has no mesh-tier twin; mesh kernels: "
+            f"{sorted(MESH_KERNELS)}"
+        )
+    _MESH_FNS[key] = fn
+    return fn
+
+
+def dispatch_mesh(name: str, *args, mesh_shape=None, **statics):
+    """Run one kernel call on its mesh-backed distributed twin —
+    the over-avatar serve tier (docs/SERVING.md §mesh tier).
+
+    Same machinery as :func:`dispatch` end to end: the
+    ``dispatch/<kernel>`` span (stamped ``mesh=``), the dispatch fault
+    point, the AOT executable memo — keyed ``<name>@mesh<n>`` so the
+    mesh program memoizes beside (never instead of) the single-device
+    one, while ``aot.invalidate_kernel(name)`` still drops it (the
+    base-name match splits on ``@``) — and the output-integrity guard
+    under the base kernel name, whose canary cross-checks the
+    single-device formulation. ``mesh_shape`` is the admission-time
+    tier decision (serve/bucketing.mesh_tier_for), a tuple whose
+    product is the ring size; the worker-side ``make_mesh`` revalidates
+    it against the live backend, so an env inventory that promised
+    more chips than exist becomes a clean dispatch error, not silent
+    wrong-device execution. Metrics: ``dispatch.calls.<kernel>`` and
+    ``dispatch.wall_s.<kernel>`` as on the native path, plus a
+    ``dispatch.mesh.<kernel>`` counter so the mesh tier's share is
+    readable without log archaeology."""
+    if not isinstance(mesh_shape, (tuple, list)) or not mesh_shape:
+        raise ValueError(
+            f"mesh_shape={mesh_shape!r}: expected a non-empty tuple"
+        )
+    n = 1
+    for d in mesh_shape:
+        n *= int(d)
+    if n < 2:
+        raise ValueError(
+            f"mesh_shape={mesh_shape!r}: a mesh tier needs >= 2 devices"
+        )
+    t0 = _time.perf_counter()
+    with _trace.span(f"dispatch/{name}",
+                     mesh="x".join(str(int(d)) for d in mesh_shape)):
+        faults.dispatch_fault(name)
+        fn = _mesh_callable(name, n)
+        if not _aot.enabled():
+            out = fn(*args, **statics)
+        else:
+            # staleness sources: the dist formulation lives in
+            # collectives.py, not the base kernel's module — a halo
+            # or ring change must stale the mesh twin's manifest rows
+            out = _aot.run_cached(
+                f"{name}@mesh{n}", fn, args, statics,
+                sources=("tpukernels/parallel/collectives.py",)
+                + tuple(_aot.KERNEL_SOURCES.get(name, ())),
+            )
+        out = _integrity.guard("registry", name, out, statics=statics)
+    _obs_metrics.inc(f"dispatch.calls.{name}")
+    _obs_metrics.inc(f"dispatch.mesh.{name}")
     _obs_metrics.observe(
         f"dispatch.wall_s.{name}", _time.perf_counter() - t0
     )

@@ -394,3 +394,46 @@ def bucket_id(kernel: str, spec, statics: dict, arrays=None) -> str:
         )
     stat = ",".join(f"{k}={v}" for k, v in sorted((statics or {}).items()))
     return f"{kernel}|{shapes}|{stat or '-'}"
+
+
+def mesh_tier_for(kernel: str, arrays, statics: dict):
+    """Mesh shape tuple ``(n,)`` when an over-avatar request can route
+    to the mesh tier (``registry.dispatch_mesh``), else ``None`` —
+    consulted by the server ONLY after :func:`bucket_for` came back
+    ``(None, "over-avatar")``, so a request that merely mismatched
+    layout or statics never lands here (docs/SERVING.md §mesh tier).
+
+    Admission must not initialize a backend (the bucket_for /
+    bucket_id rule: layout-only, numpy-only), so the device count
+    comes from the ENV inventory (``scaling.inventory(probe=False)``
+    reads ``--xla_force_host_platform_device_count`` — how the CPU
+    fleet harness fakes a multi-chip worker). A host whose env
+    declares no count (the normal real-pod config) gets no mesh tier
+    at admission; the worker-side ``make_mesh`` inside dispatch_mesh
+    is where the live backend gets the last word either way.
+
+    Eligibility: the kernel has a mesh twin (``registry.MESH_KERNELS``
+    — the one home of the capability list), >1 device, and the
+    sharded leading dim divides the ring (every dist kernel's
+    ``N % P == 0`` contract). nbody additionally needs its full
+    7-array SoA state on one common length — anything else would
+    error inside the kernel; better to dispatch natively and let the
+    single-device kernel reject it honestly."""
+    from tpukernels import registry
+
+    if kernel not in registry.MESH_KERNELS:
+        return None
+    from tpukernels.obs import scaling
+
+    n = scaling.inventory(probe=False).get("n_devices")
+    if not isinstance(n, int) or n <= 1:
+        return None
+    shapes = [tuple(np.asarray(a).shape) for a in arrays]
+    lead = next((s[0] for s in shapes if s), None)
+    if not lead or lead % n:
+        return None
+    if kernel == "nbody" and (
+        len(shapes) != 7 or any(s != (lead,) for s in shapes)
+    ):
+        return None
+    return (n,)

@@ -168,11 +168,13 @@ class _Request:
                  "spec", "pad_frac", "bucket", "conn", "t_enq",
                  "t_start", "requeues", "patience", "done", "lock",
                  "worker_ident", "tenant", "shm_ok", "request_id",
-                 "shapes", "dtypes", "replayed", "deadline_at")
+                 "shapes", "dtypes", "replayed", "deadline_at",
+                 "mesh_shape")
 
     def __init__(self, serial, rid, kernel, statics, arrays, spec,
                  pad_frac, bucket, conn, tenant=None, shm_ok=False,
-                 request_id=None, replayed=None, deadline_at=None):
+                 request_id=None, replayed=None, deadline_at=None,
+                 mesh_shape=None):
         self.serial = serial  # server-side key: client ids can collide
         self.rid = rid
         # the client-minted causal id (docs/OBSERVABILITY.md §request
@@ -200,6 +202,11 @@ class _Request:
         # time ever crosses the wire, so clock skew cannot expire (or
         # resurrect) a request; None means no deadline
         self.deadline_at = deadline_at
+        # the admission-time mesh-tier decision (bucketing.
+        # mesh_tier_for): a non-None shape routes this over-avatar
+        # request through registry.dispatch_mesh instead of the
+        # single-device dispatch (docs/SERVING.md §mesh tier)
+        self.mesh_shape = tuple(mesh_shape) if mesh_shape else None
         self.shm_ok = shm_ok       # client negotiated the shm lane
         self.t_enq = time.perf_counter()
         self.t_start = None
@@ -613,7 +620,24 @@ class Server:
             )
             spec, how = bucketing.bucket_for(kernel, arrays, statics)
             pad_frac = how if spec is not None else 0.0
+            # the over-avatar escape hatch (docs/SERVING.md §mesh
+            # tier): a request too big for every avatar may still run
+            # — on the kernel's mesh-backed distributed twin. Only the
+            # over-avatar reason consults the tier; every other native
+            # reason (layout/statics mismatch, pad-over-cap) keeps the
+            # plain single-device dispatch it always had.
+            mesh_shape = (
+                bucketing.mesh_tier_for(kernel, arrays, statics)
+                if spec is None and how == "over-avatar" else None
+            )
             bucket = bucketing.bucket_id(kernel, spec, statics, arrays)
+            if mesh_shape is not None:
+                # its own coalescing/locking key: the mesh program is
+                # a different executable than a native dispatch at
+                # the same shapes would compile
+                bucket += "|mesh" + "x".join(
+                    str(d) for d in mesh_shape
+                )
         except (KeyError, ValueError, TypeError, AttributeError,
                 protocol.ProtocolError) as e:
             # TypeError/AttributeError cover structurally malformed
@@ -644,7 +668,8 @@ class Server:
                                  and not isinstance(replay, bool)
                                  and replay > 0 else None),
                        deadline_at=protocol.deadline_from_header(
-                           header))
+                           header),
+                       mesh_shape=mesh_shape)
         try:
             self._q.put_nowait(req)
         except _queue_mod.Full:
@@ -991,8 +1016,16 @@ class Server:
                 args, meta = req.arrays, None
             jargs = tuple(jnp.asarray(a) for a in args)
             with trace.span(f"serve/{req.kernel}", bucket=req.bucket):
-                out = registry.dispatch(req.kernel, *jargs,
-                                        **req.statics)
+                if req.mesh_shape is not None:
+                    # the over-avatar mesh tier (docs/SERVING.md):
+                    # same span/fault/AOT/integrity machinery, the
+                    # kernel's distributed twin as the executable
+                    out = registry.dispatch_mesh(
+                        req.kernel, *jargs,
+                        mesh_shape=req.mesh_shape, **req.statics)
+                else:
+                    out = registry.dispatch(req.kernel, *jargs,
+                                            **req.statics)
                 jax.block_until_ready(out)
             if self._device_kind is None:
                 from tpukernels.tuning import cache as tcache
@@ -1090,6 +1123,11 @@ class Server:
             tenant=req.tenant,
             bucket=req.bucket, pad_frac=round(req.pad_frac, 6),
             bucketed=req.spec is not None,
+            # non-None iff the request ran on the mesh tier — the
+            # capacity-planning signal (how much traffic outgrows the
+            # single-device table) rides the same shape-mix record
+            mesh_shape=(list(req.mesh_shape)
+                        if req.mesh_shape is not None else None),
             # the per-request shape-mix record (requested, PRE-pad
             # shapes/dtypes): the exact input ROADMAP item 5's
             # bucket-table optimizer mines, aggregated by
